@@ -1,0 +1,19 @@
+"""ai_crypto_trader_tpu — a TPU-native quantitative crypto-trading framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+system (zd87pl/ai-crypto-trader): technical-indicator analytics, vectorized
+backtesting, Monte-Carlo risk simulation, neural price prediction, DQN
+reinforcement learning, genetic strategy evolution, market-regime detection,
+chart-pattern recognition, portfolio risk management, and a live-trading host
+shell — all with the heavy compute expressed as pure, jit-compiled functions
+that scale over a `jax.sharding.Mesh`.
+
+Design stance (vs the reference's 16 Redis-pub/sub microservices):
+a single-process-per-host compute core (pure JAX, jit/vmap/shard_map) plus a
+thin async host shell for exchange/LLM/news I/O.  Numeric data travels over
+ICI via XLA collectives, never over a network bus.
+"""
+
+__version__ = "0.1.0"
+
+from ai_crypto_trader_tpu.config import FrameworkConfig, load_config  # noqa: F401

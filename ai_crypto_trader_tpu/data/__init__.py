@@ -1,0 +1,7 @@
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv  # noqa: F401
+from ai_crypto_trader_tpu.data.ingest import (  # noqa: F401
+    OHLCV,
+    klines_to_arrays,
+    load_csv,
+    save_csv,
+)

@@ -1,0 +1,107 @@
+"""OHLCV ingest: exchange klines / CSV → dense float32 arrays.
+
+Replaces the reference's pandas-everywhere data path
+(`backtesting/data_manager.py:47-317`: paginated klines → DataFrame → CSV
+cache).  Host-side ingest stays in plain NumPy/CSV; the compute path only
+ever sees dense ``f32[T]`` arrays (SURVEY §2.6 "pandas" row).
+
+CSV layout is compatible with the reference's cache
+(``backtesting/data/market/<symbol>/<symbol>_<interval>.csv``) so existing
+downloaded datasets can be reused directly.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from dataclasses import dataclass
+from typing import Mapping, Sequence
+
+import numpy as np
+
+FIELDS = ("open", "high", "low", "close", "volume")
+
+
+@dataclass
+class OHLCV:
+    """A column-oriented candle series. ``timestamp`` is epoch-ms int64."""
+
+    timestamp: np.ndarray
+    open: np.ndarray
+    high: np.ndarray
+    low: np.ndarray
+    close: np.ndarray
+    volume: np.ndarray
+    symbol: str = ""
+    interval: str = "1m"
+
+    def __len__(self):
+        return int(self.close.shape[0])
+
+    def as_dict(self) -> dict:
+        return {f: getattr(self, f) for f in FIELDS}
+
+    def slice(self, start: int, stop: int) -> "OHLCV":
+        return OHLCV(
+            timestamp=self.timestamp[start:stop],
+            **{f: getattr(self, f)[start:stop] for f in FIELDS},
+            symbol=self.symbol,
+            interval=self.interval,
+        )
+
+
+def klines_to_arrays(klines: Sequence[Sequence], symbol: str = "", interval: str = "1m") -> OHLCV:
+    """Convert Binance-format klines (12-column rows, reference
+    `binance_ml_strategy.py:313-317`) to an OHLCV array bundle."""
+    arr = np.asarray([row[:6] for row in klines], dtype=np.float64)
+    return OHLCV(
+        timestamp=arr[:, 0].astype(np.int64),
+        open=arr[:, 1].astype(np.float32),
+        high=arr[:, 2].astype(np.float32),
+        low=arr[:, 3].astype(np.float32),
+        close=arr[:, 4].astype(np.float32),
+        volume=arr[:, 5].astype(np.float32),
+        symbol=symbol,
+        interval=interval,
+    )
+
+
+def from_dict(d: Mapping[str, np.ndarray], symbol: str = "", interval: str = "1m") -> OHLCV:
+    n = len(d["close"])
+    ts = d.get("timestamp", np.arange(n, dtype=np.int64) * 60_000)
+    return OHLCV(timestamp=np.asarray(ts, dtype=np.int64),
+                 **{f: np.asarray(d[f], np.float32) for f in FIELDS},
+                 symbol=symbol, interval=interval)
+
+
+def save_csv(data: OHLCV, root: str) -> str:
+    path = os.path.join(root, "market", data.symbol or "UNKNOWN")
+    os.makedirs(path, exist_ok=True)
+    fname = os.path.join(path, f"{data.symbol}_{data.interval}.csv")
+    with open(fname, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(("timestamp",) + FIELDS)
+        for i in range(len(data)):
+            w.writerow([int(data.timestamp[i])] + [float(getattr(data, k)[i]) for k in FIELDS])
+    return fname
+
+
+def load_csv(path: str, symbol: str = "", interval: str = "1m") -> OHLCV:
+    rows = []
+    with open(path, newline="") as f:
+        r = csv.reader(f)
+        header = next(r)
+        idx = {name: header.index(name) for name in ("timestamp",) + FIELDS}
+        for row in r:
+            rows.append([row[idx["timestamp"]]] + [row[idx[k]] for k in FIELDS])
+    arr = np.asarray(rows, dtype=np.float64)
+    return OHLCV(
+        timestamp=arr[:, 0].astype(np.int64),
+        open=arr[:, 1].astype(np.float32),
+        high=arr[:, 2].astype(np.float32),
+        low=arr[:, 3].astype(np.float32),
+        close=arr[:, 4].astype(np.float32),
+        volume=arr[:, 5].astype(np.float32),
+        symbol=symbol,
+        interval=interval,
+    )

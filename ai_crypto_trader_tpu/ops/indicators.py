@@ -1,0 +1,343 @@
+"""Technical-indicator kernels as jit-compiled array programs.
+
+TPU-native replacement for the reference's `ta`-library pipeline
+(`binance_ml_strategy.py:14-249`, TechnicalAnalyzer).  Three building blocks,
+all compiler-friendly (static shapes, no data-dependent control flow):
+
+  * windowed reductions (`lax.reduce_window`) for rolling sum/mean/max/min —
+    XLA lowers these to efficient vectorized loops on the VPU;
+  * **parallel first-order recurrences** (`lax.associative_scan`) for every
+    EMA-family indicator (EMA, MACD, Wilder RSI, Wilder ATR).  The reference
+    computes these as sequential pandas `ewm` loops; here the recursion
+    y[t] = a·y[t-1] + b[t] is evaluated in O(log T) depth by composing the
+    affine maps associatively — this is what makes the 525 600-candle
+    (1 y of 1 m) axis fast on TPU;
+  * associative forward/backward NaN fill reproducing TechnicalAnalyzer's
+    `_handle_nan_values` (ffill → bfill → 0, `binance_ml_strategy.py:28-38`).
+
+Every kernel operates on the trailing time axis of a float32 array and is
+vmap-safe, so the same code serves [T], [symbol, T], and
+[device, symbol, T] layouts.
+
+NaN semantics match pandas `min_periods=window`: positions before the first
+full window are NaN until `nanfill` is applied — golden tests in
+tests/test_indicators.py check parity against pandas formulas.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _nan_like(x):
+    return jnp.full_like(x, jnp.nan)
+
+
+def _mask_warmup(y, window):
+    """NaN-out the first window-1 positions (pandas min_periods semantics)."""
+    t = lax.broadcasted_iota(jnp.int32, y.shape, y.ndim - 1)
+    return jnp.where(t < window - 1, jnp.nan, y)
+
+
+# ---------------------------------------------------------------------------
+# Windowed reductions
+# ---------------------------------------------------------------------------
+
+def _reduce_window_last(x, init, op, window):
+    dims = [1] * x.ndim
+    dims[-1] = window
+    pads = [(0, 0)] * (x.ndim - 1) + [(window - 1, 0)]
+    return lax.reduce_window(x, init, op, tuple(dims), (1,) * x.ndim, pads)
+
+
+def rolling_sum(x, window: int):
+    return _mask_warmup(_reduce_window_last(x, 0.0, lax.add, window), window)
+
+
+def rolling_mean(x, window: int):
+    return rolling_sum(x, window) / window
+
+
+def rolling_max(x, window: int):
+    return _mask_warmup(_reduce_window_last(x, -jnp.inf, lax.max, window), window)
+
+
+def rolling_min(x, window: int):
+    return _mask_warmup(_reduce_window_last(x, jnp.inf, lax.min, window), window)
+
+
+def rolling_std(x, window: int, ddof: int = 0):
+    """Rolling population std (ddof=0, matching `ta` BollingerBands).
+
+    Numerically conditioned for long f32 price series by centering on the
+    series mean before squaring (variance is shift-invariant)."""
+    c = jnp.nanmean(x, axis=-1, keepdims=True)
+    xc = x - c
+    m = rolling_mean(xc, window)
+    m2 = rolling_mean(xc * xc, window)
+    var = jnp.maximum(m2 - m * m, 0.0) * (window / (window - ddof))
+    return jnp.sqrt(var)
+
+
+sma = rolling_mean
+
+
+# ---------------------------------------------------------------------------
+# Parallel first-order recurrences (the EMA family)
+# ---------------------------------------------------------------------------
+
+def first_order_recursion(a, b):
+    """Solve y[t] = a[t]·y[t-1] + b[t] (y[-1]=0) in parallel.
+
+    Composes affine maps (a, b) with the associative operator
+    (a2, b2)∘(a1, b1) = (a1·a2, a2·b1 + b2) via `lax.associative_scan` —
+    O(log T) depth on TPU instead of the reference's O(T) pandas loop.
+    """
+    def combine(l, r):
+        al, bl = l
+        ar, br = r
+        return al * ar, ar * bl + br
+
+    _, y = lax.associative_scan(combine, (a, b), axis=-1)
+    return y
+
+
+def _ewm(x, alpha: float, start: int):
+    """pandas `ewm(alpha, adjust=False).mean()` beginning at index `start`
+    (recursion seeded with x[start]; earlier positions NaN).
+
+    `start` models pandas skipping leading NaNs (e.g. the diff/shift NaN at
+    t=0 for RSI/ATR inputs) so parity with `ta` is exact."""
+    t = lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    xs = jnp.where(t < start, 0.0, jnp.nan_to_num(x))
+    a = jnp.where(t <= start, 0.0, 1.0 - alpha)          # reset at seed point
+    b = jnp.where(t == start, xs, alpha * xs)
+    b = jnp.where(t < start, 0.0, b)
+    y = first_order_recursion(a, b)
+    return jnp.where(t < start, jnp.nan, y)
+
+
+def ema(x, window: int, start: int | None = None, min_periods: int | None = None):
+    """`ta` EMAIndicator: ewm(span=window, adjust=False, min_periods=window).
+
+    Reference: `binance_ml_strategy.py:79-83` (ema_12 / ema_26)."""
+    alpha = 2.0 / (window + 1.0)
+    start = 0 if start is None else start
+    y = _ewm(x, alpha, start)
+    mp = window if min_periods is None else min_periods
+    return _mask_warmup(y, mp + start)
+
+
+def macd(close, fast: int = 12, slow: int = 26, signal: int = 9):
+    """MACD line / signal / histogram, `ta` defaults
+    (reference `binance_ml_strategy.py:88-97`)."""
+    line = ema(close, fast, min_periods=1) - ema(close, slow, min_periods=1)
+    line = _mask_warmup(line, slow)
+    # pandas ewm on the signal skips the slow-1 leading NaNs of the line.
+    sig = ema(line, signal, start=slow - 1, min_periods=signal)
+    hist = line - sig
+    return line, sig, hist
+
+
+def rsi(close, window: int = 14):
+    """Wilder RSI, `ta` RSIIndicator semantics
+    (reference `binance_ml_strategy.py:109-116`).
+
+    gains/losses from diff(close); Wilder smoothing = ewm(alpha=1/window,
+    adjust=False) seeded at t=1 (diff[0] is NaN); RSI = 100·g/(g+l)."""
+    prev = jnp.roll(close, 1, axis=-1)
+    diff = close - prev
+    up = jnp.maximum(diff, 0.0)
+    dn = jnp.maximum(-diff, 0.0)
+    ag = _ewm(up, 1.0 / window, start=1)
+    al = _ewm(dn, 1.0 / window, start=1)
+    r = jnp.where(al == 0.0, jnp.where(ag == 0.0, 50.0, 100.0),
+                  100.0 - 100.0 / (1.0 + ag / jnp.where(al == 0.0, 1.0, al)))
+    return _mask_warmup(r, window + 1)
+
+
+def true_range(high, low, close):
+    prev_close = jnp.roll(close, 1, axis=-1)
+    t = lax.broadcasted_iota(jnp.int32, close.shape, close.ndim - 1)
+    prev_close = jnp.where(t == 0, jnp.nan, prev_close)
+    tr = jnp.maximum(high - low,
+                     jnp.maximum(jnp.abs(high - prev_close),
+                                 jnp.abs(low - prev_close)))
+    return jnp.where(t == 0, jnp.nan, tr)
+
+
+def atr(high, low, close, window: int = 14):
+    """Wilder ATR = ewm(alpha=1/window) of true range, `ta` AverageTrueRange
+    semantics (reference `binance_ml_strategy.py:161-168`)."""
+    tr = true_range(high, low, close)
+    y = _ewm(tr, 1.0 / window, start=1)
+    return _mask_warmup(y, window + 1)
+
+
+# ---------------------------------------------------------------------------
+# Oscillators / bands / volume
+# ---------------------------------------------------------------------------
+
+def stochastic(high, low, close, window: int = 14, smooth: int = 3):
+    """Stochastic %K / %D (`ta` defaults; reference
+    `binance_ml_strategy.py:118-130`)."""
+    hh = rolling_max(high, window)
+    ll = rolling_min(low, window)
+    rng = hh - ll
+    k = 100.0 * (close - ll) / jnp.where(rng == 0.0, jnp.nan, rng)
+    # NaN propagates through the windowed sum, so any 3-window containing a
+    # zero-range NaN %K yields NaN %D — exactly pandas rolling(3).mean().
+    d = rolling_mean(k, smooth)
+    return k, _mask_warmup(d, window + smooth - 1)
+
+
+def williams_r(high, low, close, window: int = 14):
+    """Williams %R (reference `binance_ml_strategy.py:132-143`)."""
+    hh = rolling_max(high, window)
+    ll = rolling_min(low, window)
+    rng = hh - ll
+    return -100.0 * (hh - close) / jnp.where(rng == 0.0, jnp.nan, rng)
+
+
+class Bollinger(NamedTuple):
+    high: jax.Array
+    mid: jax.Array
+    low: jax.Array
+    width: jax.Array
+    position: jax.Array
+
+
+def bollinger(close, window: int = 20, num_std: float = 2.0) -> Bollinger:
+    """Bollinger bands + width + %B (reference
+    `binance_ml_strategy.py:145-159`; zero-range %B → NaN as at line 155)."""
+    mid = rolling_mean(close, window)
+    sd = rolling_std(close, window)
+    hi = mid + num_std * sd
+    lo = mid - num_std * sd
+    width = (hi - lo) / mid
+    rng = hi - lo
+    pos = (close - lo) / jnp.where(rng == 0.0, jnp.nan, rng)
+    return Bollinger(hi, mid, lo, width, pos)
+
+
+def vwap(high, low, close, volume, window: int = 14):
+    """Rolling VWAP over typical price (`ta` VolumeWeightedAveragePrice;
+    reference `binance_ml_strategy.py:170-182`)."""
+    tp = (high + low + close) / 3.0
+    num = rolling_sum(tp * volume, window)
+    den = rolling_sum(volume, window)
+    return num / jnp.where(den == 0.0, jnp.nan, den)
+
+
+def ichimoku(high, low, conv: int = 9, base: int = 26, span_b: int = 52):
+    """Ichimoku senkou A/B, unshifted (`ta` visual=False; reference
+    `binance_ml_strategy.py:99-107`)."""
+    conv_line = (rolling_max(high, conv) + rolling_min(low, conv)) / 2.0
+    base_line = (rolling_max(high, base) + rolling_min(low, base)) / 2.0
+    a = (conv_line + base_line) / 2.0
+    b = (rolling_max(high, span_b) + rolling_min(low, span_b)) / 2.0
+    return a, b
+
+
+def obv(close, volume):
+    """On-balance volume (used by regime/feature components)."""
+    prev = jnp.roll(close, 1, axis=-1)
+    t = lax.broadcasted_iota(jnp.int32, close.shape, close.ndim - 1)
+    sign = jnp.where(t == 0, 0.0, jnp.sign(close - prev))
+    return jnp.cumsum(sign * volume, axis=-1)
+
+
+def roc(close, window: int = 12):
+    """Rate of change, percent."""
+    prev = jnp.roll(close, window, axis=-1)
+    t = lax.broadcasted_iota(jnp.int32, close.shape, close.ndim - 1)
+    return jnp.where(t < window, jnp.nan, 100.0 * (close - prev) / prev)
+
+
+# ---------------------------------------------------------------------------
+# NaN fill (TechnicalAnalyzer._handle_nan_values parity)
+# ---------------------------------------------------------------------------
+
+def ffill(x):
+    """Forward-fill NaNs via associative 'last valid value' scan."""
+    valid = ~jnp.isnan(x)
+
+    def combine(l, r):
+        lv, lok = l
+        rv, rok = r
+        return jnp.where(rok, rv, lv), lok | rok
+
+    y, _ = lax.associative_scan(combine, (jnp.nan_to_num(x), valid), axis=-1)
+    seen = lax.associative_scan(jnp.logical_or, valid, axis=-1)
+    return jnp.where(seen, y, jnp.nan)
+
+
+def bfill(x):
+    return jnp.flip(ffill(jnp.flip(x, axis=-1)), axis=-1)
+
+
+def nanfill(x):
+    """ffill → bfill → 0, exactly TechnicalAnalyzer._handle_nan_values
+    (`binance_ml_strategy.py:28-38`)."""
+    return jnp.nan_to_num(bfill(ffill(x)))
+
+
+# ---------------------------------------------------------------------------
+# The full per-candle indicator table
+# ---------------------------------------------------------------------------
+
+INDICATOR_NAMES = (
+    "sma_20", "sma_50", "sma_200", "ema_12", "ema_26",
+    "macd", "macd_signal", "macd_diff",
+    "ichimoku_a", "ichimoku_b",
+    "rsi", "stoch_k", "stoch_d", "williams_r",
+    "bb_high", "bb_mid", "bb_low", "bb_width", "bb_position",
+    "atr", "vwap",
+)
+
+
+@functools.partial(jax.jit, static_argnames=("fill",))
+def compute_indicators(ohlcv: dict, fill: bool = True) -> dict:
+    """Full TechnicalAnalyzer parity: every indicator column the reference
+    computes (`binance_ml_strategy.py:40-182`), for **every candle** at once.
+
+    (The reference's backtester actually evaluates indicators only on the
+    final row and replays that single value for all candles,
+    `backtesting/strategy_tester.py:63-125`; this framework computes true
+    per-candle values — strictly more capable, and the per-candle path is
+    what live mode uses anyway.)
+
+    Input: dict with float32 arrays open/high/low/close/volume [..., T].
+    Output: dict of the 21 indicator arrays plus passthrough OHLCV.
+    """
+    high, low, close, volume = (ohlcv[k] for k in ("high", "low", "close", "volume"))
+
+    out = dict(ohlcv)
+    out["sma_20"] = sma(close, 20)
+    out["sma_50"] = sma(close, 50)
+    out["sma_200"] = sma(close, 200)
+    out["ema_12"] = ema(close, 12)
+    out["ema_26"] = ema(close, 26)
+    line, sig, hist = macd(close)
+    out["macd"], out["macd_signal"], out["macd_diff"] = line, sig, hist
+    a, b = ichimoku(high, low)
+    out["ichimoku_a"], out["ichimoku_b"] = a, b
+    out["rsi"] = rsi(close)
+    k, d = stochastic(high, low, close)
+    out["stoch_k"], out["stoch_d"] = k, d
+    out["williams_r"] = williams_r(high, low, close)
+    bb = bollinger(close)
+    out["bb_high"], out["bb_mid"], out["bb_low"] = bb.high, bb.mid, bb.low
+    out["bb_width"], out["bb_position"] = bb.width, bb.position
+    out["atr"] = atr(high, low, close)
+    out["vwap"] = vwap(high, low, close, volume)
+
+    if fill:
+        out = {k: (nanfill(v) if jnp.issubdtype(v.dtype, jnp.floating) else v)
+               for k, v in out.items()}
+    return out

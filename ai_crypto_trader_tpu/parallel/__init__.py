@@ -1,0 +1,9 @@
+from ai_crypto_trader_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    default_mesh,
+    data_sharding,
+    initialize_distributed,
+    pad_to_multiple,
+    replicated,
+    shard_leading_axis,
+)

@@ -1,0 +1,113 @@
+"""Device mesh & sharding helpers — the framework's communication backend.
+
+The reference's entire distribution fabric is Redis pub/sub + key-value state
+(`services/utils/redis_pool.py`; SURVEY §5.8): services publish fitness
+values, market updates, and model outputs through a TCP bus.  Here the data
+plane is the TPU interconnect: arrays are sharded over a
+`jax.sharding.Mesh`, and XLA collectives (`psum` / `all_gather` /
+`ppermute`) move numbers over ICI.  A host-side event bus (shell/bus.py)
+survives only for control-plane signals.
+
+Two mesh axes by convention:
+  * ``data``  — batch / population / path / symbol parallelism
+  * ``model`` — parameter sharding for large models (unused at reference
+    model sizes, but first-class so pjit sharding is available; SURVEY §2.7)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    data_parallel: int = -1,
+    model_parallel: int = 1,
+    *,
+    axis_names: tuple[str, str] = ("data", "model"),
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a 2-D (data, model) mesh.
+
+    ``data_parallel=-1`` consumes all remaining devices.  On a single chip
+    this degenerates to a 1×1 mesh so every code path is mesh-shaped from the
+    start — going from 1 chip to a v5e-8 (or multi-host pod) changes only
+    this function's arguments.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if model_parallel <= 0:
+        model_parallel = 1
+    if data_parallel == -1:
+        data_parallel = n // model_parallel
+    if data_parallel < 1 or data_parallel * model_parallel > n:
+        raise ValueError(
+            f"mesh ({data_parallel} data x {model_parallel} model) does not fit "
+            f"the {n} available device(s)"
+        )
+    grid = np.asarray(devices[: data_parallel * model_parallel]).reshape(
+        data_parallel, model_parallel
+    )
+    return Mesh(grid, axis_names)
+
+
+@functools.lru_cache(maxsize=1)
+def default_mesh() -> Mesh:
+    return make_mesh()
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the leading axis over the data axis, replicate the rest."""
+    spec = P(mesh.axis_names[0], *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_leading_axis(mesh: Mesh, tree):
+    """Device_put a pytree with every leaf sharded on its leading axis.
+
+    Leading axes must divide the data-axis size; callers pad first (see
+    ``pad_to_multiple``)."""
+    def put(x):
+        return jax.device_put(x, data_sharding(mesh, np.ndim(x)))
+    return jax.tree.map(put, tree)
+
+
+def pad_to_multiple(x, multiple: int, axis: int = 0, pad_value=0.0):
+    """Pad ``x`` along ``axis`` so its size divides evenly over a mesh axis.
+
+    Returns (padded, original_size) — callers slice results back.  Padding +
+    masking is the standing answer to ragged shapes on TPU (SURVEY §7.4
+    "Ragged reality")."""
+    size = x.shape[axis]
+    rem = (-size) % multiple
+    if rem == 0:
+        return x, size
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, rem)
+    return np.pad(np.asarray(x), widths, constant_values=pad_value), size
+
+
+def initialize_distributed(coordinator: str | None = None, num_processes: int | None = None, process_id: int | None = None):
+    """Multi-host bring-up (DCN control plane + ICI data plane).
+
+    Replaces the reference's "every service dials the same Redis host"
+    topology (`services/utils/redis_pool.py:18-120`) for the compute tier:
+    hosts join one JAX distributed system and all cross-host numeric traffic
+    happens inside XLA collectives.
+    """
+    kwargs = {}
+    if coordinator is not None:
+        kwargs = dict(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)

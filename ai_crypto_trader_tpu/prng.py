@@ -1,0 +1,25 @@
+"""PRNG discipline for the framework.
+
+The reference sprinkles `np.random` / `random` and `datetime.now()` through
+every code path, which is what makes it untestable (SURVEY §7.4).  Here all
+randomness flows from explicit `jax.random` keys, split hierarchically.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def split_tree(key: jax.Array, names: tuple[str, ...]) -> dict[str, jax.Array]:
+    """Deterministically derive one named subkey per component."""
+    keys = jax.random.split(key, len(names))
+    return {name: k for name, k in zip(names, keys)}
+
+
+def fold(key: jax.Array, step) -> jax.Array:
+    """Derive a per-step key without carrying split state (safe inside scan)."""
+    return jax.random.fold_in(key, step)

@@ -1,0 +1,40 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The standard trick for testing pmap/shard_map distribution logic without a
+TPU pod (SURVEY §4): force the host platform to present 8 XLA CPU devices.
+Must run before jax initializes, hence the env mutation at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from ai_crypto_trader_tpu.parallel import make_mesh
+
+    return make_mesh(data_parallel=8, model_parallel=1)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.fixture(scope="session")
+def ohlcv():
+    """Deterministic synthetic OHLCV — the fixture the reference never had
+    (its tests hit live Binance/OpenAI; SURVEY §4)."""
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+
+    return generate_ohlcv(n=2048, seed=7)

@@ -1,0 +1,152 @@
+"""Golden parity tests: JAX indicator kernels vs pandas implementations of
+the `ta` library formulas used by the reference TechnicalAnalyzer
+(`binance_ml_strategy.py:40-182`)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu import ops
+
+
+def _series(ohlcv):
+    return {k: pd.Series(np.asarray(v, np.float64)) for k, v in ohlcv.items()
+            if k != "regime"}
+
+
+def assert_close(ours, ref, rtol=2e-4, atol=1e-3, skip=0):
+    ours = np.asarray(ours, np.float64)[skip:]
+    ref = np.asarray(ref, np.float64)[skip:]
+    mask = ~np.isnan(ref)
+    # wherever pandas is NaN (warmup / zero-range), ours must be NaN too —
+    # a too-loose warmup mask emitting finite garbage is a bug, not slack.
+    assert np.isnan(ours[~mask]).all(), "finite values where reference is NaN"
+    np.testing.assert_allclose(ours[mask], ref[mask], rtol=rtol, atol=atol)
+
+
+class TestRolling:
+    def test_sma(self, ohlcv):
+        s = _series(ohlcv)["close"]
+        ref = s.rolling(20).mean()
+        assert_close(ops.sma(jnp.asarray(ohlcv["close"]), 20), ref)
+
+    def test_rolling_max_min(self, ohlcv):
+        s = _series(ohlcv)["high"]
+        assert_close(ops.rolling_max(jnp.asarray(ohlcv["high"]), 14), s.rolling(14).max())
+        s = _series(ohlcv)["low"]
+        assert_close(ops.rolling_min(jnp.asarray(ohlcv["low"]), 14), s.rolling(14).min())
+
+    def test_rolling_std(self, ohlcv):
+        s = _series(ohlcv)["close"]
+        ref = s.rolling(20).std(ddof=0)
+        assert_close(ops.rolling_std(jnp.asarray(ohlcv["close"]), 20), ref,
+                     rtol=5e-3, atol=5e-2)
+
+
+class TestEMAFamily:
+    def test_ema(self, ohlcv):
+        s = _series(ohlcv)["close"]
+        for w in (12, 26):
+            ref = s.ewm(span=w, adjust=False, min_periods=w).mean()
+            assert_close(ops.ema(jnp.asarray(ohlcv["close"]), w), ref)
+
+    def test_macd(self, ohlcv):
+        s = _series(ohlcv)["close"]
+        fast = s.ewm(span=12, adjust=False, min_periods=12).mean()
+        slow = s.ewm(span=26, adjust=False, min_periods=26).mean()
+        line_ref = fast - slow
+        sig_ref = line_ref.ewm(span=9, adjust=False, min_periods=9).mean()
+        line, sig, hist = ops.macd(jnp.asarray(ohlcv["close"]))
+        assert_close(line, line_ref, atol=5e-2)
+        assert_close(sig, sig_ref, atol=5e-2, skip=60)
+        assert_close(hist, line_ref - sig_ref, rtol=2e-2, atol=5e-2, skip=60)
+
+    def test_rsi(self, ohlcv):
+        s = _series(ohlcv)["close"]
+        diff = s.diff()
+        up = diff.clip(lower=0)
+        dn = -diff.clip(upper=0)
+        ag = up.ewm(alpha=1 / 14, adjust=False, min_periods=14).mean()
+        al = dn.ewm(alpha=1 / 14, adjust=False, min_periods=14).mean()
+        ref = 100 - 100 / (1 + ag / al)
+        assert_close(ops.rsi(jnp.asarray(ohlcv["close"])), ref, atol=5e-2)
+
+    def test_atr(self, ohlcv):
+        s = _series(ohlcv)
+        h, l, c = s["high"], s["low"], s["close"]
+        pc = c.shift(1)
+        tr = pd.concat([h - l, (h - pc).abs(), (l - pc).abs()], axis=1).max(axis=1)
+        tr[0] = np.nan
+        ref = tr.ewm(alpha=1 / 14, adjust=False, min_periods=14).mean()
+        ours = ops.atr(*(jnp.asarray(ohlcv[k]) for k in ("high", "low", "close")))
+        assert_close(ours, ref, rtol=2e-3, atol=5e-1)
+
+
+class TestOscillators:
+    def test_stochastic(self, ohlcv):
+        s = _series(ohlcv)
+        hh = s["high"].rolling(14).max()
+        ll = s["low"].rolling(14).min()
+        k_ref = 100 * (s["close"] - ll) / (hh - ll)
+        d_ref = k_ref.rolling(3).mean()
+        k, d = ops.stochastic(*(jnp.asarray(ohlcv[x]) for x in ("high", "low", "close")))
+        assert_close(k, k_ref, atol=5e-2)
+        assert_close(d, d_ref, atol=5e-2)
+
+    def test_williams_r(self, ohlcv):
+        s = _series(ohlcv)
+        hh = s["high"].rolling(14).max()
+        ll = s["low"].rolling(14).min()
+        ref = -100 * (hh - s["close"]) / (hh - ll)
+        ours = ops.williams_r(*(jnp.asarray(ohlcv[x]) for x in ("high", "low", "close")))
+        assert_close(ours, ref, atol=5e-2)
+
+    def test_bollinger(self, ohlcv):
+        s = _series(ohlcv)["close"]
+        mid = s.rolling(20).mean()
+        sd = s.rolling(20).std(ddof=0)
+        hi, lo = mid + 2 * sd, mid - 2 * sd
+        bb = ops.bollinger(jnp.asarray(ohlcv["close"]))
+        assert_close(bb.mid, mid)
+        assert_close(bb.high, hi, atol=2e-1)
+        assert_close(bb.low, lo, atol=2e-1)
+        pos_ref = (s - lo) / (hi - lo)
+        assert_close(bb.position, pos_ref, rtol=5e-3, atol=2e-2)
+
+    def test_vwap(self, ohlcv):
+        s = _series(ohlcv)
+        tp = (s["high"] + s["low"] + s["close"]) / 3
+        ref = (tp * s["volume"]).rolling(14).sum() / s["volume"].rolling(14).sum()
+        ours = ops.vwap(*(jnp.asarray(ohlcv[x]) for x in ("high", "low", "close", "volume")))
+        assert_close(ours, ref, rtol=1e-3, atol=5.0)
+
+
+class TestFill:
+    def test_ffill_bfill(self):
+        x = jnp.array([np.nan, 1.0, np.nan, 3.0, np.nan])
+        np.testing.assert_allclose(np.asarray(ops.ffill(x))[1:], [1, 1, 3, 3])
+        assert np.isnan(np.asarray(ops.ffill(x))[0])
+        np.testing.assert_allclose(np.asarray(ops.nanfill(x)), [1, 1, 1, 3, 3])
+
+    def test_all_nan(self):
+        x = jnp.array([np.nan, np.nan])
+        np.testing.assert_allclose(np.asarray(ops.nanfill(x)), [0.0, 0.0])
+
+
+class TestComputeIndicators:
+    def test_shapes_and_no_nans(self, ohlcv):
+        arrays = {k: jnp.asarray(v) for k, v in ohlcv.items() if k != "regime"}
+        out = ops.compute_indicators(arrays)
+        for name in ops.indicators.INDICATOR_NAMES:
+            assert out[name].shape == arrays["close"].shape, name
+            assert not np.isnan(np.asarray(out[name])).any(), name
+
+    def test_vmap_batch(self, ohlcv):
+        import jax
+        arrays = {k: jnp.stack([jnp.asarray(v)[:512]] * 3)
+                  for k, v in ohlcv.items() if k != "regime"}
+        out = jax.vmap(lambda d: ops.compute_indicators(d, fill=True))(arrays)
+        assert out["rsi"].shape == (3, 512)
+        np.testing.assert_allclose(out["rsi"][0], out["rsi"][2])
