@@ -1,0 +1,21 @@
+from ai_crypto_trader_tpu.backtest.signals import (  # noqa: F401
+    SignalFeatures,
+    compute_signal_features,
+    position_size,
+    reference_signal,
+)
+from ai_crypto_trader_tpu.backtest.strategy import (  # noqa: F401
+    PARAM_RANGES,
+    StrategyParams,
+    clamp_params,
+    default_params,
+    sample_params,
+)
+from ai_crypto_trader_tpu.backtest.engine import (  # noqa: F401
+    BacktestStats,
+    prepare_inputs,
+    run_backtest,
+    sweep,
+    sweep_sharded,
+)
+from ai_crypto_trader_tpu.backtest.metrics import compute_metrics  # noqa: F401

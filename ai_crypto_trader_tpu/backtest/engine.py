@@ -1,0 +1,331 @@
+"""The vectorized backtest engine — a `lax.scan` over the candle axis.
+
+TPU-native re-expression of the reference's sequential replay loop
+(`backtesting/strategy_tester.py:190-300`): one Python iteration + one
+OpenAI round-trip per candle becomes one fused scan step over a whole
+population of strategies at once —
+
+    lax.scan   over candles           (inherently sequential position state)
+    vmap       over strategy params   (the GA population / param grids)
+    vmap       over symbols           (portfolio axis)
+    shard_map  over the device mesh   (population sharded over ICI)
+
+The scan carries fixed-size position state (no Python dicts — SURVEY §7.4),
+and the AI gate is an input array of per-candle confidences/decisions, so a
+learned policy, a recorded LLM trace, or the constant technical rule can all
+drive the same compiled program (the LLM itself stays host-side; see
+SURVEY §7.4 "The AI (GPT) gate").
+
+Parity contract (tests/test_backtest_parity.py pins this against a scalar
+Python port of the reference loop):
+  * first `warmup` candles skipped (strategy_tester.py:192),
+  * SL/TP checked against realized pnl% before any open, a position closed
+    at candle t may be re-opened at t (pop → re-entry, lines 202-277),
+  * balance changes only on close — opens don't reserve capital, equity is
+    realized-only (open_position books no debit, lines 314-335),
+  * win = pnl > 0, loss otherwise; profit_factor left 0 when no losses
+    (calculate_final_stats:403-413),
+  * Sharpe = mean/std of per-candle equity returns × √252 with an initial
+    zero return, population std (lines 415-430).
+
+`reference_quirks=True` additionally reproduces the reference's SL/TP unit
+bug: PositionSizer returns fractional stops (0.02) that strategy_tester
+compares against percent PnL (`strategy_tester.py:209` vs
+`binance_ml_strategy.py:260`), firing stops 100× tighter than intended.
+Default False interprets them as percent (the intended 2%).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ai_crypto_trader_tpu.backtest import signals as sig
+from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
+
+
+class BacktestInputs(NamedTuple):
+    """Per-candle arrays consumed by the scan (all shape [T])."""
+
+    close: jnp.ndarray
+    signal: jnp.ndarray        # int32 {-1,0,1}
+    strength: jnp.ndarray      # f32 [0,100]
+    volatility: jnp.ndarray    # ATR/close
+    volume: jnp.ndarray        # avg quote volume
+    confidence: jnp.ndarray    # AI-gate confidence in [0,1]
+    decision: jnp.ndarray      # AI-gate decision int32 {-1,0,1}
+
+
+class CarryState(NamedTuple):
+    balance: jnp.ndarray
+    in_pos: jnp.ndarray        # bool
+    entry: jnp.ndarray
+    qty: jnp.ndarray
+    sl: jnp.ndarray            # stop-loss threshold, percent units
+    tp: jnp.ndarray
+    max_equity: jnp.ndarray
+    max_dd: jnp.ndarray
+    max_dd_pct: jnp.ndarray
+    trades: jnp.ndarray        # i32 closed trades
+    wins: jnp.ndarray
+    total_profit: jnp.ndarray
+    total_loss: jnp.ndarray
+    sum_r: jnp.ndarray         # streaming return moments for Sharpe/Sortino
+    sum_r2: jnp.ndarray
+    sum_neg_r2: jnp.ndarray
+    n_r: jnp.ndarray
+    cur_win_streak: jnp.ndarray
+    cur_loss_streak: jnp.ndarray
+    max_win_streak: jnp.ndarray
+    max_loss_streak: jnp.ndarray
+
+
+class BacktestStats(NamedTuple):
+    """Raw scan outputs; compute_metrics() derives the full metric suite."""
+
+    initial_balance: jnp.ndarray
+    final_balance: jnp.ndarray
+    total_trades: jnp.ndarray
+    winning_trades: jnp.ndarray
+    losing_trades: jnp.ndarray
+    total_profit: jnp.ndarray
+    total_loss: jnp.ndarray
+    max_drawdown: jnp.ndarray
+    max_drawdown_pct: jnp.ndarray
+    sum_r: jnp.ndarray
+    sum_r2: jnp.ndarray
+    sum_neg_r2: jnp.ndarray
+    n_r: jnp.ndarray
+    max_win_streak: jnp.ndarray
+    max_loss_streak: jnp.ndarray
+
+
+@functools.partial(jax.jit, static_argnames=("per_candle_trend",))
+def prepare_inputs(ind: dict, confidence=None, decision=None,
+                   per_candle_trend: bool = True) -> BacktestInputs:
+    """Indicator table → scan inputs. The AI gate defaults to pass-through
+    (confidence 1, decision = technical signal), i.e. the reproducible
+    configuration BASELINE.md prescribes for batch replay."""
+    feats = sig.compute_signal_features(ind, per_candle_trend=per_candle_trend)
+    signal, strength = sig.reference_signal(feats)
+    T = feats.close.shape[-1]
+    if confidence is None:
+        confidence = jnp.ones((T,), jnp.float32)
+    if decision is None:
+        decision = signal
+    return BacktestInputs(
+        close=feats.close, signal=signal, strength=strength,
+        volatility=feats.volatility, volume=feats.volume,
+        confidence=confidence, decision=decision,
+    )
+
+
+def _init_state(initial_balance) -> CarryState:
+    f = lambda v: jnp.asarray(v, jnp.float32)
+    i = lambda v: jnp.asarray(v, jnp.int32)
+    return CarryState(
+        balance=f(initial_balance), in_pos=jnp.asarray(False),
+        entry=f(0.0), qty=f(0.0), sl=f(0.0), tp=f(0.0),
+        max_equity=f(initial_balance), max_dd=f(0.0), max_dd_pct=f(0.0),
+        trades=i(0), wins=i(0), total_profit=f(0.0), total_loss=f(0.0),
+        # n_r starts at 1: the reference's equity curve holds an initial
+        # point whose return is 0 (strategy_tester.py:166-169, 417-423).
+        sum_r=f(0.0), sum_r2=f(0.0), sum_neg_r2=f(0.0), n_r=i(1),
+        cur_win_streak=i(0), cur_loss_streak=i(0),
+        max_win_streak=i(0), max_loss_streak=i(0),
+    )
+
+
+def _book_close(s: CarryState, price, do_close):
+    """Close the open position where do_close — returns updated state."""
+    pnl = (price - s.entry) * s.qty
+    win = pnl > 0.0
+    new_balance = s.balance + jnp.where(do_close, pnl, 0.0)
+    cw = jnp.where(do_close, jnp.where(win, s.cur_win_streak + 1, 0), s.cur_win_streak)
+    cl = jnp.where(do_close, jnp.where(win, 0, s.cur_loss_streak + 1), s.cur_loss_streak)
+    return s._replace(
+        balance=new_balance,
+        in_pos=s.in_pos & ~do_close,
+        trades=s.trades + do_close.astype(jnp.int32),
+        wins=s.wins + (do_close & win).astype(jnp.int32),
+        total_profit=s.total_profit + jnp.where(do_close & win, pnl, 0.0),
+        total_loss=s.total_loss + jnp.where(do_close & ~win, -pnl, 0.0),
+        cur_win_streak=cw, cur_loss_streak=cl,
+        max_win_streak=jnp.maximum(s.max_win_streak, cw),
+        max_loss_streak=jnp.maximum(s.max_loss_streak, cl),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("warmup", "reference_quirks", "use_param_sl_tp",
+                     "return_curve", "unroll"),
+)
+def run_backtest(
+    inputs: BacktestInputs,
+    params: StrategyParams | None = None,
+    initial_balance: float = 10_000.0,
+    ai_confidence_threshold: float = 0.7,
+    min_signal_strength: float = 70.0,
+    warmup: int = 10,
+    reference_quirks: bool = False,
+    use_param_sl_tp: bool = False,
+    return_curve: bool = False,
+    unroll: int = 8,
+):
+    """Run one full backtest as a single compiled scan.
+
+    With ``use_param_sl_tp`` the evolvable StrategyParams stop_loss /
+    take_profit (percent) override the PositionSizer's volatility ladder —
+    this is the mode GA evolution drives.  Batched axes broadcast: vmap this
+    function over params and/or inputs for population/symbol sweeps.
+    """
+    T = inputs.close.shape[-1]
+    steps = jnp.arange(T, dtype=jnp.int32)
+
+    def step(s: CarryState, x):
+        t, close, signal, strength, vol, volume, conf, decision = x
+        active = t >= warmup
+        prev_balance = s.balance
+
+        # --- SL/TP scan on the open position (strategy_tester.py:202-218) ---
+        entry_safe = jnp.where(s.entry == 0.0, 1.0, s.entry)
+        pnl_pct = (close - s.entry) / entry_safe * 100.0
+        hit_sl = active & s.in_pos & (pnl_pct <= -s.sl)
+        hit_tp = active & s.in_pos & ~hit_sl & (pnl_pct >= s.tp)
+        # A position that survives the candle short-circuits the rest of the
+        # loop body (`if symbol in open_positions: continue`,
+        # strategy_tester.py:221-222): no entry attempt, and — reference
+        # semantics — no equity point / drawdown / return observation.
+        survived = s.in_pos & ~(hit_sl | hit_tp)
+        s = _book_close(s, close, hit_sl | hit_tp)
+
+        # --- entry gate (strategy_tester.py:221-277, 371-401) ---
+        gate = (
+            active
+            & ~s.in_pos
+            & (conf >= ai_confidence_threshold)
+            & (strength >= min_signal_strength)
+            & (signal == decision)
+            & (decision == sig.BUY)
+        )
+        plan = sig.position_size(s.balance, vol, volume)
+        if use_param_sl_tp:
+            assert params is not None
+            sl_new = params.stop_loss
+            tp_new = params.take_profit
+            size = plan.size
+        else:
+            unit = 1.0 if reference_quirks else 100.0
+            sl_new = plan.stop_loss_pct * unit
+            tp_new = plan.take_profit_pct * unit
+            size = plan.size
+        s = s._replace(
+            in_pos=s.in_pos | gate,
+            entry=jnp.where(gate, close, s.entry),
+            qty=jnp.where(gate, size / close, s.qty),
+            sl=jnp.where(gate, sl_new, s.sl),
+            tp=jnp.where(gate, tp_new, s.tp),
+        )
+
+        # --- equity point + drawdown (strategy_tester.py:280-300), only on
+        # candles the reference reaches (not short-circuited by `continue`) ---
+        book = active & ~survived
+        equity = s.balance
+        max_eq = jnp.where(book, jnp.maximum(s.max_equity, equity), s.max_equity)
+        dd = max_eq - equity
+        dd_pct = dd / max_eq * 100.0
+        new_max = book & (dd > s.max_dd)
+        r = jnp.where(book, (equity - prev_balance) / prev_balance, 0.0)
+        s = s._replace(
+            max_equity=max_eq,
+            max_dd=jnp.where(new_max, dd, s.max_dd),
+            max_dd_pct=jnp.where(new_max, dd_pct, s.max_dd_pct),
+            sum_r=s.sum_r + r,
+            sum_r2=s.sum_r2 + r * r,
+            sum_neg_r2=s.sum_neg_r2 + jnp.where(r < 0, r * r, 0.0),
+            n_r=s.n_r + book.astype(jnp.int32),
+        )
+        return s, (equity if return_curve else None)
+
+    init = _init_state(initial_balance)
+    xs = (steps,) + tuple(inputs)
+    final, curve = lax.scan(step, init, xs, unroll=unroll)
+
+    # --- close any remaining position at the last price ("End of Test",
+    # strategy_tester.py:302-307) ---
+    final = _book_close(final, inputs.close[-1], final.in_pos)
+
+    stats = BacktestStats(
+        initial_balance=jnp.asarray(initial_balance, jnp.float32),
+        final_balance=final.balance,
+        total_trades=final.trades,
+        winning_trades=final.wins,
+        losing_trades=final.trades - final.wins,
+        total_profit=final.total_profit,
+        total_loss=final.total_loss,
+        max_drawdown=final.max_dd,
+        max_drawdown_pct=final.max_dd_pct,
+        sum_r=final.sum_r,
+        sum_r2=final.sum_r2,
+        sum_neg_r2=final.sum_neg_r2,
+        n_r=final.n_r,
+        max_win_streak=final.max_win_streak,
+        max_loss_streak=final.max_loss_streak,
+    )
+    return (stats, curve) if return_curve else stats
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("warmup", "reference_quirks", "return_curve", "unroll"),
+)
+def sweep(inputs: BacktestInputs, params: StrategyParams,
+          initial_balance: float = 10_000.0,
+          ai_confidence_threshold: float = 0.7,
+          min_signal_strength: float = 70.0,
+          warmup: int = 10, reference_quirks: bool = False,
+          return_curve: bool = False, unroll: int = 8):
+    """vmap the backtester over a stacked StrategyParams population, as ONE
+    compiled program (on the remote-compiled TPU backend, anything outside
+    jit pays an op-by-op compile round-trip — never run this path eagerly).
+
+    This is the inner loop the GA calls; `run_multiple_backtests`'s
+    sequential nested for-loops (`backtest_engine.py:127-178`) become one
+    device program."""
+    fn = lambda p: run_backtest(
+        inputs, p, initial_balance=initial_balance,
+        ai_confidence_threshold=ai_confidence_threshold,
+        min_signal_strength=min_signal_strength, warmup=warmup,
+        reference_quirks=reference_quirks, use_param_sl_tp=True,
+        return_curve=return_curve, unroll=unroll)
+    return jax.vmap(fn)(params)
+
+
+def sweep_sharded(mesh, inputs: BacktestInputs, params: StrategyParams, **kw):
+    """Shard the population over the mesh's data axis.
+
+    The population axis is split across devices; every device runs its shard
+    of strategies over the (replicated) candle array, and results are
+    all-gathered — the ICI collective that replaces the reference's
+    "publish fitness to Redis" (SURVEY §2.7)."""
+    data_axis = mesh.axis_names[0]
+    pspec = P(data_axis)
+
+    def local_sweep(p_shard):
+        return sweep(inputs, p_shard, **kw)
+
+    shard_fn = jax.shard_map(
+        local_sweep,
+        mesh=mesh,
+        in_specs=(pspec,),
+        out_specs=pspec,
+        check_vma=False,
+    )
+    params = jax.device_put(params, NamedSharding(mesh, pspec))
+    return shard_fn(params)

@@ -1,0 +1,148 @@
+"""The evolvable strategy: all 18 GA parameters live in one compiled program.
+
+The reference evolution service mutates parameters that its own backtester
+never consumes (its GA fitness is a heuristic score —
+`strategy_evolution_service.py:542-641`; its CV simulator is a placeholder
+RSI rule — `strategy_evaluation_system.py:358-431`).  Here the full
+parameter vector drives a real backtest:
+
+  periods → dynamic-window kernels (ops/dynamic.py, traced under vmap)
+  thresholds → the vote-based signal rule (same scoring shape as
+               TradingSignal, with parameterized cut-offs)
+  stop_loss / take_profit / atr_multiplier → the scan engine's exit logic
+  social thresholds → votes from (optional) social metric arrays
+
+so GA fitness = real vectorized backtest Sharpe, evaluated for the whole
+population in one vmap and sharded over the mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu.backtest.engine import BacktestInputs, run_backtest
+from ai_crypto_trader_tpu.backtest.strategy import PARAM_RANGES, StrategyParams
+from ai_crypto_trader_tpu.ops import dynamic as dyn
+from ai_crypto_trader_tpu.ops import indicators as ind_ops
+from ai_crypto_trader_tpu.backtest import signals as sig
+
+# Static loop bounds from the parameter ranges (PARAM_RANGES highs).
+WMAX_BB = int(PARAM_RANGES["bollinger_period"][1])      # 30
+WMAX_VOL = int(PARAM_RANGES["volume_ma_period"][1])     # 30
+
+
+class SocialInputs(NamedTuple):
+    """Optional per-candle social metrics (sentiment 0-100, volume,
+    engagement) — the axes the social thresholds gate on."""
+
+    sentiment: jnp.ndarray
+    volume: jnp.ndarray
+    engagement: jnp.ndarray
+
+
+def evolvable_signal(ohlcv: dict, p: StrategyParams,
+                     social: SocialInputs | None = None):
+    """Per-candle (signal ∈ {-1,0,1}, strength ∈ [0,100], volatility) for
+    one parameter vector. vmap over a stacked StrategyParams for the
+    population axis."""
+    close, high, low, volume = (ohlcv[k] for k in ("close", "high", "low", "volume"))
+
+    rsi = ind_ops.nanfill(dyn.rsi_dyn(close, p.rsi_period))
+    macd_line, _, _ = dyn.macd_dyn(close, p.macd_fast, p.macd_slow, p.macd_signal)
+    macd_line = ind_ops.nanfill(macd_line)
+    _, _, _, _, bb_pos = dyn.bollinger_dyn(close, p.bollinger_period,
+                                           p.bollinger_std, WMAX_BB)
+    bb_pos = ind_ops.nanfill(bb_pos)
+    ema_s = ind_ops.nanfill(dyn.ema_dyn(close, p.ema_short))
+    ema_l = ind_ops.nanfill(dyn.ema_dyn(close, p.ema_long))
+    atr = ind_ops.nanfill(dyn.atr_dyn(high, low, close, p.atr_period))
+    vol_ma = ind_ops.nanfill(dyn.rolling_mean_dyn(volume, p.volume_ma_period, WMAX_VOL))
+
+    volatility = atr / close
+    uptrend = ema_s > ema_l
+    downtrend = ema_s < ema_l
+    trend_strength = jnp.abs((ema_s - ema_l) / ema_l * 100.0)
+
+    # --- votes: the TradingSignal scoring shape with evolved thresholds ---
+    votes = jnp.where(rsi < p.rsi_oversold, 3.0,
+                      jnp.where(rsi < p.rsi_oversold + 10.0, 2.0, 0.0))
+    votes += jnp.where(macd_line > 0.0, 2.0, 0.0)
+    votes += jnp.where(bb_pos < 0.2, 3.0, jnp.where(bb_pos < 0.4, 2.0, 0.0))
+    votes += jnp.where(uptrend & (trend_strength > 1.0), 3.0,
+                       jnp.where(uptrend, 2.0, 0.0))
+    votes += jnp.where(volume > vol_ma, 2.0, 0.0)
+    total = 5.0
+
+    if social is not None:
+        s_vote = (
+            (social.sentiment > p.social_sentiment_threshold).astype(jnp.float32)
+            + (social.volume > p.social_volume_threshold).astype(jnp.float32)
+            + (social.engagement > p.social_engagement_threshold).astype(jnp.float32)
+        )
+        votes += jnp.where(s_vote >= 2.0, 3.0, jnp.where(s_vote >= 1.0, 1.0, 0.0))
+        total += 1.0
+
+    overbought = (rsi > p.rsi_overbought) | (bb_pos > 0.8)
+    ratio = votes / (3.0 * total)
+    signal = jnp.where(overbought, sig.SELL,
+                       jnp.where(ratio >= 0.6, sig.BUY,
+                                 jnp.where(ratio <= 0.15, sig.SELL, sig.NEUTRAL)))
+    signal = signal.astype(jnp.int32)
+
+    # --- strength: same weighting scheme as TradingSignal._calculate_strength ---
+    is_buy = signal == sig.BUY
+    rsi_str = jnp.where(is_buy,
+                        (p.rsi_oversold + 10.0 - jnp.minimum(rsi, p.rsi_oversold + 10.0)) / 15.0,
+                        (jnp.maximum(rsi, p.rsi_overbought) - p.rsi_overbought) / 15.0)
+    macd_str = jnp.minimum(jnp.abs(macd_line), 1.0)
+    bb_str = jnp.where(is_buy, jnp.maximum(0.4 - bb_pos, 0.0) / 0.4,
+                       jnp.maximum(bb_pos - 0.6, 0.0) / 0.4)
+    trend_str = jnp.minimum(trend_strength / 5.0, 1.0)
+    aligned = (is_buy & uptrend) | ((signal == sig.SELL) & downtrend)
+    strength = (rsi_str * 30.0 + macd_str * 20.0 + bb_str * 20.0
+                + jnp.where(aligned, trend_str * 15.0, 0.0)
+                + jnp.where(volume > vol_ma, 15.0, 0.0))
+    strength = jnp.where(signal == sig.NEUTRAL, 0.0, jnp.clip(strength, 0.0, 100.0))
+    return signal, strength, volatility
+
+
+def evolvable_inputs(ohlcv: dict, p: StrategyParams,
+                     social: SocialInputs | None = None) -> BacktestInputs:
+    signal, strength, volatility = evolvable_signal(ohlcv, p, social)
+    close = ohlcv["close"]
+    avg_volume = jnp.mean(ohlcv["volume"]) * jnp.mean(close)
+    T = close.shape[-1]
+    return BacktestInputs(
+        close=close, signal=signal, strength=strength, volatility=volatility,
+        volume=jnp.full((T,), avg_volume, jnp.float32),
+        confidence=jnp.ones((T,), jnp.float32),
+        decision=signal,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("min_signal_strength", "warmup"))
+def evolvable_backtest(ohlcv: dict, p: StrategyParams,
+                       initial_balance: float = 10_000.0,
+                       min_signal_strength: float = 50.0,
+                       warmup: int = 10):
+    """Full pipeline for one parameter vector: dynamic indicators → signal →
+    scan backtest with the params' SL/TP. The GA's fitness kernel."""
+    inputs = evolvable_inputs(ohlcv, p)
+    return run_backtest(inputs, p, initial_balance=initial_balance,
+                        min_signal_strength=min_signal_strength,
+                        use_param_sl_tp=True, warmup=warmup)
+
+
+@functools.partial(jax.jit, static_argnames=("min_signal_strength", "warmup"))
+def population_backtest(ohlcv: dict, population: StrategyParams,
+                        initial_balance: float = 10_000.0,
+                        min_signal_strength: float = 50.0, warmup: int = 10):
+    """vmap the full dynamic pipeline over a stacked population (one
+    compiled program — see engine.sweep note on eager dispatch)."""
+    return jax.vmap(lambda p: evolvable_backtest(
+        ohlcv, p, initial_balance=initial_balance,
+        min_signal_strength=min_signal_strength, warmup=warmup))(population)

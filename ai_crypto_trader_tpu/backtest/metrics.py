@@ -1,0 +1,77 @@
+"""Performance-metric suite derived from BacktestStats.
+
+Replicates the reference's metric definitions so numbers are comparable:
+  * win rate / profit factor / Sharpe —
+    `backtesting/strategy_tester.py:403-430` (Sharpe: per-candle equity
+    returns, population std, ×√252, 0 when degenerate; profit factor left
+    at 0 when there are no losing trades — reference behavior, preserved),
+  * Sortino / Calmar / expectancy / recovery / streaks —
+    `services/strategy_evaluation.py:231-319` (StrategyPerformanceMetrics
+    "advanced metrics").
+
+Everything is computed from the streaming moments the scan carries, so the
+full suite costs O(1) per backtest regardless of T, and vmaps trivially.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu.backtest.engine import BacktestStats
+
+
+def compute_metrics(s: BacktestStats, annualization: float = 252.0) -> dict:
+    n = jnp.maximum(s.n_r, 1).astype(jnp.float32)
+    mean_r = s.sum_r / n
+    var_r = jnp.maximum(s.sum_r2 / n - mean_r * mean_r, 0.0)
+    std_r = jnp.sqrt(var_r)
+    sqrt_ann = jnp.sqrt(annualization)
+
+    sharpe = jnp.where(
+        (s.n_r > 1) & (std_r > 0.0), mean_r / jnp.where(std_r > 0, std_r, 1.0) * sqrt_ann, 0.0
+    )
+
+    downside = jnp.sqrt(s.sum_neg_r2 / n)
+    sortino = jnp.where(downside > 0.0, mean_r / jnp.where(downside > 0, downside, 1.0) * sqrt_ann, 0.0)
+
+    total_trades = s.total_trades.astype(jnp.float32)
+    win_rate = jnp.where(s.total_trades > 0, s.winning_trades / jnp.maximum(total_trades, 1.0) * 100.0, 0.0)
+    profit_factor = jnp.where(s.total_loss > 0.0, s.total_profit / jnp.where(s.total_loss > 0, s.total_loss, 1.0), 0.0)
+
+    total_return_pct = (s.final_balance - s.initial_balance) / s.initial_balance * 100.0
+    ann_return_pct = mean_r * annualization * 100.0
+    calmar = jnp.where(s.max_drawdown_pct > 0.0,
+                       ann_return_pct / jnp.where(s.max_drawdown_pct > 0, s.max_drawdown_pct, 1.0), 0.0)
+
+    avg_win = jnp.where(s.winning_trades > 0, s.total_profit / jnp.maximum(s.winning_trades, 1), 0.0)
+    avg_loss = jnp.where(s.losing_trades > 0, s.total_loss / jnp.maximum(s.losing_trades, 1), 0.0)
+    wr = win_rate / 100.0
+    expectancy = wr * avg_win - (1.0 - wr) * avg_loss
+
+    net_profit = s.final_balance - s.initial_balance
+    recovery = jnp.where(s.max_drawdown > 0.0, net_profit / jnp.where(s.max_drawdown > 0, s.max_drawdown, 1.0), 0.0)
+
+    return {
+        "initial_balance": s.initial_balance,
+        "final_balance": s.final_balance,
+        "total_trades": s.total_trades,
+        "winning_trades": s.winning_trades,
+        "losing_trades": s.losing_trades,
+        "win_rate": win_rate,
+        "profit_factor": profit_factor,
+        "total_profit": s.total_profit,
+        "total_loss": s.total_loss,
+        "max_drawdown": s.max_drawdown,
+        "max_drawdown_pct": s.max_drawdown_pct,
+        "sharpe_ratio": sharpe,
+        "sortino_ratio": sortino,
+        "calmar_ratio": calmar,
+        "total_return_pct": total_return_pct,
+        "annualized_return_pct": ann_return_pct,
+        "expectancy": expectancy,
+        "avg_win": avg_win,
+        "avg_loss": avg_loss,
+        "recovery_factor": recovery,
+        "max_win_streak": s.max_win_streak,
+        "max_loss_streak": s.max_loss_streak,
+    }

@@ -1,0 +1,184 @@
+"""Vectorized trading-signal scoring — branch-free TradingSignal parity.
+
+Re-expresses the reference's per-candle if/else trees
+(`binance_ml_strategy.py:470-581` TradingSignal, `:184-203` get_trend,
+`:251-291` PositionSizer) as `jnp.where` arithmetic over whole candle axes,
+so one jit call scores every candle of every symbol at once instead of
+constructing one Python object per candle.
+
+Semantics are kept *exactly*, including the reference's quirks, because the
+golden parity tests (tests/test_backtest_parity.py) diff this code against a
+scalar port of the reference logic:
+
+  * the MACD "strong momentum" branch `macd > 0 and macd > macd * 1.1`
+    (`binance_ml_strategy.py:509`) is unsatisfiable for positive macd —
+    algebraically it requires macd < 0 — so only the +2.0 branch can fire;
+  * `if self.williams_r and ...` / `if self.bb_position and ...` treat an
+    exact 0.0 as "missing" (Python falsiness), so a 0.0 feature contributes
+    no votes; reproduced with explicit != 0 masks;
+  * 'SELL' fires whenever the *buy* vote ratio is ≤ 0.3 — there are no
+    sell-side votes in the reference.
+
+Signals are encoded as int32: +1 BUY, 0 NEUTRAL, -1 SELL.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+BUY, NEUTRAL, SELL = 1, 0, -1
+
+
+class SignalFeatures(NamedTuple):
+    """Per-candle feature set consumed by the signal rule — the array form of
+    the dict built by the reference's prepare_market_data
+    (`backtesting/strategy_tester.py:100-118`)."""
+
+    close: jnp.ndarray
+    rsi: jnp.ndarray
+    stoch_k: jnp.ndarray
+    macd: jnp.ndarray
+    williams_r: jnp.ndarray
+    bb_position: jnp.ndarray
+    trend: jnp.ndarray           # +1 uptrend / 0 sideways / -1 downtrend
+    trend_strength: jnp.ndarray  # percent distance from SMAs
+    volatility: jnp.ndarray      # ATR / close  (get_volatility, line 205-211)
+    volume: jnp.ndarray          # avg volume in quote units (scalar broadcast)
+
+
+def compute_signal_features(ind: dict, per_candle_trend: bool = True) -> SignalFeatures:
+    """Build SignalFeatures from a compute_indicators() output dict.
+
+    ``per_candle_trend=True`` evaluates trend/volatility at every candle
+    (what live mode needs); the reference's backtester froze the final row's
+    values for all candles (`strategy_tester.py:100-118`) — passing False
+    reproduces that for parity testing by broadcasting the last value.
+    """
+    close, sma20, sma50 = ind["close"], ind["sma_20"], ind["sma_50"]
+    up = (close > sma20) & (sma20 > sma50)
+    dn = (close < sma20) & (sma20 < sma50)
+    trend = jnp.where(up, 1, jnp.where(dn, -1, 0)).astype(jnp.int32)
+    strength = jnp.abs(
+        ((close - sma20) / sma20 * 100.0 + (close - sma50) / sma50 * 100.0) / 2.0
+    )
+    vol = ind["atr"] / close
+    avg_volume = jnp.mean(ind["volume"], axis=-1, keepdims=True) * jnp.mean(
+        close, axis=-1, keepdims=True
+    )
+    feats = SignalFeatures(
+        close=close,
+        rsi=ind["rsi"],
+        stoch_k=ind["stoch_k"],
+        macd=ind["macd"],
+        williams_r=ind["williams_r"],
+        bb_position=ind["bb_position"],
+        trend=trend,
+        trend_strength=strength,
+        volatility=vol,
+        volume=jnp.broadcast_to(avg_volume, close.shape),
+    )
+    if not per_candle_trend:
+        last = lambda x: jnp.broadcast_to(x[..., -1:], x.shape)
+        feats = feats._replace(
+            rsi=last(feats.rsi), stoch_k=last(feats.stoch_k),
+            macd=last(feats.macd), williams_r=last(feats.williams_r),
+            bb_position=last(feats.bb_position), trend=last(feats.trend),
+            trend_strength=last(feats.trend_strength),
+            volatility=last(feats.volatility),
+        )
+    return feats
+
+
+def reference_signal(f: SignalFeatures):
+    """TradingSignal._calculate_signal + _calculate_strength, vectorized.
+
+    Returns (signal int32 ∈ {-1,0,1}, strength f32 ∈ [0,100]).
+    Reference: `binance_ml_strategy.py:489-581`.
+    """
+    zero = jnp.zeros_like(f.rsi)
+
+    # --- votes (lines 489-534); 6 voters, 3.0 strong / 2.0 moderate ---
+    buy = jnp.where(f.rsi < 35.0, 3.0, jnp.where(f.rsi < 45.0, 2.0, 0.0))
+    buy += jnp.where(f.stoch_k < 20.0, 3.0, jnp.where(f.stoch_k < 30.0, 2.0, 0.0))
+    # macd>0 and macd>macd*1.1 is unsatisfiable → only the +2 branch exists.
+    buy += jnp.where(f.macd > 0.0, 2.0, 0.0)
+    w_valid = f.williams_r != 0.0  # Python truthiness of the reference
+    buy += jnp.where(w_valid & (f.williams_r < -80.0), 3.0,
+                     jnp.where(w_valid & (f.williams_r < -65.0), 2.0, 0.0))
+    ts_valid = f.trend_strength != 0.0
+    uptrend = f.trend == 1
+    buy += jnp.where(uptrend & ts_valid & (f.trend_strength > 10.0), 3.0,
+                     jnp.where(uptrend & ts_valid & (f.trend_strength > 5.0), 2.0, 0.0))
+    bb_valid = f.bb_position != 0.0
+    buy += jnp.where(bb_valid & (f.bb_position < 0.2), 3.0,
+                     jnp.where(bb_valid & (f.bb_position < 0.4), 2.0, 0.0))
+
+    ratio = buy / 6.0
+    signal = jnp.where(ratio >= 0.6, BUY, jnp.where(ratio <= 0.3, SELL, NEUTRAL))
+    signal = signal.astype(jnp.int32)
+
+    # --- strength (lines 545-581) ---
+    is_buy = signal == BUY
+    is_sell = signal == SELL
+
+    rsi_str = jnp.where(is_buy, (45.0 - jnp.minimum(f.rsi, 45.0)) / 15.0,
+                        (jnp.maximum(f.rsi, 55.0) - 55.0) / 15.0)
+    stoch_str = jnp.where(is_buy, (30.0 - jnp.minimum(f.stoch_k, 30.0)) / 30.0,
+                          (jnp.maximum(f.stoch_k, 70.0) - 70.0) / 30.0)
+    macd_str = jnp.minimum(jnp.abs(f.macd), 1.0)
+    volume_str = jnp.minimum(f.volume / 100_000.0, 1.0)
+    trend_str = jnp.minimum(f.trend_strength / 20.0, 1.0)
+    trend_aligned = (is_buy & (f.trend == 1)) | (is_sell & (f.trend == -1))
+
+    strength = (
+        rsi_str * 30.0
+        + stoch_str * 20.0
+        + macd_str * 20.0
+        + volume_str * 15.0
+        + jnp.where(ts_valid & trend_aligned, trend_str * 15.0, 0.0)
+    )
+    strength = jnp.clip(strength, 0.0, 100.0)
+    strength = jnp.where(signal == NEUTRAL, zero, strength)
+    return signal, strength
+
+
+class PositionPlan(NamedTuple):
+    size: jnp.ndarray            # quote-currency position size
+    stop_loss_pct: jnp.ndarray   # reference units: FRACTION (0.02 = "2%")
+    take_profit_pct: jnp.ndarray
+    trailing_activation: jnp.ndarray
+    trailing_distance: jnp.ndarray
+
+
+def position_size(total_capital, volatility, volume,
+                  max_risk_per_trade: float = 0.15) -> PositionPlan:
+    """PositionSizer.calculate_position_size, vectorized
+    (reference `binance_ml_strategy.py:251-291`).
+
+    Note on units: the reference returns stop_loss_pct as a *fraction*
+    (0.02) but its backtester compares it against a PnL expressed in
+    *percent* (`strategy_tester.py:206-218`), making stops ~100× tighter
+    than intended.  This function reproduces the raw sizer; the engine
+    decides the interpretation via its `reference_quirks` flag.
+    """
+    hi = volatility > 0.02
+    mid = (~hi) & (volatility > 0.01)
+    position_pct = jnp.where(hi, 0.25, jnp.where(mid, 0.20, 0.15))
+    sl = jnp.where(hi, 0.02, jnp.where(mid, 0.015, 0.01))
+
+    volume_factor = jnp.minimum(volume / 50_000.0, 1.0)
+    size = total_capital * position_pct * volume_factor
+    size = jnp.minimum(size, total_capital * max_risk_per_trade / sl)
+    size = jnp.minimum(size, total_capital * 0.20)
+    size = jnp.maximum(size, total_capital * 0.10)
+    size = jnp.maximum(size, 40.0)
+
+    return PositionPlan(
+        size=size,
+        stop_loss_pct=sl,
+        take_profit_pct=sl * 2.0,
+        trailing_activation=sl * 1.5,
+        trailing_distance=sl * 0.75,
+    )

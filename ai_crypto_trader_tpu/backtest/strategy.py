@@ -1,0 +1,113 @@
+"""The evolvable strategy-parameter space.
+
+Mirrors the 18-dimensional parameter space of the reference evolution brain
+(`services/strategy_evolution_service.py:98-117`) as a NamedTuple of f32
+leaves, so a whole GA population is just a stacked StrategyParams with a
+leading population axis — vmap-able through the signal rule and backtester.
+
+The reference *defines* these ranges but never actually backtests them (its
+GA fitness is a heuristic score, `strategy_evolution_service.py:542-641`).
+Here every parameter is live: periods feed the dynamic-window indicator
+kernels (ops/dynamic.py) and thresholds/SL/TP feed the scan backtester, so
+fitness is a real vectorized backtest.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class StrategyParams(NamedTuple):
+    rsi_period: jnp.ndarray
+    rsi_overbought: jnp.ndarray
+    rsi_oversold: jnp.ndarray
+    macd_fast: jnp.ndarray
+    macd_slow: jnp.ndarray
+    macd_signal: jnp.ndarray
+    bollinger_period: jnp.ndarray
+    bollinger_std: jnp.ndarray
+    atr_period: jnp.ndarray
+    atr_multiplier: jnp.ndarray
+    ema_short: jnp.ndarray
+    ema_long: jnp.ndarray
+    volume_ma_period: jnp.ndarray
+    social_sentiment_threshold: jnp.ndarray
+    social_volume_threshold: jnp.ndarray
+    social_engagement_threshold: jnp.ndarray
+    stop_loss: jnp.ndarray      # percent (1 = 1%)
+    take_profit: jnp.ndarray    # percent
+
+
+# (low, high, integer?) per dimension — strategy_evolution_service.py:98-117.
+PARAM_RANGES: dict[str, tuple[float, float, bool]] = {
+    "rsi_period": (5, 30, True),
+    "rsi_overbought": (65, 85, False),
+    "rsi_oversold": (15, 35, False),
+    "macd_fast": (8, 20, True),
+    "macd_slow": (20, 40, True),
+    "macd_signal": (5, 15, True),
+    "bollinger_period": (10, 30, True),
+    "bollinger_std": (1.5, 3.0, False),
+    "atr_period": (7, 25, True),
+    "atr_multiplier": (1.0, 4.0, False),
+    "ema_short": (5, 20, True),
+    "ema_long": (20, 100, True),
+    "volume_ma_period": (5, 30, True),
+    "social_sentiment_threshold": (50, 80, False),
+    "social_volume_threshold": (5_000, 50_000, False),
+    "social_engagement_threshold": (1_000, 20_000, False),
+    "stop_loss": (1.0, 5.0, False),
+    "take_profit": (1.0, 10.0, False),
+}
+
+import numpy as _np
+
+N_PARAMS = len(PARAM_RANGES)
+# Plain NumPy so importing the module never initializes a JAX backend (on
+# this environment an eager jnp constant would grab the single TPU chip).
+_LOWS = _np.asarray([r[0] for r in PARAM_RANGES.values()], _np.float32)
+_HIGHS = _np.asarray([r[1] for r in PARAM_RANGES.values()], _np.float32)
+_IS_INT = _np.asarray([r[2] for r in PARAM_RANGES.values()], bool)
+
+
+def default_params(batch: tuple[int, ...] = ()) -> StrategyParams:
+    """Range midpoints (the reference seeds evolution with current params;
+    midpoints are the neutral starting point)."""
+    mid = (_LOWS + _HIGHS) / 2.0
+    mid = jnp.where(_IS_INT, jnp.round(mid), mid)
+    leaves = [jnp.broadcast_to(m, batch) for m in mid]
+    return StrategyParams(*leaves)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def sample_params(key: jax.Array, n: int) -> StrategyParams:
+    """Uniform population sample within ranges (GA seeding,
+    `services/genetic_algorithm.py:83-117`)."""
+    u = jax.random.uniform(key, (n, N_PARAMS))
+    vals = _LOWS + u * (_HIGHS - _LOWS)
+    vals = jnp.where(_IS_INT, jnp.round(vals), vals)
+    return StrategyParams(*[vals[:, i] for i in range(N_PARAMS)])
+
+
+def clamp_params(p: StrategyParams) -> StrategyParams:
+    """Clamp to ranges + round integer dims (the reference clamps GPT/GA
+    outputs the same way, `strategy_evolution_service.py:excerpt 487-511`)."""
+    leaves = []
+    for i, leaf in enumerate(p):
+        v = jnp.clip(leaf, _LOWS[i], _HIGHS[i])
+        v = jnp.where(_IS_INT[i], jnp.round(v), v)
+        leaves.append(v)
+    return StrategyParams(*leaves)
+
+
+def stack_params(p: StrategyParams) -> jnp.ndarray:
+    """[..., N_PARAMS] matrix view (for GA genome ops)."""
+    return jnp.stack(list(p), axis=-1)
+
+
+def unstack_params(m: jnp.ndarray) -> StrategyParams:
+    return StrategyParams(*[m[..., i] for i in range(N_PARAMS)])

@@ -1,0 +1,8 @@
+from ai_crypto_trader_tpu.evolve.ga import (  # noqa: F401
+    GAState,
+    backtest_fitness,
+    evolve_step,
+    population_diversity,
+    run_ga,
+    run_ga_sharded,
+)

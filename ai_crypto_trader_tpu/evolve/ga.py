@@ -1,0 +1,205 @@
+"""Genetic strategy evolution with *real* backtest fitness, mesh-sharded.
+
+Capability parity with `services/genetic_algorithm.py` (seeded init :83-117,
+elitism + tournament-3 selection :135-161, uniform crossover :163-189,
+int/float mutation :191-223, per-generation history + diversity :293-348) —
+but the two structural flaws of the reference are fixed by design:
+
+  * its fitness evaluation is a **sequential Python loop** over individuals
+    (`genetic_algorithm.py:119-133`) — here the whole population evaluates
+    as one vmapped program, sharded over the mesh data axis with fitness
+    values all-gathered over ICI (replacing "publish fitness to Redis",
+    SURVEY §2.7);
+  * its production fitness is a **heuristic score**, not a backtest
+    (`strategy_evolution_service.py:542-641`) — here fitness is the Sharpe
+    (blended with drawdown/win-rate exactly where the reference's
+    _needs_improvement thresholds look, strategy_evolution_service.py:
+    1571-1582) of a full dynamic-period backtest (backtest/evolvable.py).
+
+Every genetic operator is a pure jitted function of (key, genomes, fitness);
+a generation is one device program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ai_crypto_trader_tpu.backtest.evolvable import evolvable_backtest
+from ai_crypto_trader_tpu.backtest.metrics import compute_metrics
+from ai_crypto_trader_tpu.backtest.strategy import (
+    _HIGHS,
+    _IS_INT,
+    _LOWS,
+    StrategyParams,
+    stack_params,
+    unstack_params,
+)
+from ai_crypto_trader_tpu.config import GAParams
+
+
+class GAState(NamedTuple):
+    genomes: jnp.ndarray      # [pop, n_params]
+    fitness: jnp.ndarray      # [pop]
+    best_genome: jnp.ndarray  # [n_params]
+    best_fitness: jnp.ndarray
+
+
+def population_diversity(genomes: jnp.ndarray) -> jnp.ndarray:
+    """Mean normalized variance across parameter dims
+    (`genetic_algorithm.py:293-348`)."""
+    span = _HIGHS - _LOWS
+    norm = (genomes - _LOWS) / span
+    return jnp.mean(jnp.var(norm, axis=0))
+
+
+def backtest_fitness(ohlcv: dict, *, min_sharpe_weight: float = 1.0,
+                     drawdown_limit: float = 15.0,
+                     win_rate_target: float = 52.0) -> Callable:
+    """Fitness = backtest Sharpe, penalized by the monitoring thresholds the
+    reference's _needs_improvement checks (strategy_evolution_service.py:
+    1571-1582): excess drawdown and win-rate shortfall subtract."""
+
+    def fitness(p: StrategyParams) -> jnp.ndarray:
+        stats = evolvable_backtest(ohlcv, p)
+        m = compute_metrics(stats)
+        dd_pen = jnp.maximum(m["max_drawdown_pct"] - drawdown_limit, 0.0) * 0.05
+        wr_pen = jnp.maximum(win_rate_target - m["win_rate"], 0.0) * 0.01
+        no_trades = (stats.total_trades == 0).astype(jnp.float32)
+        return (min_sharpe_weight * m["sharpe_ratio"] - dd_pen - wr_pen
+                - no_trades * 5.0)
+
+    return fitness
+
+
+def _tournament(key, fitness, k: int, n_picks: int):
+    """[n_picks] winner indices of size-k tournaments
+    (`genetic_algorithm.py:152-161`)."""
+    pop = fitness.shape[0]
+    cand = jax.random.randint(key, (n_picks, k), 0, pop)
+    cand_fit = fitness[cand]
+    return cand[jnp.arange(n_picks), jnp.argmax(cand_fit, axis=1)]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def evolve_step(key, state: GAState, cfg: GAParams) -> GAState:
+    """One generation of selection → crossover → mutation → clamp.
+    Fitness of the new genomes is filled in by the (separately jitted /
+    sharded) evaluation pass — see run_ga."""
+    genomes, fitness = state.genomes, state.fitness
+    pop, n_params = genomes.shape
+    k_sel, k_cross, k_mut, k_scale = jax.random.split(key, 4)
+
+    # Elitism (genetic_algorithm.py:139-146)
+    elite_idx = jnp.argsort(-fitness)[: cfg.elite_size]
+    elites = genomes[elite_idx]
+
+    n_children = pop - cfg.elite_size
+    parents_a = genomes[_tournament(k_sel, fitness, cfg.tournament_size, n_children)]
+    parents_b = genomes[
+        _tournament(jax.random.fold_in(k_sel, 1), fitness, cfg.tournament_size, n_children)
+    ]
+
+    # Uniform crossover (genetic_algorithm.py:163-189)
+    do_cross = jax.random.uniform(k_cross, (n_children, 1)) < cfg.crossover_rate
+    mask = jax.random.bernoulli(jax.random.fold_in(k_cross, 1), 0.5,
+                                (n_children, n_params))
+    children = jnp.where(do_cross & mask, parents_b, parents_a)
+
+    # Gaussian mutation scaled to range; ints re-rounded (:191-223)
+    span = _HIGHS - _LOWS
+    noise = jax.random.normal(k_scale, (n_children, n_params)) * span * cfg.mutation_scale
+    do_mut = jax.random.bernoulli(k_mut, cfg.mutation_rate, (n_children, n_params))
+    children = children + jnp.where(do_mut, noise, 0.0)
+    children = jnp.clip(children, _LOWS, _HIGHS)
+    children = jnp.where(_IS_INT, jnp.round(children), children)
+
+    new_genomes = jnp.concatenate([elites, children], axis=0)
+    return state._replace(genomes=new_genomes)
+
+
+def _update_best(state: GAState) -> GAState:
+    i = jnp.argmax(state.fitness)
+    better = state.fitness[i] > state.best_fitness
+    return state._replace(
+        best_genome=jnp.where(better, state.genomes[i], state.best_genome),
+        best_fitness=jnp.where(better, state.fitness[i], state.best_fitness),
+    )
+
+
+def run_ga(key, fitness_fn: Callable, cfg: GAParams,
+           seed_params: StrategyParams | None = None,
+           eval_fn: Callable | None = None):
+    """GA driver (`genetic_algorithm.py:254-291`): returns (best
+    StrategyParams, history list of per-generation records).
+
+    `eval_fn(genomes) -> fitness` defaults to a vmap of fitness_fn; pass the
+    sharded evaluator from run_ga_sharded for pod execution."""
+    from ai_crypto_trader_tpu.backtest.strategy import sample_params
+
+    if eval_fn is None:
+        eval_fn = jax.jit(
+            lambda g: jax.vmap(lambda row: fitness_fn(unstack_params(row)))(g)
+        )
+
+    k_init, key = jax.random.split(key)
+    genomes = stack_params(sample_params(k_init, cfg.population_size))
+    if seed_params is not None:
+        # Seeded init: individual 0 is the incumbent strategy
+        # (genetic_algorithm.py:92-99).
+        genomes = genomes.at[0].set(stack_params(seed_params))
+
+    fitness = eval_fn(genomes)
+    state = GAState(genomes, fitness, genomes[jnp.argmax(fitness)], jnp.max(fitness))
+    state = _update_best(state)
+
+    history = []
+    for gen in range(cfg.generations):
+        key, k_gen = jax.random.split(key)
+        state = evolve_step(k_gen, state, cfg)
+        state = state._replace(fitness=eval_fn(state.genomes))
+        state = _update_best(state)
+        history.append({
+            "generation": gen,
+            "best_fitness": float(state.best_fitness),
+            "mean_fitness": float(jnp.mean(state.fitness)),
+            "diversity": float(population_diversity(state.genomes)),
+        })
+    return unstack_params(state.best_genome), history
+
+
+def run_ga_sharded(key, mesh, ohlcv: dict, cfg: GAParams,
+                   seed_params: StrategyParams | None = None,
+                   fitness_fn: Callable | None = None):
+    """GA with population evaluation sharded over the mesh data axis.
+
+    Each device backtests its population shard; fitness is all-gathered over
+    ICI by the out_spec (the collective that replaces the reference's
+    sequential evaluate→publish loop). Population size must divide the data
+    axis; GAParams.population_size is padded up if needed."""
+    fitness_fn = fitness_fn or backtest_fitness(ohlcv)
+    data_axis = mesh.axis_names[0]
+    n_dev = mesh.shape[data_axis]
+    pop = ((cfg.population_size + n_dev - 1) // n_dev) * n_dev
+    if pop != cfg.population_size:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, population_size=pop)
+
+    def local_eval(g_shard):
+        return jax.vmap(lambda row: fitness_fn(unstack_params(row)))(g_shard)
+
+    sharded = jax.jit(jax.shard_map(
+        local_eval, mesh=mesh,
+        in_specs=(P(data_axis, None),), out_specs=P(data_axis),
+        check_vma=False,
+    ))
+
+    def eval_fn(genomes):
+        genomes = jax.device_put(genomes, NamedSharding(mesh, P(data_axis, None)))
+        return sharded(genomes)
+
+    return run_ga(key, fitness_fn, cfg, seed_params, eval_fn=eval_fn)

@@ -1,0 +1,182 @@
+"""Monte-Carlo risk engine — GBM & bootstrap path simulation on TPU.
+
+Replaces the compute core of the reference MonteCarloService
+(`services/monte_carlo_service.py:197-394`): its Python for-loop over
+timesteps (GBM step at lines 269-273) and its per-simulation bootstrap loop
+(275-298) become closed-form cumulative-sum programs — a GBM path is just
+`exp(cumsum(log-increments))`, so the whole [paths × days] tensor is one
+fused kernel with no sequential dependency at all.  10k × 30 paths is
+microseconds; the same code scales to millions of paths sharded over the
+mesh data axis.
+
+Statistics (`:314-336`) — percentiles, VaR/CVaR on percent changes,
+probability of profit, per-path max drawdown via running maximum — are all
+computed on-device; drawdown's running max uses an associative cummax scan
+(the reference uses `np.maximum.accumulate` per path in a Python loop).
+
+Scenario handling mirrors config.json:97-103: drift/vol multipliers for
+base / bull / bear / volatile / crab.
+
+Portfolio aggregation ships both flavors:
+  * `portfolio_stats` — the reference's correlation-ignoring weighted sums
+    (`_calculate_portfolio_stats:577-659`), for parity;
+  * `simulate_portfolio_correlated` — joint GBM with a Cholesky factor of
+    the asset return covariance, which the reference explicitly lacks
+    ("Simplified approach - ignores correlations").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import numpy as _np
+
+# NumPy, not jnp: a module-level device constant would initialize the JAX
+# backend (and claim the TPU) at import time.
+PERCENTILES = _np.asarray([1.0, 5.0, 10.0, 25.0, 50.0, 75.0, 90.0, 95.0, 99.0])
+PERIODS_PER_YEAR = 252.0
+
+
+def estimate_mu_sigma(returns: jnp.ndarray, periods_per_year: float = PERIODS_PER_YEAR):
+    """Annualized drift / vol from a per-period return series
+    (`monte_carlo_service.py:236-247`; pandas .std() is ddof=1)."""
+    n = returns.shape[-1]
+    mu = jnp.mean(returns, axis=-1) * periods_per_year
+    sd = jnp.std(returns, axis=-1, ddof=1) if n > 1 else jnp.zeros_like(mu)
+    return mu, sd * jnp.sqrt(periods_per_year)
+
+
+@functools.partial(jax.jit, static_argnames=("days", "num_sims"))
+def simulate_gbm(key, initial_price, mu, sigma, days: int, num_sims: int,
+                 dt: float = 1.0 / PERIODS_PER_YEAR):
+    """GBM paths, shape [num_sims, days]; paths[:, 0] == initial_price.
+
+    Same recursion as the reference timestep loop
+    (`monte_carlo_service.py:266-273`) solved in closed form:
+    S_t = S_0 · exp(Σ ((μ-σ²/2)dt + σ√dt·Z)).
+    """
+    z = jax.random.normal(key, (num_sims, days - 1))
+    inc = (mu - 0.5 * sigma**2) * dt + sigma * jnp.sqrt(dt) * z
+    log_path = jnp.concatenate(
+        [jnp.zeros((num_sims, 1)), jnp.cumsum(inc, axis=-1)], axis=-1
+    )
+    return initial_price * jnp.exp(log_path)
+
+
+@functools.partial(jax.jit, static_argnames=("days", "num_sims", "log_returns"))
+def simulate_bootstrap(key, initial_price, returns, days: int, num_sims: int,
+                       log_returns: bool = True):
+    """Historical bootstrap: resample past returns with replacement
+    (`monte_carlo_service.py:275-298`) — the per-simulation Python loop
+    becomes one gather + cumsum."""
+    idx = jax.random.randint(key, (num_sims, days - 1), 0, returns.shape[-1])
+    sampled = returns[idx]
+    if log_returns:
+        log_inc = sampled
+    else:
+        log_inc = jnp.log1p(sampled)
+    log_path = jnp.concatenate(
+        [jnp.zeros((num_sims, 1)), jnp.cumsum(log_inc, axis=-1)], axis=-1
+    )
+    return initial_price * jnp.exp(log_path)
+
+
+@jax.jit
+def path_statistics(paths, initial_price, confidence: float = 0.95):
+    """Reference result statistics (`monte_carlo_service.py:302-336`),
+    vectorized: VaR/CVaR are on percent changes; |·| applied host-side as
+    the reference does when reporting."""
+    final = paths[:, -1]
+    pct = (final / initial_price - 1.0) * 100.0
+
+    pctl_prices = jnp.percentile(final, PERCENTILES)
+    var_pctl = 100.0 * (1.0 - confidence)
+    var = jnp.percentile(pct, var_pctl)
+    tail = pct <= var
+    cvar = jnp.sum(jnp.where(tail, pct, 0.0)) / jnp.maximum(jnp.sum(tail), 1)
+    prob_profit = jnp.mean((final > initial_price).astype(jnp.float32))
+
+    running_max = lax.associative_scan(jnp.maximum, paths, axis=-1)
+    drawdown = (running_max - paths) / running_max
+    max_dd = jnp.max(drawdown, axis=-1)
+
+    return {
+        "final_prices": final,
+        "pct_changes": pct,
+        "percentile_prices": pctl_prices,
+        "expected_price": jnp.mean(final),
+        "expected_pct_change": jnp.mean(pct),
+        "var": var,
+        "cvar": cvar,
+        "prob_profit": prob_profit,
+        "prob_loss": 1.0 - prob_profit,
+        "max_drawdown_mean": jnp.mean(max_dd),
+        "max_drawdown_median": jnp.median(max_dd),
+        "max_drawdown_max": jnp.max(max_dd),
+    }
+
+
+def run_simulation(key, initial_price, returns, *, days: int = 30,
+                   num_sims: int = 1_000, scenario: str = "base",
+                   scenarios: dict | None = None, method: str = "gbm",
+                   confidence: float = 0.95) -> dict:
+    """Full single-asset simulation: estimate params → apply scenario
+    multipliers → simulate → statistics.  One fused device program.
+
+    `scenarios` maps name → (drift_factor, volatility_factor); defaults to
+    the reference's five (config.json:97-103 via config.MonteCarloParams).
+    """
+    from ai_crypto_trader_tpu.config import MonteCarloParams
+
+    scenarios = scenarios or dict(MonteCarloParams().scenarios)
+    drift_f, vol_f = scenarios[scenario]
+    mu, sigma = estimate_mu_sigma(jnp.asarray(returns))
+    mu, sigma = mu * drift_f, sigma * vol_f
+    if method == "gbm":
+        paths = simulate_gbm(key, initial_price, mu, sigma, days, num_sims)
+    elif method in ("bootstrap", "historical"):
+        paths = simulate_bootstrap(key, initial_price, jnp.asarray(returns), days, num_sims)
+    else:
+        raise ValueError(f"unknown simulation method {method!r}")
+    stats = path_statistics(paths, initial_price, confidence)
+    stats.update({"mu": mu, "sigma": sigma, "scenario": scenario,
+                  "drift_factor": drift_f, "volatility_factor": vol_f,
+                  "paths": paths})
+    return stats
+
+
+@jax.jit
+def portfolio_stats(weights, expected_returns, vars_, cvars):
+    """Reference portfolio aggregation — correlation-ignoring weighted sums
+    (`monte_carlo_service.py:577-659`). All inputs [n_assets] decimals."""
+    return {
+        "expected_return": jnp.sum(weights * expected_returns),
+        "var": jnp.sum(weights * vars_),
+        "cvar": jnp.sum(weights * cvars),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("days", "num_sims"))
+def simulate_portfolio_correlated(key, initial_prices, mus, cov, weights,
+                                  days: int, num_sims: int,
+                                  dt: float = 1.0 / PERIODS_PER_YEAR):
+    """Correlation-aware joint GBM the reference lacks: draw correlated
+    shocks via the Cholesky factor of the annualized return covariance and
+    simulate all assets jointly; portfolio value per path = Σ wᵢ·Sᵢ/Sᵢ₀.
+
+    Returns portfolio relative-value paths [num_sims, days]."""
+    n_assets = initial_prices.shape[0]
+    chol = jnp.linalg.cholesky(cov + 1e-12 * jnp.eye(n_assets))
+    z = jax.random.normal(key, (num_sims, days - 1, n_assets))
+    shocks = jnp.einsum("sdk,ak->sda", z, chol) * jnp.sqrt(dt)
+    sig2 = jnp.diagonal(cov)
+    inc = (mus - 0.5 * sig2) * dt + shocks
+    log_paths = jnp.concatenate(
+        [jnp.zeros((num_sims, 1, n_assets)), jnp.cumsum(inc, axis=1)], axis=1
+    )
+    rel = jnp.exp(log_paths)                      # S_t / S_0 per asset
+    return jnp.einsum("sda,a->sd", rel, weights)  # portfolio relative value

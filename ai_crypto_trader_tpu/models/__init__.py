@@ -1,0 +1,14 @@
+from ai_crypto_trader_tpu.models.zoo import (  # noqa: F401
+    MODEL_REGISTRY,
+    build_model,
+)
+from ai_crypto_trader_tpu.models.train import (  # noqa: F401
+    Scaler,
+    TrainResult,
+    fit_scaler,
+    make_windows,
+    predict_prices,
+    train_model,
+)
+from ai_crypto_trader_tpu.models.hpo import optimize_hyperparameters  # noqa: F401
+from ai_crypto_trader_tpu.models.importance import feature_importance  # noqa: F401

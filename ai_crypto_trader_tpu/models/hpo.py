@@ -1,0 +1,83 @@
+"""Hyperparameter optimization: random search + successive halving.
+
+Replaces the reference's Optuna Bayesian HPO
+(`optimize_hyperparameters`, `services/neural_network_service.py:588-767`:
+20 trials over model_type/units/dropout/lr/batch) with a dependency-free
+random-search + successive-halving (ASHA-style) scheme: all trials start
+with a small epoch budget, the best fraction graduate to the full budget.
+Same search space, same number of full-budget equivalents.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from ai_crypto_trader_tpu.models.train import train_model
+
+SEARCH_SPACE = {
+    # neural_network_service.py:604-640 (Optuna suggest_* calls)
+    "model_type": ("lstm", "gru", "cnn_lstm", "attention", "transformer"),
+    "units": (32, 64, 128),
+    "dropout": (0.1, 0.5),
+    "learning_rate": (1e-4, 1e-2),
+    "batch_size": (16, 32, 64),
+}
+
+
+def _sample_trial(rng: np.random.Generator) -> dict:
+    lo, hi = SEARCH_SPACE["dropout"]
+    llo, lhi = np.log(SEARCH_SPACE["learning_rate"][0]), np.log(SEARCH_SPACE["learning_rate"][1])
+    return {
+        "model_type": rng.choice(SEARCH_SPACE["model_type"]),
+        "units": int(rng.choice(SEARCH_SPACE["units"])),
+        "dropout": float(rng.uniform(lo, hi)),
+        "learning_rate": float(np.exp(rng.uniform(llo, lhi))),
+        "batch_size": int(rng.choice(SEARCH_SPACE["batch_size"])),
+    }
+
+
+def optimize_hyperparameters(
+    key,
+    features: np.ndarray,
+    *,
+    n_trials: int = 20,
+    rung_epochs: Sequence[int] = (5, 20),
+    survivor_fraction: float = 0.3,
+    seq_len: int = 60,
+    seed: int = 0,
+) -> dict:
+    """Returns {"best_params": ..., "best_val_loss": ..., "trials": [...]}."""
+    rng = np.random.default_rng(seed)
+    trials = [_sample_trial(rng) for _ in range(n_trials)]
+    results = []
+
+    # Rung 0: short budget for everyone.
+    for i, t in enumerate(trials):
+        r = train_model(jax.random.fold_in(key, i), features, t["model_type"],
+                        seq_len=seq_len, units=t["units"], dropout=t["dropout"],
+                        learning_rate=t["learning_rate"], batch_size=t["batch_size"],
+                        epochs=rung_epochs[0], early_stopping_patience=rung_epochs[0])
+        results.append({"trial": t, "val_loss": r.best_val_loss, "rung": 0})
+
+    # Survivors graduate to the full budget; the winner is chosen among
+    # full-budget runs only (losses across unequal budgets and fresh inits
+    # are not comparable).
+    order = np.argsort([r["val_loss"] for r in results])
+    n_sur = max(int(np.ceil(n_trials * survivor_fraction)), 1)
+    finalists = []
+    for rank, i in enumerate(order[:n_sur]):
+        t = results[i]["trial"]
+        r = train_model(jax.random.fold_in(key, 10_000 + rank), features,
+                        t["model_type"], seq_len=seq_len, units=t["units"],
+                        dropout=t["dropout"], learning_rate=t["learning_rate"],
+                        batch_size=t["batch_size"], epochs=rung_epochs[-1])
+        rec = {"trial": t, "val_loss": r.best_val_loss, "rung": 1}
+        results[i] = rec
+        finalists.append(rec)
+
+    best = min(finalists, key=lambda r: r["val_loss"])
+    return {"best_params": best["trial"], "best_val_loss": best["val_loss"],
+            "trials": results}

@@ -1,0 +1,221 @@
+"""Training / prediction loop for the model zoo.
+
+Replaces the reference's Keras fit pipeline
+(`services/neural_network_service.py:530-1012`): MinMax scaling + sliding
+windows (:530-586), EarlyStopping / ReduceLROnPlateau / checkpointing
+callbacks (:805-912), and predict + denormalize + confidence (:1090-1219) —
+as pure jitted train/eval steps under optax, with the LR-plateau logic
+implemented via `optax.inject_hyperparams` so the schedule is host-driven
+state, not a callback object.
+
+Multitask horizon losses are weighted 1.0/0.7/0.5
+(`neural_network_service.py:335-344`); the probabilistic head trains on
+Gaussian NLL (:381-391).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ai_crypto_trader_tpu.models.zoo import build_model
+
+MULTITASK_WEIGHTS = (1.0, 0.7, 0.5)
+
+
+class Scaler(NamedTuple):
+    """MinMax scaler state (sklearn MinMaxScaler parity,
+    `neural_network_service.py:541-549`)."""
+
+    min: jnp.ndarray
+    max: jnp.ndarray
+
+    def transform(self, x):
+        rng = self.max - self.min
+        return (x - self.min) / jnp.where(rng == 0.0, 1.0, rng)
+
+    def inverse(self, x, feature: int = 0):
+        rng = self.max - self.min
+        return x * jnp.where(rng[feature] == 0.0, 1.0, rng[feature]) + self.min[feature]
+
+
+def fit_scaler(features: np.ndarray) -> Scaler:
+    return Scaler(jnp.asarray(features.min(axis=0)), jnp.asarray(features.max(axis=0)))
+
+
+def make_windows(features: np.ndarray, seq_len: int = 60,
+                 horizons: Sequence[int] = (1,), target_col: int = 0):
+    """[T, F] → (X [N, seq_len, F], y [N, H]).
+
+    Target = scaled close at t+h (`prepare_training_data`,
+    `neural_network_service.py:558-586`)."""
+    T = features.shape[0]
+    hmax = max(horizons)
+    n = T - seq_len - hmax + 1
+    if n <= 0:
+        raise ValueError(f"series too short: T={T} seq_len={seq_len} hmax={hmax}")
+    idx = np.arange(n)[:, None] + np.arange(seq_len)[None, :]
+    X = features[idx]
+    y = np.stack([features[np.arange(n) + seq_len + h - 1, target_col]
+                  for h in horizons], axis=-1)
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+@dataclass
+class TrainResult:
+    params: Any
+    model_type: str
+    scaler: Scaler
+    model_kwargs: dict = field(default_factory=dict)
+    history: list = field(default_factory=list)
+    best_val_loss: float = float("inf")
+    epochs_run: int = 0
+
+    def model(self):
+        return build_model(self.model_type, **self.model_kwargs)
+
+
+def _loss_fn(out: dict, y: jnp.ndarray, model_type: str) -> jnp.ndarray:
+    if model_type == "probabilistic":
+        mu, log_sigma = out["mean"], out["log_sigma"]
+        # Gaussian NLL — the 3-line replacement for the TFP head.
+        nll = 0.5 * jnp.exp(-2 * log_sigma) * (y - mu) ** 2 + log_sigma
+        return jnp.mean(nll)
+    pred = out["mean"]
+    if pred.shape[-1] > 1:  # multitask
+        w = jnp.asarray(MULTITASK_WEIGHTS[: pred.shape[-1]])
+        return jnp.mean(jnp.mean((pred - y) ** 2, axis=0) * w)
+    return jnp.mean((pred - y) ** 2)
+
+
+def train_model(
+    key,
+    features: np.ndarray,
+    model_type: str = "lstm",
+    *,
+    seq_len: int = 60,
+    horizons: Sequence[int] | None = None,
+    units: int = 64,
+    dropout: float = 0.2,
+    epochs: int = 100,
+    batch_size: int = 32,
+    learning_rate: float = 1e-3,
+    val_fraction: float = 0.2,
+    early_stopping_patience: int = 10,
+    reduce_lr_patience: int = 5,
+    reduce_lr_factor: float = 0.5,
+    min_lr: float = 1e-6,
+    verbose: bool = False,
+) -> TrainResult:
+    """Fit one model; returns params + history + scaler.
+
+    Chronological train/val split (no shuffle across the boundary — the
+    reference shuffles windows, which leaks future data into training; we
+    split first, then shuffle within train)."""
+    if horizons is None:
+        horizons = (1, 3, 5) if model_type == "multitask" else (1,)
+
+    # Leak-free split: the scaler is fit ONLY on the training rows, and
+    # validation windows are exactly those whose targets reach past the
+    # training boundary (the reference fits MinMax on the whole series and
+    # shuffles windows across the split, `neural_network_service.py:530-586`).
+    T = features.shape[0]
+    train_rows = max(T - int(T * val_fraction), seq_len + max(horizons) + 1)
+    scaler = fit_scaler(features[:train_rows])
+    scaled = np.asarray(scaler.transform(jnp.asarray(features)))
+    X, y = make_windows(scaled, seq_len, horizons)
+    hmax = max(horizons)
+    target_row = np.arange(len(X)) + seq_len + hmax - 1
+    is_train = target_row < train_rows
+    X_tr, y_tr = X[is_train], y[is_train]
+    X_val, y_val = X[~is_train], y[~is_train]
+    if len(X_val) == 0:
+        X_val, y_val = X_tr[-1:], y_tr[-1:]
+
+    model_kwargs = dict(units=units, dropout=dropout, horizons=tuple(horizons))
+    model = build_model(model_type, **model_kwargs)
+    k_init, k_drop, key = jax.random.split(key, 3)
+    params = model.init(k_init, jnp.asarray(X[:2]), False)
+
+    tx = optax.inject_hyperparams(optax.adam)(learning_rate=learning_rate)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, xb, yb, rng):
+        def loss(p):
+            out = model.apply(p, xb, True, rngs={"dropout": rng})
+            return _loss_fn(out, yb, model_type)
+
+        l, grads = jax.value_and_grad(loss)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, l
+
+    @jax.jit
+    def eval_loss(params, xb, yb):
+        return _loss_fn(model.apply(params, xb, False), yb, model_type)
+
+    X_val_j, y_val_j = jnp.asarray(X_val), jnp.asarray(y_val)
+    n_batches = max(len(X_tr) // batch_size, 1)
+
+    best = TrainResult(params=params, model_type=model_type, scaler=scaler,
+                       model_kwargs=model_kwargs)
+    patience = lr_patience = 0
+    lr = learning_rate
+
+    for epoch in range(epochs):
+        key, k_shuf, k_ep = jax.random.split(key, 3)
+        perm = np.asarray(jax.random.permutation(k_shuf, len(X_tr)))
+        ep_loss = 0.0
+        for b in range(n_batches):
+            sl = perm[b * batch_size: (b + 1) * batch_size]
+            params, opt_state, l = train_step(
+                params, opt_state, jnp.asarray(X_tr[sl]), jnp.asarray(y_tr[sl]),
+                jax.random.fold_in(k_ep, b))
+            ep_loss += float(l)
+        val_loss = float(eval_loss(params, X_val_j, y_val_j))
+        best.history.append({"epoch": epoch, "loss": ep_loss / n_batches,
+                             "val_loss": val_loss, "lr": lr})
+        if verbose:
+            print(f"epoch {epoch}: loss={ep_loss/n_batches:.5f} val={val_loss:.5f}")
+
+        if val_loss < best.best_val_loss - 1e-7:
+            best.best_val_loss = val_loss
+            best.params = params
+            patience = lr_patience = 0
+        else:
+            patience += 1
+            lr_patience += 1
+            if lr_patience >= reduce_lr_patience and lr > min_lr:
+                lr = max(lr * reduce_lr_factor, min_lr)
+                opt_state.hyperparams["learning_rate"] = jnp.asarray(lr)
+                lr_patience = 0
+            if patience >= early_stopping_patience:
+                break
+    best.epochs_run = epoch + 1
+    return best
+
+
+def predict_prices(result: TrainResult, features: np.ndarray,
+                   seq_len: int = 60, target_col: int = 0) -> dict:
+    """Predict the next price from the trailing window + denormalize +
+    confidence from validation loss (`predict_prices`,
+    `neural_network_service.py:1090-1219`)."""
+    model = result.model()
+    scaled = result.scaler.transform(jnp.asarray(features))
+    window = scaled[-seq_len:][None]
+    out = model.apply(result.params, window, False)
+    mean_scaled = out["mean"][0]
+    price = np.asarray(result.scaler.inverse(mean_scaled, target_col))
+    confidence = float(1.0 / (1.0 + result.best_val_loss * 100.0))
+    res = {"predicted_price": price, "confidence": confidence}
+    if "log_sigma" in out:
+        sigma_scaled = np.exp(np.asarray(out["log_sigma"][0]))
+        rng = np.asarray(result.scaler.max[target_col] - result.scaler.min[target_col])
+        res["predicted_std"] = sigma_scaled * rng
+    return res

@@ -1,0 +1,250 @@
+"""The price-prediction model zoo — flax/linen, XLA:TPU-compiled.
+
+Capability parity with the reference's 8 Keras architectures + ensemble
+(`services/neural_network_service.py:164-485`):
+
+  lstm(:191) gru(:202) bidirectional(:213) cnn_lstm(:224) attention(:236)
+  transformer(:247-306, manual sinusoidal PE + 2 blocks)
+  multitask(:308-353, 3 horizon heads, loss weights 1.0/0.7/0.5)
+  probabilistic(:355-391, Normal head + NLL — TFP replaced by a 3-line
+                log-prob in pure JAX)
+  ensemble(:423-485, LSTM+GRU+CNN branches concatenated)
+
+Design is TPU-first rather than a Keras translation: recurrent layers use
+`flax.linen.RNN` over optimized cells (XLA fuses the scan body onto the
+MXU), all dense/conv work is batched bf16-friendly, and every model exposes
+the same functional signature
+
+    apply(params, x[B, T, F], train=False, rngs=...) -> output
+
+where output is `{"mean": [B,H]}` (H = #horizons, 1 for single-task) plus
+`"log_sigma"` for the probabilistic head.  Losses live in models/train.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+Dtype = Any
+
+
+def sinusoidal_positions(seq_len: int, d_model: int) -> jnp.ndarray:
+    """Sinusoidal positional encoding (the reference builds the same table
+    manually, `neural_network_service.py:252-270`)."""
+    pos = np.arange(seq_len)[:, None]
+    i = np.arange(d_model)[None, :]
+    angle = pos / np.power(10_000.0, (2 * (i // 2)) / d_model)
+    table = np.where(i % 2 == 0, np.sin(angle), np.cos(angle))
+    return jnp.asarray(table, jnp.float32)
+
+
+class RecurrentEncoder(nn.Module):
+    """Stacked LSTM/GRU encoder with inter-layer dropout."""
+
+    units: int = 64
+    num_layers: int = 2
+    dropout: float = 0.2
+    cell: str = "lstm"          # lstm | gru
+    bidirectional: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cell_cls = {"lstm": nn.OptimizedLSTMCell, "gru": nn.GRUCell}[self.cell]
+        for layer in range(self.num_layers):
+            rnn = nn.RNN(cell_cls(self.units), name=f"rnn_{layer}")
+            if self.bidirectional:
+                fwd = rnn(x)
+                bwd = jnp.flip(nn.RNN(cell_cls(self.units), name=f"rnn_b_{layer}")(
+                    jnp.flip(x, axis=1)), axis=1)
+                x = jnp.concatenate([fwd, bwd], axis=-1)
+            else:
+                x = rnn(x)
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return x
+
+
+class SingleHead(nn.Module):
+    """encoder → last hidden state → Dense(1) regression head."""
+
+    encoder: Callable
+    units: int = 64
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = self.encoder(x, train)[:, -1, :]
+        h = nn.Dense(self.units // 2)(h)
+        h = nn.relu(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return {"mean": nn.Dense(1)(h)}
+
+
+class CNNLSTM(nn.Module):
+    """Conv1D feature extraction → max-pool → LSTM
+    (`neural_network_service.py:224-234`)."""
+
+    units: int = 64
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.units, kernel_size=(3,), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, window_shape=(2,), strides=(2,))
+        x = nn.Conv(self.units, kernel_size=(3,), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.units))(x)
+        h = x[:, -1, :]
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return {"mean": nn.Dense(1)(h)}
+
+
+class AttentionModel(nn.Module):
+    """LSTM encoder + multi-head self-attention pooling
+    (`neural_network_service.py:236-245`)."""
+
+    units: int = 64
+    num_heads: int = 4
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = RecurrentEncoder(self.units, 1, self.dropout)(x, train)
+        a = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, qkv_features=self.units,
+            deterministic=not train, dropout_rate=self.dropout)(h, h)
+        h = nn.LayerNorm()(h + a)
+        h = jnp.mean(h, axis=1)
+        return {"mean": nn.Dense(1)(nn.relu(nn.Dense(self.units // 2)(h)))}
+
+
+class TransformerBlock(nn.Module):
+    d_model: int
+    num_heads: int
+    ff_dim: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, qkv_features=self.d_model,
+            deterministic=not train, dropout_rate=self.dropout)(x, x)
+        x = nn.LayerNorm()(x + a)
+        f = nn.Dense(self.ff_dim)(x)
+        f = nn.gelu(f)
+        f = nn.Dense(self.d_model)(f)
+        f = nn.Dropout(self.dropout, deterministic=not train)(f)
+        return nn.LayerNorm()(x + f)
+
+
+class TransformerModel(nn.Module):
+    """Input proj + sinusoidal PE + 2 transformer blocks
+    (`neural_network_service.py:247-306`)."""
+
+    d_model: int = 64
+    num_heads: int = 4
+    num_blocks: int = 2
+    ff_dim: int = 128
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, T, F = x.shape
+        h = nn.Dense(self.d_model)(x)
+        h = h + sinusoidal_positions(T, self.d_model)[None]
+        for _ in range(self.num_blocks):
+            h = TransformerBlock(self.d_model, self.num_heads,
+                                 self.ff_dim, self.dropout)(h, train)
+        h = jnp.mean(h, axis=1)
+        return {"mean": nn.Dense(1)(nn.relu(nn.Dense(self.d_model // 2)(h)))}
+
+
+class MultitaskModel(nn.Module):
+    """Shared encoder + one head per prediction horizon; loss weights
+    1.0/0.7/0.5 applied in train.py (`neural_network_service.py:308-353`)."""
+
+    units: int = 64
+    dropout: float = 0.2
+    horizons: Sequence[int] = (1, 3, 5)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = RecurrentEncoder(self.units, 2, self.dropout)(x, train)[:, -1, :]
+        outs = [nn.Dense(1, name=f"head_h{hz}")(nn.relu(nn.Dense(32)(h)))
+                for hz in self.horizons]
+        return {"mean": jnp.concatenate(outs, axis=-1)}
+
+
+class ProbabilisticModel(nn.Module):
+    """Normal(μ, σ) head trained with NLL — replaces the TFP
+    DistributionLambda (`neural_network_service.py:355-391`)."""
+
+    units: int = 64
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = RecurrentEncoder(self.units, 2, self.dropout)(x, train)[:, -1, :]
+        h = nn.relu(nn.Dense(self.units // 2)(h))
+        mean = nn.Dense(1)(h)
+        log_sigma = jnp.clip(nn.Dense(1)(h), -7.0, 3.0)
+        return {"mean": mean, "log_sigma": log_sigma}
+
+
+class EnsembleModel(nn.Module):
+    """LSTM + GRU + CNN branches, concatenated
+    (`create_ensemble_model`, `neural_network_service.py:423-485`)."""
+
+    units: int = 64
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        lstm = RecurrentEncoder(self.units, 1, self.dropout, "lstm")(x, train)[:, -1]
+        gru = RecurrentEncoder(self.units, 1, self.dropout, "gru")(x, train)[:, -1]
+        c = nn.relu(nn.Conv(self.units, (3,), padding="SAME")(x))
+        c = jnp.mean(c, axis=1)
+        h = jnp.concatenate([lstm, gru, c], axis=-1)
+        h = nn.relu(nn.Dense(self.units)(h))
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return {"mean": nn.Dense(1)(h)}
+
+
+def build_model(model_type: str, units: int = 64, dropout: float = 0.2,
+                num_layers: int = 2, horizons: Sequence[int] = (1, 3, 5)) -> nn.Module:
+    """Factory mirroring `create_model`'s type dispatch
+    (`neural_network_service.py:164-421`)."""
+    mt = model_type.lower()
+    if mt == "lstm":
+        return SingleHead(RecurrentEncoder(units, num_layers, dropout, "lstm"),
+                          units, dropout)
+    if mt == "gru":
+        return SingleHead(RecurrentEncoder(units, num_layers, dropout, "gru"),
+                          units, dropout)
+    if mt == "bidirectional":
+        return SingleHead(
+            RecurrentEncoder(units, num_layers, dropout, "lstm", bidirectional=True),
+            units, dropout)
+    if mt == "cnn_lstm":
+        return CNNLSTM(units, dropout)
+    if mt == "attention":
+        return AttentionModel(units, dropout=dropout)
+    if mt == "transformer":
+        return TransformerModel(d_model=units, dropout=dropout)
+    if mt == "multitask":
+        return MultitaskModel(units, dropout, horizons)
+    if mt == "probabilistic":
+        return ProbabilisticModel(units, dropout)
+    if mt == "ensemble":
+        return EnsembleModel(units, dropout)
+    raise ValueError(f"unknown model type {model_type!r}")
+
+
+MODEL_REGISTRY = ("lstm", "gru", "bidirectional", "cnn_lstm", "attention",
+                  "transformer", "multitask", "probabilistic", "ensemble")

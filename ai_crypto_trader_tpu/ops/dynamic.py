@@ -1,0 +1,128 @@
+"""Dynamic-window indicator kernels — periods as *traced* values.
+
+The reference's evolution service mutates indicator periods
+(`strategy_evolution_service.py:98-117`: rsi_period, macd_fast/slow,
+bollinger_period, ema_short/long, atr_period, volume_ma_period) but never
+backtests them.  Making periods ordinary traced scalars lets one compiled
+program evaluate a whole GA population with *heterogeneous periods* via
+vmap — no per-individual recompilation, no shape polymorphism.
+
+Two machinery classes:
+  * EMA-family (ema/rsi/atr/macd): the smoothing factor α is already a
+    scalar multiplier in the first-order recurrence, so the associative-scan
+    solver in ops.indicators works unchanged with traced α;
+  * hard-window ops (mean/std/max/min): computed as a fori_loop over a
+    static upper bound WMAX of lagged copies, masked to the traced window —
+    O(T·WMAX) VPU work, which XLA keeps in registers/VMEM tiles; WMAX comes
+    from the parameter ranges (≤100 for ema_long, ≤52 otherwise).
+
+Warmup positions (t < window-1) are NaN like the static kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ai_crypto_trader_tpu.ops.indicators import _ewm, first_order_recursion, true_range
+
+
+def _iota(x):
+    return lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+
+
+def _mask_warmup_dyn(y, window):
+    return jnp.where(_iota(y) < window - 1, jnp.nan, y)
+
+
+def _rolling_reduce_dyn(x, window, wmax: int, op, neutral):
+    """Reduce over the trailing `window` (traced, ≤ wmax) positions."""
+    t = _iota(x)
+
+    def body(i, acc):
+        lagged = jnp.roll(x, i, axis=-1)
+        valid = (i < window) & (t >= i)
+        return op(acc, jnp.where(valid, lagged, neutral))
+
+    acc = lax.fori_loop(0, wmax, body, jnp.full_like(x, neutral))
+    return _mask_warmup_dyn(acc, window)
+
+
+def rolling_sum_dyn(x, window, wmax: int):
+    return _rolling_reduce_dyn(jnp.nan_to_num(x), window, wmax, jnp.add, 0.0)
+
+
+def rolling_mean_dyn(x, window, wmax: int):
+    return rolling_sum_dyn(x, window, wmax) / window
+
+
+def rolling_max_dyn(x, window, wmax: int):
+    return _rolling_reduce_dyn(x, window, wmax, jnp.maximum, -jnp.inf)
+
+
+def rolling_min_dyn(x, window, wmax: int):
+    return _rolling_reduce_dyn(x, window, wmax, jnp.minimum, jnp.inf)
+
+
+def rolling_std_dyn(x, window, wmax: int):
+    c = jnp.nanmean(x, axis=-1, keepdims=True)
+    xc = x - c
+    m = rolling_mean_dyn(xc, window, wmax)
+    m2 = rolling_mean_dyn(xc * xc, window, wmax)
+    return jnp.sqrt(jnp.maximum(m2 - m * m, 0.0))
+
+
+def ema_dyn(x, window):
+    """EMA with traced span (pandas ewm(span=w, adjust=False) semantics)."""
+    alpha = 2.0 / (window + 1.0)
+    y = _ewm(x, alpha, start=0)
+    return _mask_warmup_dyn(y, window)
+
+
+def macd_dyn(close, fast, slow, signal):
+    """MACD with traced periods. The signal line seeds where the slow EMA
+    becomes valid, mirroring pandas NaN-skipping (ops.indicators.macd)."""
+    line = ema_dyn(close, fast) - ema_dyn(close, slow)
+    line_filled = jnp.where(jnp.isnan(line), 0.0, line)
+    t = _iota(close)
+    start = jnp.asarray(slow - 1, jnp.float32)
+    alpha = 2.0 / (signal + 1.0)
+    a = jnp.where(t <= start, 0.0, 1.0 - alpha)
+    b = jnp.where(t == start, line_filled,
+                  jnp.where(t < start, 0.0, alpha * line_filled))
+    sig = first_order_recursion(a, b)
+    sig = jnp.where(t < start + signal - 1, jnp.nan, sig)
+    line = _mask_warmup_dyn(line, slow)
+    return line, sig, line - sig
+
+
+def rsi_dyn(close, window):
+    """Wilder RSI with traced period (ops.indicators.rsi with α = 1/w)."""
+    prev = jnp.roll(close, 1, axis=-1)
+    diff = close - prev
+    up = jnp.maximum(diff, 0.0)
+    dn = jnp.maximum(-diff, 0.0)
+    ag = _ewm(up, 1.0 / window, start=1)
+    al = _ewm(dn, 1.0 / window, start=1)
+    r = jnp.where(al == 0.0, jnp.where(ag == 0.0, 50.0, 100.0),
+                  100.0 - 100.0 / (1.0 + ag / jnp.where(al == 0.0, 1.0, al)))
+    return jnp.where(_iota(close) < window, jnp.nan, r)
+
+
+def atr_dyn(high, low, close, window):
+    tr = true_range(high, low, close)
+    y = _ewm(tr, 1.0 / window, start=1)
+    return jnp.where(_iota(close) < window, jnp.nan, y)
+
+
+def bollinger_dyn(close, window, num_std, wmax: int):
+    mid = rolling_mean_dyn(close, window, wmax)
+    sd = rolling_std_dyn(close, window, wmax)
+    hi, lo = mid + num_std * sd, mid - num_std * sd
+    rng = hi - lo
+    pos = (close - lo) / jnp.where(rng == 0.0, jnp.nan, rng)
+    width = rng / mid
+    return hi, mid, lo, width, pos

@@ -1,0 +1,19 @@
+from ai_crypto_trader_tpu.regime.detector import (  # noqa: F401
+    REGIME_NAMES,
+    RegimeDetector,
+    regime_features,
+    rules_regime,
+)
+from ai_crypto_trader_tpu.regime.cluster import (  # noqa: F401
+    gmm_fit,
+    gmm_predict_proba,
+    kmeans_fit,
+    kmeans_predict,
+    pca_fit,
+    standardize_fit,
+)
+from ai_crypto_trader_tpu.regime.hmm import (  # noqa: F401
+    hmm_fit,
+    hmm_posteriors,
+    hmm_viterbi,
+)

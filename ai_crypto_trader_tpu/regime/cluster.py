@@ -1,0 +1,144 @@
+"""Clustering primitives in pure JAX: standardize, PCA, k-means, GMM.
+
+Replaces the sklearn pipeline of the reference MarketRegimeDetector
+(`services/utils/market_regime_detector.py:138-224`: StandardScaler, PCA
+when >5 features, KMeans, GaussianMixture).  EM and Lloyd iterations are
+`lax.scan`s over fixed iteration counts — branch-free, jit-compiled, and
+batched over the sample axis on the VPU/MXU (distance matrices are
+matmuls).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Standardizer(NamedTuple):
+    mean: jnp.ndarray
+    std: jnp.ndarray
+
+    def transform(self, x):
+        return (x - self.mean) / self.std
+
+
+def standardize_fit(x) -> Standardizer:
+    mean = jnp.mean(x, axis=0)
+    std = jnp.std(x, axis=0)
+    return Standardizer(mean, jnp.where(std == 0.0, 1.0, std))
+
+
+class PCA(NamedTuple):
+    components: jnp.ndarray   # [F, K]
+    mean: jnp.ndarray
+
+    def transform(self, x):
+        return (x - self.mean) @ self.components
+
+
+def pca_fit(x, n_components: int) -> PCA:
+    mean = jnp.mean(x, axis=0)
+    xc = x - mean
+    _, _, vt = jnp.linalg.svd(xc, full_matrices=False)
+    return PCA(components=vt[:n_components].T, mean=mean)
+
+
+# ---------------------------------------------------------------------------
+# k-means (Lloyd) with k-means++ style init
+# ---------------------------------------------------------------------------
+
+class KMeans(NamedTuple):
+    centroids: jnp.ndarray    # [K, F]
+
+
+def _sq_dists(x, c):
+    """[N, K] squared distances as a matmul (MXU-friendly)."""
+    return (jnp.sum(x * x, axis=1)[:, None] - 2.0 * x @ c.T
+            + jnp.sum(c * c, axis=1)[None, :])
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def kmeans_fit(key, x, k: int, iters: int = 100) -> KMeans:
+    n = x.shape[0]
+
+    # k-means++ seeding: greedy farthest-point with random first pick.
+    def seed_step(carry, i):
+        cents, key = carry
+        d = jnp.min(_sq_dists(x, cents), axis=1)
+        key, kk = jax.random.split(key)
+        nxt = x[jnp.argmax(d)]
+        cents = cents.at[i].set(nxt)
+        return (cents, key), None
+
+    key, k0 = jax.random.split(key)
+    first = x[jax.random.randint(k0, (), 0, n)]
+    cents0 = jnp.zeros((k, x.shape[1])).at[0].set(first)
+    (cents, _), _ = lax.scan(seed_step, (cents0, key), jnp.arange(1, k))
+
+    def lloyd(carry, _):
+        cents = carry
+        assign = jnp.argmin(_sq_dists(x, cents), axis=1)
+        onehot = jax.nn.one_hot(assign, k)                       # [N, K]
+        counts = jnp.sum(onehot, axis=0)
+        sums = onehot.T @ x                                      # [K, F]
+        new = jnp.where(counts[:, None] > 0,
+                        sums / jnp.maximum(counts[:, None], 1.0), cents)
+        return new, None
+
+    cents, _ = lax.scan(lloyd, cents, None, length=iters)
+    return KMeans(cents)
+
+
+@jax.jit
+def kmeans_predict(model: KMeans, x):
+    return jnp.argmin(_sq_dists(x, model.centroids), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Diagonal-covariance GMM via EM
+# ---------------------------------------------------------------------------
+
+class GMM(NamedTuple):
+    weights: jnp.ndarray   # [K]
+    means: jnp.ndarray     # [K, F]
+    vars: jnp.ndarray      # [K, F] diagonal
+
+
+def _gmm_log_prob(gmm: GMM, x):
+    """[N, K] per-component log densities + log weights."""
+    diff = x[:, None, :] - gmm.means[None]                       # [N, K, F]
+    lp = -0.5 * jnp.sum(diff * diff / gmm.vars[None] + jnp.log(2 * jnp.pi * gmm.vars[None]),
+                        axis=-1)
+    return lp + jnp.log(gmm.weights)[None]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def gmm_fit(key, x, k: int, iters: int = 50, var_floor: float = 1e-4) -> GMM:
+    km = kmeans_fit(key, x, k, iters=20)
+    assign = kmeans_predict(km, x)
+    onehot = jax.nn.one_hot(assign, k)
+    counts = jnp.maximum(jnp.sum(onehot, axis=0), 1.0)
+    means0 = (onehot.T @ x) / counts[:, None]
+    var0 = jnp.maximum(
+        (onehot.T @ (x * x)) / counts[:, None] - means0**2, var_floor)
+    gmm0 = GMM(weights=counts / x.shape[0], means=means0, vars=var0)
+
+    def em(gmm, _):
+        logp = _gmm_log_prob(gmm, x)                             # E-step
+        resp = jax.nn.softmax(logp, axis=1)                      # [N, K]
+        nk = jnp.maximum(jnp.sum(resp, axis=0), 1e-6)            # M-step
+        means = (resp.T @ x) / nk[:, None]
+        var = jnp.maximum((resp.T @ (x * x)) / nk[:, None] - means**2, var_floor)
+        return GMM(weights=nk / x.shape[0], means=means, vars=var), None
+
+    gmm, _ = lax.scan(em, gmm0, None, length=iters)
+    return gmm
+
+
+@jax.jit
+def gmm_predict_proba(gmm: GMM, x):
+    return jax.nn.softmax(_gmm_log_prob(gmm, x), axis=1)

@@ -1,0 +1,198 @@
+"""Market-regime detection: rules + k-means / GMM / HMM, with the
+cluster→regime-name mapping of the reference.
+
+Capability parity with MarketRegimeDetector
+(`services/utils/market_regime_detector.py`):
+  * feature transformers return/volatility/trend-slope/RSI/MACD/BB-width
+    (:64-110) — here computed from the indicator table in one jit;
+  * StandardScaler + PCA when >5 features (:181-188);
+  * kmeans / gmm / hmm backends (:138-224) — pure JAX (regime/cluster.py,
+    regime/hmm.py) instead of sklearn/hmmlearn;
+  * heuristic cluster→regime naming by mean return & volatility rank
+    (:226-296): highest return → bull, lowest → bear, highest vol of the
+    rest → volatile, remainder → ranging;
+  * `detect_regime` → (regime, confidence, probabilities) (:298-455);
+  * rules method (the reference's hybrid mode, config.json "market_regime")
+    as a branch-free threshold classifier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.regime import cluster as cl
+from ai_crypto_trader_tpu.regime import hmm as hmm_mod
+
+REGIME_NAMES = ("bull", "bear", "ranging", "volatile")
+
+
+@jax.jit
+def regime_features(ohlcv: dict, window: int = 20) -> jnp.ndarray:
+    """[T, 6] feature matrix: return, rolling vol, trend slope, RSI, MACD
+    (price-normalized), BB width (`market_regime_detector.py:64-110`)."""
+    close = ohlcv["close"]
+    ret = jnp.diff(jnp.log(close), prepend=jnp.log(close[:1]))
+    vol = ops.nanfill(ops.rolling_std(ret, window))
+    # trend slope: per-candle OLS slope of close over the window, normalized
+    # by price so it is scale-free
+    slope = ops.nanfill(_rolling_slope(close, window)) / close
+    rsi = ops.nanfill(ops.rsi(close)) / 100.0
+    macd_line, _, _ = ops.macd(close)
+    macd_n = ops.nanfill(macd_line) / close
+    bb = ops.bollinger(close)
+    bbw = ops.nanfill(bb.width)
+    return jnp.stack([ret, vol, slope, rsi, macd_n, bbw], axis=-1)
+
+
+def _rolling_slope(x, window: int):
+    """OLS slope of x on t over a trailing window: slope_t =
+    Σᵢ (i - t̄)(x_{t-w+1+i}) / Σᵢ (i - t̄)² — one small convolution with the
+    centered time ramp."""
+    t_mean = (window - 1) / 2.0
+    ss_t = window * (window * window - 1) / 12.0      # Σ (i - t̄)²
+    ramp = jnp.arange(window, dtype=jnp.float32) - t_mean
+    tx = jnp.convolve(x, ramp[::-1], mode="full")[: x.shape[0]]
+    tx = jnp.where(jnp.arange(x.shape[0]) < window - 1, jnp.nan, tx)
+    return tx / ss_t
+
+
+def rules_regime(features: jnp.ndarray, slope_thresh: float = 5e-5,
+                 vol_quantile: float = 0.8) -> jnp.ndarray:
+    """Branch-free threshold rules (the reference's hybrid 'rule' half):
+    high vol → volatile; else slope sign picks bull/bear; flat → ranging.
+    Returns [T] int labels indexing REGIME_NAMES."""
+    vol = features[:, 1]
+    slope = features[:, 2]
+    vol_hi = vol > jnp.quantile(vol, vol_quantile)
+    lbl = jnp.where(vol_hi, 3,
+                    jnp.where(slope > slope_thresh, 0,
+                              jnp.where(slope < -slope_thresh, 1, 2)))
+    return lbl.astype(jnp.int32)
+
+
+def _name_clusters(features: jnp.ndarray, labels: jnp.ndarray, k: int):
+    """Cluster index → regime-name index by return/vol ranking
+    (`market_regime_detector.py:226-296`)."""
+    feats = np.asarray(features)
+    labels = np.asarray(labels)
+    counts = np.array([(labels == c).sum() for c in range(k)])
+    rets = np.array([feats[labels == c, 0].mean() if counts[c] else np.nan
+                     for c in range(k)])
+    vols = np.array([feats[labels == c, 1].mean() if counts[c] else np.nan
+                     for c in range(k)])
+    mapping = np.full(k, 2, dtype=np.int32)          # default ranging
+    occupied = np.where(counts > 0)[0]
+    if len(occupied) == 0:
+        return mapping
+    # Rank only occupied clusters — an empty cluster must never be named
+    # bull/bear or that regime becomes unreachable.
+    bull = int(occupied[np.nanargmax(rets[occupied])])
+    bear = int(occupied[np.nanargmin(rets[occupied])])
+    mapping[bull] = 0
+    if bear != bull:
+        mapping[bear] = 1
+    remaining = [c for c in occupied if c not in (bull, bear)]
+    if remaining:
+        mapping[max(remaining, key=lambda c: vols[c])] = 3   # volatile
+    return mapping
+
+
+@dataclass
+class RegimeDetector:
+    """fit/detect façade over the JAX backends."""
+
+    method: str = "kmeans"      # kmeans | gmm | hmm | rules
+    n_regimes: int = 4
+    pca_components: int = 5
+    seed: int = 0
+    _state: dict = field(default_factory=dict)
+
+    def fit(self, ohlcv: dict) -> "RegimeDetector":
+        feats = regime_features(ohlcv)
+        std = cl.standardize_fit(feats)
+        z = std.transform(feats)
+        if z.shape[1] > self.pca_components:
+            pca = cl.pca_fit(z, self.pca_components)
+            z = pca.transform(z)
+        else:
+            pca = None
+        key = jax.random.PRNGKey(self.seed)
+        if self.method == "kmeans":
+            model = cl.kmeans_fit(key, z, self.n_regimes)
+            labels = cl.kmeans_predict(model, z)
+        elif self.method == "gmm":
+            model = cl.gmm_fit(key, z, self.n_regimes)
+            labels = jnp.argmax(cl.gmm_predict_proba(model, z), axis=1)
+        elif self.method == "hmm":
+            model = hmm_mod.hmm_fit(key, z, self.n_regimes)
+            labels = hmm_mod.hmm_viterbi(model, z)
+        elif self.method == "rules":
+            model, labels = None, rules_regime(feats)
+        else:
+            raise ValueError(f"unknown regime method {self.method!r}")
+        mapping = (np.arange(self.n_regimes, dtype=np.int32)
+                   if self.method == "rules"
+                   else _name_clusters(feats, labels, self.n_regimes))
+        self._state = {"std": std, "pca": pca, "model": model,
+                       "mapping": mapping}
+        return self
+
+    def _project(self, ohlcv: dict):
+        feats = regime_features(ohlcv)
+        z = self._state["std"].transform(feats)
+        if self._state["pca"] is not None:
+            z = self._state["pca"].transform(z)
+        return feats, z
+
+    def detect(self, ohlcv: dict) -> dict:
+        """Regime of the final candle: name, confidence, full probability
+        vector over REGIME_NAMES (`detect_regime`,
+        `market_regime_detector.py:298-455`)."""
+        feats, z = self._project(ohlcv)
+        mapping = self._state["mapping"]
+        probs4 = np.zeros(4, dtype=np.float64)
+        if self.method == "kmeans":
+            model = self._state["model"]
+            d = np.asarray(cl._sq_dists(z[-1:], model.centroids))[0]
+            sim = np.exp(-d / (d.mean() + 1e-9))
+            p = sim / sim.sum()
+            for c, pr in enumerate(p):
+                probs4[mapping[c]] += pr
+        elif self.method == "gmm":
+            p = np.asarray(cl.gmm_predict_proba(self._state["model"], z[-1:]))[0]
+            for c, pr in enumerate(p):
+                probs4[mapping[c]] += pr
+        elif self.method == "hmm":
+            gamma, _ = hmm_mod.hmm_posteriors(self._state["model"], z)
+            p = np.asarray(gamma[-1])
+            for c, pr in enumerate(p):
+                probs4[mapping[c]] += pr
+        else:  # rules
+            lbl = int(np.asarray(rules_regime(feats))[-1])
+            probs4[lbl] = 1.0
+        idx = int(np.argmax(probs4))
+        return {"regime": REGIME_NAMES[idx],
+                "confidence": float(probs4[idx]),
+                "probabilities": {n: float(probs4[i])
+                                  for i, n in enumerate(REGIME_NAMES)}}
+
+    def label_series(self, ohlcv: dict) -> np.ndarray:
+        """Per-candle regime labels (for per-regime strategy performance
+        tracking, `services/market_regime_service.py:637-1062`)."""
+        feats, z = self._project(ohlcv)
+        mapping = self._state["mapping"]
+        if self.method == "kmeans":
+            lbl = np.asarray(cl.kmeans_predict(self._state["model"], z))
+        elif self.method == "gmm":
+            lbl = np.asarray(jnp.argmax(cl.gmm_predict_proba(self._state["model"], z), axis=1))
+        elif self.method == "hmm":
+            lbl = np.asarray(hmm_mod.hmm_viterbi(self._state["model"], z))
+        else:
+            return np.asarray(rules_regime(feats))
+        return mapping[lbl]

@@ -1,0 +1,130 @@
+"""Gaussian HMM in log space: forward-backward + Baum-Welch as scans.
+
+Replaces `hmmlearn.GaussianHMM` (reference
+`services/utils/market_regime_detector.py:150-154`, C implementation) with
+pure JAX: the forward and backward recursions are `lax.scan`s over time with
+logsumexp accumulation (numerically-safe log space — SURVEY §7.4 flags this
+as the touchy part), and Baum-Welch E/M is a fixed-iteration scan, all
+jit-compiled.  Diagonal Gaussian emissions.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.special import logsumexp
+
+
+class HMM(NamedTuple):
+    log_pi: jnp.ndarray     # [K] initial log probs
+    log_A: jnp.ndarray      # [K, K] transition log probs (row = from)
+    means: jnp.ndarray      # [K, F]
+    vars: jnp.ndarray       # [K, F]
+
+
+def _emission_logp(hmm: HMM, x):
+    """[T, K] log N(x_t | mean_k, var_k)."""
+    diff = x[:, None, :] - hmm.means[None]
+    return -0.5 * jnp.sum(diff * diff / hmm.vars[None]
+                          + jnp.log(2 * jnp.pi * hmm.vars[None]), axis=-1)
+
+
+def _forward(hmm: HMM, logb):
+    """Returns (log_alpha [T, K], log-likelihood)."""
+    def step(la, lb_t):
+        la_next = lb_t + logsumexp(la[:, None] + hmm.log_A, axis=0)
+        return la_next, la_next
+
+    la0 = hmm.log_pi + logb[0]
+    _, las = lax.scan(step, la0, logb[1:])
+    log_alpha = jnp.concatenate([la0[None], las], axis=0)
+    return log_alpha, logsumexp(log_alpha[-1])
+
+
+def _backward(hmm: HMM, logb):
+    def step(lb, lb_emit_next):
+        lb_prev = logsumexp(hmm.log_A + (lb_emit_next + lb)[None, :], axis=1)
+        return lb_prev, lb_prev
+
+    lbT = jnp.zeros_like(logb[0])
+    _, lbs = lax.scan(step, lbT, logb[1:][::-1])
+    return jnp.concatenate([lbs[::-1], lbT[None]], axis=0)
+
+
+@jax.jit
+def hmm_posteriors(hmm: HMM, x):
+    """γ_t(k) = P(z_t = k | x_1..T) and the sequence log-likelihood."""
+    logb = _emission_logp(hmm, x)
+    log_alpha, ll = _forward(hmm, logb)
+    log_beta = _backward(hmm, logb)
+    gamma = jax.nn.softmax(log_alpha + log_beta, axis=1)
+    return gamma, ll
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def hmm_fit(key, x, k: int, iters: int = 30, var_floor: float = 1e-4) -> HMM:
+    """Baum-Welch with k-means initialization of emission params."""
+    from ai_crypto_trader_tpu.regime.cluster import kmeans_fit, kmeans_predict
+
+    km = kmeans_fit(key, x, k, iters=20)
+    assign = kmeans_predict(km, x)
+    onehot = jax.nn.one_hot(assign, k)
+    counts = jnp.maximum(jnp.sum(onehot, axis=0), 1.0)
+    means0 = (onehot.T @ x) / counts[:, None]
+    vars0 = jnp.maximum((onehot.T @ (x * x)) / counts[:, None] - means0**2,
+                        var_floor)
+    hmm0 = HMM(
+        log_pi=jnp.log(jnp.full((k,), 1.0 / k)),
+        log_A=jnp.log((jnp.eye(k) * 0.9 + (1 - jnp.eye(k)) * (0.1 / (k - 1)))),
+        means=means0, vars=vars0,
+    )
+
+    def bw(hmm, _):
+        logb = _emission_logp(hmm, x)
+        log_alpha, ll = _forward(hmm, logb)
+        log_beta = _backward(hmm, logb)
+        log_gamma = log_alpha + log_beta
+        gamma = jax.nn.softmax(log_gamma, axis=1)                # [T, K]
+
+        # ξ_t(i,j) ∝ α_t(i) A_ij b_j(t+1) β_{t+1}(j)
+        lx = (log_alpha[:-1, :, None] + hmm.log_A[None]
+              + (logb[1:] + log_beta[1:])[:, None, :])           # [T-1,K,K]
+        xi = jax.nn.softmax(lx.reshape(lx.shape[0], -1), axis=1).reshape(lx.shape)
+
+        new_pi = jnp.log(gamma[0] + 1e-12)
+        trans = jnp.sum(xi, axis=0)
+        new_A = jnp.log(trans / jnp.maximum(jnp.sum(trans, axis=1, keepdims=True), 1e-12) + 1e-12)
+        nk = jnp.maximum(jnp.sum(gamma, axis=0), 1e-6)
+        means = (gamma.T @ x) / nk[:, None]
+        vars_ = jnp.maximum((gamma.T @ (x * x)) / nk[:, None] - means**2, var_floor)
+        return HMM(new_pi, new_A, means, vars_), ll
+
+    hmm, lls = lax.scan(bw, hmm0, None, length=iters)
+    return hmm
+
+
+@jax.jit
+def hmm_viterbi(hmm: HMM, x):
+    """Most-likely state path (argmax decoding)."""
+    logb = _emission_logp(hmm, x)
+
+    def step(delta, lb_t):
+        scores = delta[:, None] + hmm.log_A                      # [K, K]
+        best = jnp.max(scores, axis=0) + lb_t
+        arg = jnp.argmax(scores, axis=0)
+        return best, arg
+
+    d0 = hmm.log_pi + logb[0]
+    dT, args = lax.scan(step, d0, logb[1:])
+
+    def backtrack(state, arg_t):
+        prev = arg_t[state]
+        return prev, prev
+
+    last = jnp.argmax(dT)
+    _, path = lax.scan(backtrack, last, args[::-1])
+    return jnp.concatenate([path[::-1], last[None]])

@@ -1,0 +1,16 @@
+from ai_crypto_trader_tpu.rl.env import (  # noqa: F401
+    EnvParams,
+    EnvState,
+    env_reset,
+    env_step,
+    make_env_params,
+)
+from ai_crypto_trader_tpu.rl.dqn import (  # noqa: F401
+    DQNConfig,
+    DQNState,
+    act,
+    dqn_init,
+    evaluate_policy,
+    train_dqn,
+    train_iteration,
+)

@@ -1,0 +1,122 @@
+"""The vectorized trading environment — the env the reference never shipped.
+
+`services/reinforcement_learning.py:421-503` trains a DQN against a
+gym-style `env.reset()/env.step()` object, but **no environment class exists
+anywhere in the reference repo** (SURVEY §2.3) — the env is implicit.  This
+module supplies it as a pure functional environment over precomputed market
+feature arrays, designed for massive vmap: thousands of independent episodes
+(different start offsets) step in lock-step on one TPU core, Anakin/Podracer
+style (PAPERS.md: "Podracer architectures for scalable RL").
+
+Action space mirrors the reference agent (BUY=0 / HOLD=1 / SELL=2,
+`reinforcement_learning.py:292-318`); long-only single position; reward =
+per-step change in mark-to-market equity (as a fraction of balance), which
+sums to total return over an episode.
+
+Observation (state_size=10, matching RLParams.state_size /
+`reinforcement_learning.py:33-40`):
+  [rsi/100, stoch_k/100, macd(clipped), williams_r/-100, bb_position,
+   volatility, 1-step return, 5-step return, in_position, unrealized_pnl%]
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+BUY, HOLD, SELL = 0, 1, 2
+OBS_SIZE = 10
+
+
+class EnvParams(NamedTuple):
+    close: jnp.ndarray       # [T]
+    obs_table: jnp.ndarray   # [T, OBS_SIZE-2] market features (position
+                             # features are appended dynamically)
+    episode_len: int
+    fee_rate: jnp.ndarray    # taker fee fraction per side
+
+
+class EnvState(NamedTuple):
+    t: jnp.ndarray           # absolute candle index
+    start: jnp.ndarray
+    in_pos: jnp.ndarray      # bool
+    entry: jnp.ndarray
+    balance: jnp.ndarray     # equity in quote units (starts at 1.0)
+
+
+def make_env_params(ind: dict, episode_len: int = 256,
+                    fee_rate: float = 0.0) -> EnvParams:
+    """Build the feature table from a compute_indicators() dict."""
+    close = ind["close"]
+    ret1 = jnp.diff(close, prepend=close[:1]) / close
+    ret5 = (close - jnp.roll(close, 5)) / jnp.roll(close, 5)
+    ret5 = ret5.at[:5].set(0.0) if hasattr(ret5, "at") else ret5
+    obs = jnp.stack([
+        ind["rsi"] / 100.0,
+        ind["stoch_k"] / 100.0,
+        jnp.clip(ind["macd"] / close * 100.0, -1.0, 1.0),
+        ind["williams_r"] / -100.0,
+        ind["bb_position"],
+        ind["atr"] / close,
+        jnp.clip(ret1 * 100.0, -1.0, 1.0),
+        jnp.clip(ret5 * 100.0, -1.0, 1.0),
+    ], axis=-1)
+    return EnvParams(close=close, obs_table=obs.astype(jnp.float32),
+                     episode_len=episode_len,
+                     fee_rate=jnp.asarray(fee_rate, jnp.float32))
+
+
+def _observe(p: EnvParams, s: EnvState) -> jnp.ndarray:
+    market = p.obs_table[s.t]
+    unreal = jnp.where(s.in_pos, (p.close[s.t] - s.entry) / s.entry, 0.0)
+    return jnp.concatenate([
+        market,
+        jnp.stack([s.in_pos.astype(jnp.float32), unreal * 100.0]),
+    ])
+
+
+@functools.partial(jax.jit, static_argnames=())
+def env_reset(p: EnvParams, key) -> tuple[EnvState, jnp.ndarray]:
+    """Random start offset so vmapped episodes decorrelate."""
+    T = p.close.shape[0]
+    start = jax.random.randint(key, (), 0, jnp.maximum(T - p.episode_len - 1, 1))
+    s = EnvState(t=start, start=start, in_pos=jnp.asarray(False),
+                 entry=jnp.asarray(0.0, jnp.float32),
+                 balance=jnp.asarray(1.0, jnp.float32))
+    return s, _observe(p, s)
+
+
+@jax.jit
+def env_step(p: EnvParams, s: EnvState, action) -> tuple[EnvState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(state, action) → (state', obs', reward, done). Pure; vmap over the
+    leading axis of states for parallel envs."""
+    price = p.close[s.t]
+    next_t = s.t + 1
+    next_price = p.close[next_t]
+
+    open_now = (action == BUY) & ~s.in_pos
+    close_now = (action == SELL) & s.in_pos
+
+    entry = jnp.where(open_now, price, s.entry)
+    in_pos = (s.in_pos | open_now) & ~close_now
+
+    # Mark-to-market equity delta over the candle t → t+1 (a SELL exits at
+    # this candle's price, so no further exposure; per-step deltas already
+    # sum to the trade's total return — no realized lump on close, or the
+    # pnl would be double-counted). Fees charged on open/close.
+    exposure = in_pos.astype(jnp.float32)
+    price_ret = (next_price - price) / price
+    fees = (open_now.astype(jnp.float32) + close_now.astype(jnp.float32)) * p.fee_rate
+    reward = exposure * price_ret - fees
+
+    balance = s.balance * (1.0 + reward)
+    # Terminal: episode budget exhausted OR end of data (without the latter,
+    # an episode longer than the series would run forever on a clamped index).
+    done = ((next_t - s.start) >= p.episode_len) | (next_t >= p.close.shape[0] - 1)
+
+    s2 = EnvState(t=next_t, start=s.start, in_pos=in_pos,
+                  entry=entry, balance=balance)
+    return s2, _observe(p, s2), reward, done

@@ -1,0 +1,93 @@
+"""Headline benchmark: vectorized backtest throughput (candles/sec/chip).
+
+BASELINE.md config #1: single-strategy replay on 1 y of 1 m candles,
+widened by vmap over a strategy-param population — the TPU re-expression of
+`backtesting/strategy_tester.py:190-300` (the reference walks candles in a
+Python for-loop; the baseline side is measured here by running a faithful
+scalar port of that loop with the per-candle GPT gate replaced by its
+technical rule, the only reproducible configuration — see BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "candles/s/chip", "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def reference_cpu_candles_per_sec(inputs, n=20_000) -> float:
+    """Faithful scalar port of the reference replay loop (strategy_tester.py
+    :190-300 semantics; see tests/test_backtest_parity.py oracle)."""
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from test_backtest_parity import python_backtest
+
+    args = [np.asarray(x)[:n] for x in inputs]
+    t0 = time.perf_counter()
+    python_backtest(*args)
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ai_crypto_trader_tpu import ops
+    from ai_crypto_trader_tpu.backtest import prepare_inputs, sample_params, sweep
+    from ai_crypto_trader_tpu.data import generate_ohlcv
+
+    T = 525_600           # 1 year of 1-minute candles
+    B = 128               # strategy population width
+    log(f"devices: {jax.devices()}")
+
+    d = generate_ohlcv(n=T, seed=3)
+    arrays = {k: jnp.asarray(v) for k, v in d.items() if k != "regime"}
+
+    # Two staged jit programs (never eager ops on the axon backend — each
+    # eager op is a separate compile; and never one mega-fused graph — XLA
+    # compile time grows superlinearly in the ~70 long associative scans).
+    t0 = time.perf_counter()
+    ind = ops.compute_indicators(arrays)
+    jax.block_until_ready(ind["rsi"])
+    log(f"indicators (incl. compile): {time.perf_counter()-t0:.1f}s")
+    t0 = time.perf_counter()
+    inp = prepare_inputs(ind)
+    jax.block_until_ready(inp.strength)
+    log(f"signal features (incl. compile): {time.perf_counter()-t0:.1f}s")
+
+    params = sample_params(jax.random.PRNGKey(0), B)
+
+    t0 = time.perf_counter()
+    stats = sweep(inp, params, unroll=8)
+    jax.block_until_ready(stats.final_balance)
+    log(f"sweep compile+first run: {time.perf_counter()-t0:.1f}s")
+
+    t0 = time.perf_counter()
+    stats = sweep(inp, params, unroll=8)
+    jax.block_until_ready(stats.final_balance)
+    dt = time.perf_counter() - t0
+    candles_per_sec = T * B / dt
+    log(f"steady-state sweep: {dt:.3f}s → {candles_per_sec:,.0f} candles/s/chip "
+        f"(pop {B} × {T} candles)")
+
+    ref_cps = reference_cpu_candles_per_sec(inp)
+    log(f"reference CPU loop: {ref_cps:,.0f} candles/s")
+
+    print(json.dumps({
+        "metric": "backtest_candles_per_sec_per_chip",
+        "value": round(candles_per_sec, 1),
+        "unit": "candles/s/chip",
+        "vs_baseline": round(candles_per_sec / ref_cps, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,213 @@
+"""Golden parity: the lax.scan backtester vs a scalar Python port of the
+reference replay loop (`backtesting/strategy_tester.py:156-430`), including
+its quirks (equity bookkeeping skipped while a position is held, SL/TP unit
+mismatch, profit-factor-0-when-no-losses)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.backtest import (
+    compute_metrics,
+    compute_signal_features,
+    prepare_inputs,
+    reference_signal,
+    run_backtest,
+    sample_params,
+    sweep,
+    sweep_sharded,
+)
+
+
+# ---------------------------------------------------------------------------
+# Scalar port of the reference loop (the oracle)
+# ---------------------------------------------------------------------------
+
+def python_position_size(capital, vol, volume, max_risk=0.15):
+    if vol > 0.02:
+        pct, sl = 0.25, 0.02
+    elif vol > 0.01:
+        pct, sl = 0.20, 0.015
+    else:
+        pct, sl = 0.15, 0.01
+    vf = min(volume / 50_000.0, 1.0)
+    size = capital * pct * vf
+    size = min(size, capital * max_risk / sl)
+    size = min(size, capital * 0.20)
+    size = max(size, capital * 0.10)
+    size = max(size, 40.0)
+    return size, sl, sl * 2.0
+
+
+def python_backtest(close, signal, strength, vol, volume, conf, decision,
+                    initial=10_000.0, warmup=10, thresh=0.7, min_strength=70.0,
+                    quirks=False, param_sl=None, param_tp=None):
+    balance = initial
+    in_pos = False
+    entry = qty = sl = tp = 0.0
+    max_eq, max_dd, max_dd_pct = initial, 0.0, 0.0
+    trades = wins = 0
+    tot_p = tot_l = 0.0
+    returns = [0.0]
+    cw = cl = mw = ml = 0
+
+    def close_pos(price):
+        nonlocal balance, trades, wins, tot_p, tot_l, in_pos, cw, cl, mw, ml
+        pnl = (price - entry) * qty
+        balance += pnl
+        trades += 1
+        if pnl > 0:
+            wins += 1
+            tot_p += pnl
+            cw += 1; cl = 0
+        else:
+            tot_l -= pnl
+            cl += 1; cw = 0
+        mw, ml = max(mw, cw), max(ml, cl)
+        in_pos = False
+
+    T = len(close)
+    for t in range(T):
+        if t < warmup:
+            continue
+        price = float(close[t])
+        prev = balance
+        if in_pos:
+            pnl_pct = (price - entry) / entry * 100.0
+            if pnl_pct <= -sl:
+                close_pos(price)
+            elif pnl_pct >= tp:
+                close_pos(price)
+            else:
+                continue  # strategy_tester.py:221-222 — skips bookkeeping
+        if (not in_pos and conf[t] >= thresh and strength[t] >= min_strength
+                and signal[t] == decision[t] and decision[t] == 1):
+            size, sl_frac, tp_frac = python_position_size(balance, float(vol[t]), float(volume[t]))
+            entry, qty = price, size / price
+            if param_sl is not None:
+                sl, tp = param_sl, param_tp
+            else:
+                unit = 1.0 if quirks else 100.0
+                sl, tp = sl_frac * unit, tp_frac * unit
+            in_pos = True
+        returns.append((balance - prev) / prev)
+        if balance > max_eq:
+            max_eq = balance
+        dd = max_eq - balance
+        ddp = dd / max_eq * 100.0
+        if dd > max_dd:
+            max_dd, max_dd_pct = dd, ddp
+    if in_pos:
+        close_pos(float(close[-1]))
+
+    r = np.asarray(returns)
+    sharpe = 0.0
+    if len(r) > 1 and r.std() > 0:
+        sharpe = r.mean() / r.std() * np.sqrt(252)
+    return dict(final_balance=balance, total_trades=trades, winning_trades=wins,
+                total_profit=tot_p, total_loss=tot_l, max_drawdown=max_dd,
+                max_drawdown_pct=max_dd_pct, sharpe_ratio=sharpe, n_r=len(r),
+                max_win_streak=mw, max_loss_streak=ml)
+
+
+def _inputs(ohlcv, n=2048, per_candle=True):
+    arrays = {k: jnp.asarray(v[:n]) for k, v in ohlcv.items() if k != "regime"}
+    ind = ops.compute_indicators(arrays)
+    return prepare_inputs(ind, per_candle_trend=per_candle)
+
+
+def _assert_parity(stats, oracle, metrics):
+    assert int(stats.total_trades) == oracle["total_trades"]
+    assert int(stats.winning_trades) == oracle["winning_trades"]
+    assert int(stats.n_r) == oracle["n_r"]
+    np.testing.assert_allclose(float(stats.final_balance), oracle["final_balance"], rtol=1e-4)
+    np.testing.assert_allclose(float(stats.total_profit), oracle["total_profit"], rtol=1e-3, atol=1e-2)
+    np.testing.assert_allclose(float(stats.total_loss), oracle["total_loss"], rtol=1e-3, atol=1e-2)
+    np.testing.assert_allclose(float(stats.max_drawdown), oracle["max_drawdown"], rtol=1e-3, atol=1e-2)
+    np.testing.assert_allclose(float(metrics["sharpe_ratio"]), oracle["sharpe_ratio"], rtol=5e-2, atol=5e-3)
+    assert int(stats.max_win_streak) == oracle["max_win_streak"]
+    assert int(stats.max_loss_streak) == oracle["max_loss_streak"]
+
+
+class TestParity:
+    @pytest.mark.parametrize("quirks", [False, True])
+    def test_vs_python_oracle(self, ohlcv, quirks):
+        inp = _inputs(ohlcv)
+        args = [np.asarray(x) for x in inp]
+        oracle = python_backtest(*args, quirks=quirks)
+        assert oracle["total_trades"] > 0, "test vectors must actually trade"
+        stats = run_backtest(inp, reference_quirks=quirks)
+        _assert_parity(stats, oracle, compute_metrics(stats))
+
+    def test_param_sl_tp_mode(self, ohlcv):
+        from ai_crypto_trader_tpu.backtest import default_params
+        inp = _inputs(ohlcv)
+        p = default_params()
+        args = [np.asarray(x) for x in inp]
+        oracle = python_backtest(*args, param_sl=float(p.stop_loss), param_tp=float(p.take_profit))
+        stats = run_backtest(inp, p, use_param_sl_tp=True)
+        _assert_parity(stats, oracle, compute_metrics(stats))
+
+    def test_frozen_features_mode(self, ohlcv):
+        """per_candle_trend=False reproduces the reference's frozen last-row
+        features (strategy_tester.py:100-118)."""
+        inp = _inputs(ohlcv, per_candle=False)
+        sigs = np.asarray(inp.signal)
+        assert (sigs == sigs[-1]).all()  # frozen → constant signal
+
+
+class TestSweep:
+    def test_vmap_matches_individual(self, ohlcv):
+        inp = _inputs(ohlcv, n=1024)
+        params = sample_params(jax.random.PRNGKey(0), 8)
+        batch = sweep(inp, params)
+        for i in [0, 3, 7]:
+            single = run_backtest(inp, jax.tree.map(lambda x: x[i], params),
+                                  use_param_sl_tp=True)
+            np.testing.assert_allclose(float(batch.final_balance[i]),
+                                       float(single.final_balance), rtol=1e-6)
+            assert int(batch.total_trades[i]) == int(single.total_trades)
+
+    def test_shard_map_matches_vmap(self, ohlcv, mesh8):
+        inp = _inputs(ohlcv, n=512)
+        params = sample_params(jax.random.PRNGKey(1), 16)  # 2 per device
+        plain = sweep(inp, params)
+        sharded = sweep_sharded(mesh8, inp, params)
+        np.testing.assert_allclose(np.asarray(plain.final_balance),
+                                   np.asarray(sharded.final_balance), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(plain.total_trades),
+                                      np.asarray(sharded.total_trades))
+
+
+class TestSignalRule:
+    def test_scalar_oracle(self, ohlcv):
+        """reference_signal vs a direct scalar port of TradingSignal."""
+        arrays = {k: jnp.asarray(v[:512]) for k, v in ohlcv.items() if k != "regime"}
+        ind = ops.compute_indicators(arrays)
+        feats = compute_signal_features(ind)
+        signal, strength = reference_signal(feats)
+        f = {k: np.asarray(v) for k, v in feats._asdict().items()}
+        for t in range(250, 300):
+            buy = 0.0
+            rsi, st, mac = f["rsi"][t], f["stoch_k"][t], f["macd"][t]
+            wr, bb = f["williams_r"][t], f["bb_position"][t]
+            tr, ts = f["trend"][t], f["trend_strength"][t]
+            if rsi < 35: buy += 3
+            elif rsi < 45: buy += 2
+            if st < 20: buy += 3
+            elif st < 30: buy += 2
+            if mac > 0 and mac > mac * 1.1: buy += 3
+            elif mac > 0: buy += 2
+            if wr and wr < -80: buy += 3
+            elif wr and wr < -65: buy += 2
+            if tr == 1 and ts and ts > 10: buy += 3
+            elif tr == 1 and ts and ts > 5: buy += 2
+            if bb and bb < 0.2: buy += 3
+            elif bb and bb < 0.4: buy += 2
+            ratio = buy / 6
+            exp = 1 if ratio >= 0.6 else (-1 if ratio <= 0.3 else 0)
+            assert int(signal[t]) == exp, (t, ratio)
+            if exp == 0:
+                assert float(strength[t]) == 0.0

@@ -1,0 +1,120 @@
+"""Dynamic-window kernels, evolvable strategy pipeline, and GA evolution."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.ops import dynamic as dyn
+from ai_crypto_trader_tpu.backtest import default_params, sample_params
+from ai_crypto_trader_tpu.backtest.evolvable import (
+    evolvable_backtest,
+    evolvable_signal,
+    population_backtest,
+)
+from ai_crypto_trader_tpu.config import GAParams
+from ai_crypto_trader_tpu.evolve import (
+    backtest_fitness,
+    population_diversity,
+    run_ga,
+    run_ga_sharded,
+)
+
+
+def _arrays(ohlcv, n=512):
+    return {k: jnp.asarray(v[:n]) for k, v in ohlcv.items() if k != "regime"}
+
+
+class TestDynamicKernels:
+    """Traced-window kernels must agree with the static golden kernels when
+    the window matches."""
+
+    def test_rolling_mean(self, ohlcv):
+        x = jnp.asarray(ohlcv["close"][:512])
+        a = dyn.rolling_mean_dyn(x, jnp.asarray(20.0), 30)
+        b = ops.rolling_mean(x, 20)
+        np.testing.assert_allclose(np.nan_to_num(a), np.nan_to_num(b), rtol=1e-5)
+
+    def test_rolling_max_min(self, ohlcv):
+        x = jnp.asarray(ohlcv["high"][:512])
+        np.testing.assert_allclose(
+            np.nan_to_num(dyn.rolling_max_dyn(x, jnp.asarray(14.0), 30)),
+            np.nan_to_num(ops.rolling_max(x, 14)), rtol=1e-6)
+
+    def test_ema(self, ohlcv):
+        x = jnp.asarray(ohlcv["close"][:512])
+        a = dyn.ema_dyn(x, jnp.asarray(12.0))
+        b = ops.ema(x, 12, min_periods=1)
+        mask = ~np.isnan(np.asarray(a))
+        np.testing.assert_allclose(np.asarray(a)[mask], np.asarray(b)[mask], rtol=1e-4)
+
+    def test_rsi(self, ohlcv):
+        x = jnp.asarray(ohlcv["close"][:512])
+        a, b = dyn.rsi_dyn(x, jnp.asarray(14.0)), ops.rsi(x, 14)
+        m = ~(np.isnan(np.asarray(a)) | np.isnan(np.asarray(b)))
+        np.testing.assert_allclose(np.asarray(a)[m], np.asarray(b)[m], rtol=1e-3, atol=1e-2)
+
+    def test_vmap_over_windows(self, ohlcv):
+        """The point of it all: heterogeneous periods in one program."""
+        x = jnp.asarray(ohlcv["close"][:256])
+        ws = jnp.asarray([5.0, 10.0, 20.0])
+        out = jax.vmap(lambda w: dyn.rolling_mean_dyn(x, w, 30))(ws)
+        assert out.shape == (3, 256)
+        np.testing.assert_allclose(np.nan_to_num(out[2]),
+                                   np.nan_to_num(ops.rolling_mean(x, 20)), rtol=1e-5)
+
+
+class TestEvolvable:
+    def test_signal_shapes(self, ohlcv):
+        arr = _arrays(ohlcv)
+        p = default_params()
+        signal, strength, vol = evolvable_signal(arr, p)
+        assert signal.shape == arr["close"].shape
+        assert set(np.unique(np.asarray(signal))) <= {-1, 0, 1}
+        assert float(strength.max()) <= 100.0
+
+    def test_backtest_runs_and_trades(self, ohlcv):
+        arr = _arrays(ohlcv, n=1024)
+        stats = evolvable_backtest(arr, default_params())
+        assert np.isfinite(float(stats.final_balance))
+
+    def test_population_batch(self, ohlcv):
+        arr = _arrays(ohlcv)
+        pop = sample_params(jax.random.PRNGKey(0), 4)
+        stats = population_backtest(arr, pop)
+        assert stats.final_balance.shape == (4,)
+        # different params should mostly produce different outcomes
+        assert len(np.unique(np.asarray(stats.final_balance))) > 1
+
+
+class TestGA:
+    CFG = GAParams(population_size=8, generations=3, elite_size=2)
+
+    def test_improves_and_records(self, ohlcv):
+        arr = _arrays(ohlcv)
+        fit = backtest_fitness(arr)
+        best, hist = run_ga(jax.random.PRNGKey(0), fit, self.CFG,
+                            seed_params=default_params())
+        assert len(hist) == 3
+        assert hist[-1]["best_fitness"] >= hist[0]["best_fitness"] - 1e-6
+        assert 0.0 <= hist[-1]["diversity"] <= 1.0
+        # best params respect ranges
+        from ai_crypto_trader_tpu.backtest.strategy import PARAM_RANGES
+        for name, (lo, hi, _) in PARAM_RANGES.items():
+            v = float(getattr(best, name))
+            assert lo - 1e-6 <= v <= hi + 1e-6, name
+
+    def test_elite_preserved(self, ohlcv):
+        """Best fitness can never decrease across generations (elitism)."""
+        arr = _arrays(ohlcv)
+        best, hist = run_ga(jax.random.PRNGKey(1), backtest_fitness(arr), self.CFG)
+        bf = [h["best_fitness"] for h in hist]
+        assert all(b2 >= b1 - 1e-6 for b1, b2 in zip(bf, bf[1:]))
+
+    def test_sharded_matches_structure(self, ohlcv, mesh8):
+        arr = _arrays(ohlcv, n=256)
+        cfg = GAParams(population_size=8, generations=2, elite_size=2)
+        best, hist = run_ga_sharded(jax.random.PRNGKey(2), mesh8, arr, cfg)
+        assert len(hist) == 2
+        assert np.isfinite(hist[-1]["best_fitness"])

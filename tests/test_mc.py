@@ -1,0 +1,86 @@
+"""Monte-Carlo engine tests: statistical correctness of GBM/bootstrap and
+parity of the statistics block with a NumPy re-computation (the formulas of
+`services/monte_carlo_service.py:302-336`)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu import mc
+
+
+KEY = jax.random.PRNGKey(42)
+
+
+class TestGBM:
+    def test_shape_and_initial(self):
+        paths = mc.simulate_gbm(KEY, 100.0, 0.05, 0.3, days=30, num_sims=512)
+        assert paths.shape == (512, 30)
+        np.testing.assert_allclose(np.asarray(paths[:, 0]), 100.0)
+
+    def test_terminal_mean(self):
+        # E[S_T] = S0 * exp(mu * T) for GBM
+        days, n = 252, 20_000
+        paths = mc.simulate_gbm(KEY, 100.0, 0.10, 0.2, days=days, num_sims=n)
+        t_years = (days - 1) / 252.0
+        expected = 100.0 * np.exp(0.10 * t_years)
+        got = float(jnp.mean(paths[:, -1]))
+        assert abs(got - expected) / expected < 0.02
+
+    def test_zero_vol_is_deterministic(self):
+        paths = mc.simulate_gbm(KEY, 100.0, 0.10, 0.0, days=10, num_sims=4)
+        np.testing.assert_allclose(np.asarray(paths[0]), np.asarray(paths[3]))
+
+
+class TestBootstrap:
+    def test_resamples_historical(self):
+        rets = jnp.asarray(np.float32([0.01, -0.02, 0.005, 0.03, -0.01]))
+        paths = mc.simulate_bootstrap(KEY, 50.0, rets, days=20, num_sims=256)
+        assert paths.shape == (256, 20)
+        step_rets = np.diff(np.log(np.asarray(paths)), axis=1)
+        # every step return must be one of the historical log returns
+        assert np.isin(step_rets.round(5), np.asarray(rets).round(5)).mean() > 0.999
+
+
+class TestStatistics:
+    def test_against_numpy_oracle(self):
+        paths = mc.simulate_gbm(KEY, 100.0, 0.05, 0.5, days=30, num_sims=2_000)
+        stats = {k: np.asarray(v) for k, v in mc.path_statistics(paths, 100.0).items()}
+        p = np.asarray(paths)
+        final = p[:, -1]
+        pct = (final / 100.0 - 1) * 100
+        np.testing.assert_allclose(stats["var"], np.percentile(pct, 5), rtol=1e-3)
+        cvar_ref = pct[pct <= np.percentile(pct, 5)].mean()
+        np.testing.assert_allclose(stats["cvar"], cvar_ref, rtol=5e-3)
+        np.testing.assert_allclose(stats["prob_profit"], (final > 100).mean(), atol=1e-6)
+        rm = np.maximum.accumulate(p, axis=1)
+        dd = ((rm - p) / rm).max(axis=1)
+        np.testing.assert_allclose(stats["max_drawdown_mean"], dd.mean(), rtol=1e-4)
+        assert stats["cvar"] <= stats["var"] + 1e-6
+
+    def test_run_simulation_scenarios(self, rng):
+        rets = rng.normal(0.0005, 0.02, 500).astype(np.float32)
+        out_base = mc.run_simulation(KEY, 100.0, rets, days=30, num_sims=500, scenario="base")
+        out_vol = mc.run_simulation(KEY, 100.0, rets, days=30, num_sims=500, scenario="volatile")
+        assert float(out_vol["sigma"]) > float(out_base["sigma"]) * 1.9
+        out_bear = mc.run_simulation(KEY, 100.0, rets, days=30, num_sims=500, scenario="bear")
+        assert float(out_bear["mu"]) == -float(out_base["mu"])
+
+
+class TestPortfolio:
+    def test_weighted_sums(self):
+        w = jnp.asarray([0.5, 0.3, 0.2])
+        er = jnp.asarray([0.10, 0.05, -0.02])
+        v = jnp.asarray([0.08, 0.12, 0.2])
+        cv = jnp.asarray([0.1, 0.15, 0.25])
+        out = mc.portfolio_stats(w, er, v, cv)
+        np.testing.assert_allclose(float(out["expected_return"]), 0.061, rtol=1e-5)
+
+    def test_correlated_joint(self):
+        n_assets = 3
+        cov = np.array([[0.04, 0.01, 0.0], [0.01, 0.09, 0.02], [0.0, 0.02, 0.16]], np.float32)
+        out = mc.simulate_portfolio_correlated(
+            KEY, jnp.ones(n_assets) * 100.0, jnp.asarray([0.05, 0.03, 0.08]),
+            jnp.asarray(cov), jnp.asarray([0.4, 0.4, 0.2]), days=30, num_sims=256)
+        assert out.shape == (256, 30)
+        np.testing.assert_allclose(np.asarray(out[:, 0]), 1.0, rtol=1e-5)

@@ -1,0 +1,136 @@
+"""Model zoo: forward shapes for all 9 architectures, training convergence,
+prediction, HPO, and feature importance."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu.models import (
+    MODEL_REGISTRY,
+    build_model,
+    feature_importance,
+    fit_scaler,
+    make_windows,
+    optimize_hyperparameters,
+    predict_prices,
+    train_model,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _features(n=300, f=4, seed=0):
+    rng = np.random.default_rng(seed)
+    t = np.arange(n)
+    base = 100 + 10 * np.sin(t / 20) + rng.normal(0, 0.5, n)
+    cols = [base] + [rng.normal(0, 1, n) for _ in range(f - 1)]
+    return np.stack(cols, axis=1).astype(np.float32)
+
+
+class TestZoo:
+    @pytest.mark.parametrize("mt", MODEL_REGISTRY)
+    def test_forward_shapes(self, mt):
+        model = build_model(mt, units=16)
+        x = jnp.zeros((2, 20, 4))
+        params = model.init(KEY, x, False)
+        out = model.apply(params, x, False)
+        expected_h = 3 if mt == "multitask" else 1
+        assert out["mean"].shape == (2, expected_h)
+        if mt == "probabilistic":
+            assert out["log_sigma"].shape == (2, 1)
+
+    def test_dropout_only_in_train(self):
+        model = build_model("lstm", units=16, dropout=0.5)
+        x = jnp.ones((2, 20, 4))
+        params = model.init(KEY, x, False)
+        a = model.apply(params, x, False)
+        b = model.apply(params, x, False)
+        np.testing.assert_allclose(np.asarray(a["mean"]), np.asarray(b["mean"]))
+        c = model.apply(params, x, True, rngs={"dropout": KEY})
+        assert not np.allclose(np.asarray(a["mean"]), np.asarray(c["mean"]))
+
+
+class TestWindows:
+    def test_shapes_and_targets(self):
+        f = _features(100)
+        X, y = make_windows(f, seq_len=10, horizons=(1, 3))
+        assert X.shape == (88, 10, 4) and y.shape == (88, 2)
+        np.testing.assert_allclose(y[0, 0], f[10, 0])
+        np.testing.assert_allclose(y[0, 1], f[12, 0])
+
+    def test_too_short_raises(self):
+        with pytest.raises(ValueError):
+            make_windows(_features(10), seq_len=20)
+
+    def test_scaler_roundtrip(self):
+        f = _features(50)
+        s = fit_scaler(f)
+        scaled = s.transform(jnp.asarray(f))
+        assert float(scaled.min()) >= 0 and float(scaled.max()) <= 1.0001
+        back = s.inverse(scaled[:, 0], 0)
+        np.testing.assert_allclose(np.asarray(back), f[:, 0], rtol=1e-5)
+
+
+class TestTraining:
+    def test_loss_decreases_and_early_stops(self):
+        f = _features(250)
+        r = train_model(KEY, f, "lstm", seq_len=16, units=16, epochs=12,
+                        batch_size=32, early_stopping_patience=12)
+        losses = [h["loss"] for h in r.history]
+        assert losses[-1] < losses[0]
+        assert r.best_val_loss < np.inf
+        out = predict_prices(r, f, seq_len=16)
+        assert np.isfinite(out["predicted_price"]).all()
+        assert 0.0 < out["confidence"] <= 1.0
+
+    def test_multitask_and_probabilistic(self):
+        f = _features(200)
+        r = train_model(KEY, f, "multitask", seq_len=16, units=16, epochs=2)
+        assert np.isfinite(r.best_val_loss)
+        r = train_model(KEY, f, "probabilistic", seq_len=16, units=16, epochs=2)
+        out = predict_prices(r, f, seq_len=16)
+        assert "predicted_std" in out and float(out["predicted_std"]) > 0
+
+    def test_scaler_fit_excludes_validation_rows(self):
+        """No look-ahead: a price spike confined to the val tail must not
+        influence the scaler."""
+        f = _features(200)
+        f[-20:, 0] += 1000.0  # future-only spike
+        r = train_model(KEY, f, "lstm", seq_len=16, units=8, epochs=1,
+                        val_fraction=0.2)
+        train_rows = 200 - int(200 * 0.2)
+        assert float(r.scaler.max[0]) <= f[:train_rows, 0].max() + 1e-3
+
+    def test_lr_plateau_reduces(self):
+        f = _features(150)
+        r = train_model(KEY, f, "lstm", seq_len=16, units=8, epochs=15,
+                        reduce_lr_patience=1, early_stopping_patience=15,
+                        learning_rate=1e-3)
+        lrs = [h["lr"] for h in r.history]
+        assert min(lrs) <= max(lrs)  # monotone non-increasing schedule
+        assert all(b <= a + 1e-12 for a, b in zip(lrs, lrs[1:]))
+
+
+class TestHPO:
+    def test_two_trials(self):
+        f = _features(150)
+        out = optimize_hyperparameters(KEY, f, n_trials=2, rung_epochs=(1, 2),
+                                       seq_len=16)
+        assert len(out["trials"]) == 2
+        assert np.isfinite(out["best_val_loss"])
+        assert out["best_params"]["model_type"] in MODEL_REGISTRY
+
+
+class TestImportance:
+    def test_sums_to_one_and_ranks(self):
+        f = _features(120)
+        r = train_model(KEY, f, "lstm", seq_len=16, units=8, epochs=2)
+        s = r.scaler.transform(jnp.asarray(f))
+        X, _ = make_windows(np.asarray(s), seq_len=16)
+        out = feature_importance(r.params, "lstm", jnp.asarray(X[:16]),
+                                 feature_names=["close", "a", "b", "c"],
+                                 model_kwargs=r.model_kwargs)
+        w = np.asarray(list(out["importances"].values()))
+        np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-4)
+        assert out["ranked"][0] in {"close", "a", "b", "c"}

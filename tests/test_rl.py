@@ -1,0 +1,134 @@
+"""RL: env semantics (long-only position accounting, episode structure) and
+DQN training machinery (replay ring, target sync, ε decay, learning)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.rl import (
+    DQNConfig,
+    dqn_init,
+    env_reset,
+    env_step,
+    evaluate_policy,
+    make_env_params,
+    train_dqn,
+    train_iteration,
+)
+from ai_crypto_trader_tpu.rl.env import BUY, HOLD, SELL
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _env_params(ohlcv, n=512, episode_len=64, fee=0.0):
+    arrays = {k: jnp.asarray(v[:n]) for k, v in ohlcv.items() if k != "regime"}
+    ind = ops.compute_indicators(arrays)
+    return make_env_params(ind, episode_len=episode_len, fee_rate=fee)
+
+
+class TestEnv:
+    def test_reset_obs_shape(self, ohlcv):
+        p = _env_params(ohlcv)
+        s, obs = env_reset(p, KEY)
+        assert obs.shape == (10,)
+        assert not bool(s.in_pos)
+        np.testing.assert_allclose(float(s.balance), 1.0)
+
+    def test_buy_hold_sell_accounting(self, ohlcv):
+        p = _env_params(ohlcv)
+        s, _ = env_reset(p, KEY)
+        t0 = int(s.t)
+        s, _, r1, _ = env_step(p, s, jnp.asarray(BUY))
+        assert bool(s.in_pos)
+        price_ret = (float(p.close[t0 + 1]) - float(p.close[t0])) / float(p.close[t0])
+        np.testing.assert_allclose(float(r1), price_ret, rtol=1e-5)
+        s, _, r2, _ = env_step(p, s, jnp.asarray(SELL))
+        assert not bool(s.in_pos)
+        np.testing.assert_allclose(float(r2), 0.0, atol=1e-7)  # exited at t+1 price
+
+    def test_hold_when_flat_gives_zero(self, ohlcv):
+        p = _env_params(ohlcv)
+        s, _ = env_reset(p, KEY)
+        for _ in range(3):
+            s, _, r, _ = env_step(p, s, jnp.asarray(HOLD))
+            np.testing.assert_allclose(float(r), 0.0, atol=1e-7)
+        np.testing.assert_allclose(float(s.balance), 1.0, rtol=1e-6)
+
+    def test_fees_charged(self, ohlcv):
+        p = _env_params(ohlcv, fee=0.001)
+        s, _ = env_reset(p, KEY)
+        _, _, r_fee, _ = env_step(p, s, jnp.asarray(BUY))
+        p0 = _env_params(ohlcv, fee=0.0)
+        s0, _ = env_reset(p0, KEY)
+        _, _, r_nofee, _ = env_step(p0, s0, jnp.asarray(BUY))
+        np.testing.assert_allclose(float(r_nofee) - float(r_fee), 0.001, rtol=1e-4)
+
+    def test_done_at_episode_end(self, ohlcv):
+        p = _env_params(ohlcv, episode_len=5)
+        s, _ = env_reset(p, KEY)
+        done = False
+        for i in range(5):
+            s, _, _, done = env_step(p, s, jnp.asarray(HOLD))
+        assert bool(done)
+
+    def test_episode_longer_than_series_terminates(self, ohlcv):
+        p = _env_params(ohlcv, n=40, episode_len=500)
+        s, _ = env_reset(p, KEY)
+        done = False
+        for _ in range(45):
+            s, _, _, done = env_step(p, s, jnp.asarray(HOLD))
+            if bool(done):
+                break
+        assert bool(done), "episode must terminate at end of data"
+        assert int(s.t) <= 40
+
+    def test_vmapped_envs_independent(self, ohlcv):
+        p = _env_params(ohlcv)
+        keys = jax.random.split(KEY, 8)
+        states, obs = jax.vmap(lambda k: env_reset(p, k))(keys)
+        assert obs.shape == (8, 10)
+        assert len(np.unique(np.asarray(states.t))) > 1  # different offsets
+
+
+class TestDQN:
+    CFG = DQNConfig(num_envs=8, replay_capacity=512, batch_size=16,
+                    rollout_len=4, learn_steps_per_iter=2,
+                    target_sync_every=3)
+
+    def test_init_shapes(self, ohlcv):
+        p = _env_params(ohlcv)
+        st = dqn_init(KEY, p, self.CFG)
+        assert st.obs.shape == (8, 10)
+        assert int(st.replay.size) == 0
+
+    def test_iteration_fills_replay_and_learns(self, ohlcv):
+        p = _env_params(ohlcv)
+        st = dqn_init(KEY, p, self.CFG)
+        st2, m = train_iteration(p, st, self.CFG)
+        assert int(st2.replay.size) == 32  # 4 steps × 8 envs
+        assert int(st2.learn_steps) == 2
+        assert float(st2.epsilon) < float(st.epsilon)
+        assert np.isfinite(float(m["loss"]))
+        # params actually updated
+        leaf0 = jax.tree.leaves(st.params)[0]
+        leaf2 = jax.tree.leaves(st2.params)[0]
+        assert not np.allclose(np.asarray(leaf0), np.asarray(leaf2))
+
+    def test_target_sync_happens(self, ohlcv):
+        p = _env_params(ohlcv)
+        st = dqn_init(KEY, p, self.CFG)
+        # after 2 iterations learn_steps=4 ≥ sync interval 3 → target != init
+        for _ in range(2):
+            st, _ = train_iteration(p, st, self.CFG)
+        t0 = jax.tree.leaves(st.target_params)[0]
+        pr = jax.tree.leaves(st.params)[0]
+        init = jax.tree.leaves(dqn_init(KEY, p, self.CFG).target_params)[0]
+        assert not np.allclose(np.asarray(t0), np.asarray(init))
+
+    def test_train_and_evaluate(self, ohlcv):
+        p = _env_params(ohlcv)
+        st, hist = train_dqn(KEY, p, self.CFG, iterations=3)
+        assert np.isfinite(hist[-1]["loss"])
+        out = evaluate_policy(p, st.params, self.CFG, KEY, n_steps=32)
+        assert np.isfinite(float(out["mean_balance"]))
