@@ -1,0 +1,13 @@
+from ai_crypto_trader_tpu.patterns.synthetic import (  # noqa: F401
+    PATTERN_CLASSES,
+    generate_dataset,
+    generate_pattern,
+)
+from ai_crypto_trader_tpu.patterns.model import (  # noqa: F401
+    PATTERN_IMPLICATIONS,
+    PatternRecognizer,
+    detect_patterns,
+    pattern_completion,
+    preprocess_window,
+    train_pattern_model,
+)

@@ -1,0 +1,164 @@
+"""Synthetic chart-pattern generators — the training-data source.
+
+The reference's only in-repo training data for its pattern classifier is a
+set of synthetic shape generators
+(`services/utils/pattern_recognition.py:813-1039`: head & shoulders, double
+top/bottom, triangles, rectangle, cup & handle).  This module regenerates
+all **14 pattern families + no_pattern** (the reference draws only 9 of its
+15 classes; the missing flags/pennant/wedges are added here so every class
+is trainable), as pure jax.random functions that vmap into whole datasets
+in one call.
+
+Each generator returns a [T] close-price path; `to_ohlcv` dresses it into
+the [T, 5] OHLCV windows the classifier consumes (normalized per the
+reference's preprocess: OHLC ÷ last close, volume ÷ max —
+`pattern_recognition.py:336-374`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+PATTERN_CLASSES = (
+    "head_and_shoulders", "inverse_head_and_shoulders",
+    "double_top", "double_bottom",
+    "ascending_triangle", "descending_triangle", "symmetric_triangle",
+    "rectangle", "flag_bull", "flag_bear",
+    "pennant", "cup_and_handle", "rising_wedge", "falling_wedge",
+    "no_pattern",
+)
+N_CLASSES = len(PATTERN_CLASSES)
+
+
+def _bump(t, center, width, height):
+    """Smooth gaussian bump."""
+    return height * jnp.exp(-0.5 * ((t - center) / width) ** 2)
+
+
+def _noise(key, T, level):
+    return jax.random.normal(key, (T,)) * level
+
+
+def _path(key, T, base, shape_fn):
+    k_amp, k_noise, k_lvl = jax.random.split(key, 3)
+    amp = 8.0 + 6.0 * jax.random.uniform(k_amp)
+    noise = (0.3 + 0.7 * jax.random.uniform(k_lvl)) * 0.35
+    t = jnp.linspace(0.0, 1.0, T)
+    return base + amp * shape_fn(t) + _noise(k_noise, T, noise)
+
+
+def _head_shoulders(t, sign):
+    return sign * (_bump(t, 0.2, 0.07, 0.6) + _bump(t, 0.5, 0.08, 1.0)
+                   + _bump(t, 0.8, 0.07, 0.6))
+
+
+def _double(t, sign):
+    return sign * (_bump(t, 0.3, 0.08, 1.0) + _bump(t, 0.7, 0.08, 1.0))
+
+
+def _triangle(t, kind):
+    osc = jnp.sin(t * 6 * jnp.pi)
+    if kind == "ascending":
+        env_hi, env_lo = 1.0, 1.0 - t        # flat top, rising lows
+        return jnp.where(osc > 0, osc * 0.2, osc) * env_lo * 0.5 + t * 0.5
+    if kind == "descending":
+        return jnp.where(osc < 0, osc * 0.2, osc) * (1.0 - t) * 0.5 - t * 0.5
+    return osc * (1.0 - t) * 0.5             # symmetric: shrinking envelope
+
+
+def _rectangle(t):
+    return 0.5 * jnp.sin(t * 8 * jnp.pi)
+
+
+def _flag(t, sign):
+    """Sharp pole then a counter-trend consolidation channel."""
+    pole = jnp.clip(t / 0.3, 0.0, 1.0) * sign
+    channel = jnp.where(t > 0.3, -sign * (t - 0.3) * 0.3
+                        + 0.08 * jnp.sin((t - 0.3) * 20 * jnp.pi), 0.0)
+    return pole + channel
+
+
+def _pennant(t):
+    pole = jnp.clip(t / 0.3, 0.0, 1.0)
+    flagpart = jnp.where(t > 0.3, jnp.sin((t - 0.3) * 16 * jnp.pi)
+                         * jnp.maximum(1.0 - (t - 0.3) / 0.7, 0.0) * 0.25, 0.0)
+    return pole + flagpart
+
+
+def _cup_handle(t):
+    cup = -_bump(t, 0.4, 0.2, 1.0)
+    handle = -_bump(t, 0.85, 0.05, 0.3)
+    return cup + handle
+
+
+def _wedge(t, rising):
+    sign = 1.0 if rising else -1.0
+    drift = sign * t * 0.8
+    osc = jnp.sin(t * 8 * jnp.pi) * (0.5 - 0.4 * t)   # converging envelope
+    return drift + osc
+
+
+def _no_pattern(key, T, base):
+    k1, k2 = jax.random.split(key)
+    steps = jax.random.normal(k1, (T,)) * 0.5
+    return base + jnp.cumsum(steps) + _noise(k2, T, 0.3)
+
+
+@functools.partial(jax.jit, static_argnames=("label", "T"))
+def generate_pattern(key, label: int, T: int = 60, base: float = 100.0):
+    """One synthetic close path for class index `label`."""
+    name = PATTERN_CLASSES[label]
+    if name == "no_pattern":
+        return _no_pattern(key, T, base)
+    shape = {
+        "head_and_shoulders": lambda t: _head_shoulders(t, 1.0),
+        "inverse_head_and_shoulders": lambda t: _head_shoulders(t, -1.0),
+        "double_top": lambda t: _double(t, 1.0),
+        "double_bottom": lambda t: _double(t, -1.0),
+        "ascending_triangle": lambda t: _triangle(t, "ascending"),
+        "descending_triangle": lambda t: _triangle(t, "descending"),
+        "symmetric_triangle": lambda t: _triangle(t, "symmetric"),
+        "rectangle": _rectangle,
+        "flag_bull": lambda t: _flag(t, 1.0),
+        "flag_bear": lambda t: _flag(t, -1.0),
+        "pennant": _pennant,
+        "cup_and_handle": _cup_handle,
+        "rising_wedge": lambda t: _wedge(t, True),
+        "falling_wedge": lambda t: _wedge(t, False),
+    }[name]
+    return _path(key, T, base, shape)
+
+
+def to_ohlcv(key, close):
+    """Dress a close path into normalized OHLCV (preprocess parity:
+    OHLC ÷ last close, volume ÷ max volume)."""
+    T = close.shape[0]
+    k_o, k_w, k_v = jax.random.split(key, 3)
+    spread = jnp.abs(jax.random.normal(k_w, (2, T))) * 0.3
+    open_ = jnp.concatenate([close[:1], close[:-1]]) + _noise(k_o, T, 0.1)
+    high = jnp.maximum(open_, close) + spread[0]
+    low = jnp.minimum(open_, close) - spread[1]
+    volume = jnp.abs(jax.random.normal(k_v, (T,))) + 0.5
+    last = close[-1]
+    ohlc = jnp.stack([open_, high, low, close], axis=-1) / last
+    vol = (volume / jnp.max(volume))[:, None]
+    return jnp.concatenate([ohlc, vol], axis=-1)
+
+
+def generate_dataset(key, n_per_class: int = 64, T: int = 60):
+    """[(N·C), T, 5] windows + [N·C] labels, one vmapped call per class."""
+    xs, ys = [], []
+    for label in range(N_CLASSES):
+        k = jax.random.fold_in(key, label)
+        keys = jax.random.split(k, n_per_class)
+
+        def one(kk):
+            k1, k2 = jax.random.split(kk)
+            return to_ohlcv(k2, generate_pattern(k1, label, T))
+
+        xs.append(jax.vmap(one)(keys))
+        ys.append(jnp.full((n_per_class,), label, jnp.int32))
+    return jnp.concatenate(xs), jnp.concatenate(ys)

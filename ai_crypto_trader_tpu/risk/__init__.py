@@ -1,0 +1,20 @@
+from ai_crypto_trader_tpu.risk.var import (  # noqa: F401
+    correlation_matrix,
+    cvar,
+    diversification_analysis,
+    equal_risk_position_sizes,
+    historical_var,
+    parametric_var,
+    portfolio_var,
+)
+from ai_crypto_trader_tpu.risk.stops import (  # noqa: F401
+    TrailingStopState,
+    adaptive_stop_loss,
+    trailing_stop_init,
+    trailing_stop_update,
+)
+from ai_crypto_trader_tpu.risk.social import (  # noqa: F401
+    SocialSnapshot,
+    social_risk_adjustment,
+    weighted_sentiment,
+)

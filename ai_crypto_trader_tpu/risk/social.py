@@ -1,0 +1,94 @@
+"""Social-sentiment risk adjustment.
+
+Capability parity with SocialRiskAdjuster (`services/social_risk_adjuster.py`):
+  * source-weighted sentiment score (:150) over twitter/reddit/news/overall,
+  * exponential time decay with a 6-hour half-life (:205),
+  * sentiment → position-size / stop-loss / take-profit / correlation-limit
+    adjustment factors (:229-298), each capped at ±max_adjustment_percent
+    (config.json: 0.5),
+  * data-quality gate (:323): below min_data_quality everything is neutral.
+
+Pure functions over arrays of timestamped sentiment observations, so the
+same code scores one live snapshot or a whole backtest's history (vmapped).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu.config import SocialRiskParams
+
+DEFAULT_SOURCE_WEIGHTS = (0.35, 0.30, 0.25, 0.10)  # twitter/reddit/news/overall
+
+
+class SocialSnapshot(NamedTuple):
+    """Timestamped sentiment observations. sentiments[i, s] ∈ [0, 1] for
+    observation i from source s; age_hours[i] = now - t_i."""
+
+    sentiments: jnp.ndarray    # [N, n_sources]
+    age_hours: jnp.ndarray     # [N]
+    data_quality: jnp.ndarray  # scalar ∈ [0, 1]
+
+
+@jax.jit
+def weighted_sentiment(snap: SocialSnapshot,
+                       source_weights=DEFAULT_SOURCE_WEIGHTS,
+                       half_life_hours: float = 6.0):
+    """Time-decayed, source-weighted sentiment ∈ [0, 1]
+    (`social_risk_adjuster.py:150-228`)."""
+    w_src = jnp.asarray(source_weights)
+    w_src = w_src / jnp.sum(w_src)
+    decay = jnp.exp2(-snap.age_hours / half_life_hours)        # [N]
+    per_obs = snap.sentiments @ w_src                          # [N]
+    denom = jnp.maximum(jnp.sum(decay), 1e-9)
+    return jnp.sum(per_obs * decay) / denom
+
+
+def social_risk_adjustment(snap: SocialSnapshot,
+                           params: SocialRiskParams | None = None):
+    """Sentiment → multiplicative adjustment factors
+    (`social_risk_adjuster.py:229-323`).
+
+    Bullish sentiment (≥ bullish_threshold) sizes up / widens TP; bearish
+    (≤ bearish_threshold) sizes down / tightens stops; every factor is
+    clamped to 1 ± max_adjustment_percent, and a failing data-quality gate
+    returns exact neutrality."""
+    p = params or SocialRiskParams()
+    # Source order of SocialSnapshot columns: twitter, reddit, news, overall.
+    w = tuple(p.sentiment_weights.get(k, d) for k, d in zip(
+        ("twitter_sentiment", "reddit_sentiment", "news_sentiment",
+         "overall_sentiment"), DEFAULT_SOURCE_WEIGHTS))
+    s = weighted_sentiment(snap, source_weights=w,
+                           half_life_hours=p.sentiment_half_life_hours)
+
+    # signed intensity ∈ [-1, 1]: 0 at neutral band center, ±1 at extremes
+    center = (p.bullish_threshold + p.bearish_threshold) / 2.0
+    span = (p.bullish_threshold - p.bearish_threshold) / 2.0
+    intensity = jnp.clip((s - center) / span, -1.0, 1.0)
+    in_band = (s < p.bullish_threshold) & (s > p.bearish_threshold)
+    intensity = jnp.where(in_band, 0.0, intensity)
+
+    cap = p.max_adjustment_percent
+
+    def factor(impact):
+        return jnp.clip(1.0 + intensity * impact, 1.0 - cap, 1.0 + cap)
+
+    quality_ok = snap.data_quality >= p.min_data_quality
+    enabled = jnp.asarray(p.enabled) & quality_ok
+
+    def gated(f):
+        return jnp.where(enabled, f, 1.0)
+
+    return {
+        "sentiment": s,
+        "intensity": jnp.where(enabled, intensity, 0.0),
+        "position_size_factor": gated(factor(p.position_size_impact)),
+        # bearish → tighter stop (smaller stop distance), bullish → roomier
+        "stop_loss_factor": gated(factor(p.stop_loss_impact)),
+        "take_profit_factor": gated(factor(p.take_profit_impact)),
+        "correlation_limit_factor": gated(factor(-p.correlation_impact)),
+        "data_quality_ok": quality_ok,
+    }

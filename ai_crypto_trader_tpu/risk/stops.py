@@ -1,0 +1,110 @@
+"""Stop-loss machinery: adaptive stops + the four trailing-stop strategies.
+
+Capability parity with:
+  * `PortfolioRiskService.calculate_adaptive_stop_loss`
+    (`services/portfolio_risk_service.py:489-547`): volatility-scaled stop
+    percentage, factor ∈ [min_factor, max_factor] via a 50 %-annual-vol
+    normalization;
+  * `TrailingStopManager` (`services/trade_executor_service.py:55-398`):
+    percent_based / atr_based / volatility_based / fixed_amount trailing
+    strategies, activation threshold, highest-price tracking, and the
+    stop-only-moves-up invariant.
+
+The trailing stop is a pure state machine `(state, price) → state'` — one
+`jnp.where` chain instead of the reference's dict mutation — so it can run
+per-candle *inside* the scan backtester (vmapped over positions) as well as
+tick-by-tick in the live executor shell.  Time-based throttling
+(`max_adjustment_frequency_seconds`) is a host-side concern and lives in
+the shell executor, not here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+STRATEGIES = ("percent_based", "atr_based", "volatility_based", "fixed_amount")
+
+
+@jax.jit
+def adaptive_stop_loss(entry_price, annual_volatility, base_stop_pct: float = 2.0,
+                       min_factor: float = 0.5, max_factor: float = 2.0):
+    """Volatility-adaptive stop (`portfolio_risk_service.py:489-547`):
+    factor interpolates linearly as vol goes 0 → 50 % annualized."""
+    vol_pct = jnp.clip(annual_volatility / 0.5, 0.0, 1.0)
+    factor = min_factor + (max_factor - min_factor) * vol_pct
+    stop_pct = base_stop_pct * factor
+    return entry_price * (1.0 - stop_pct / 100.0), stop_pct
+
+
+class TrailingStopState(NamedTuple):
+    entry: jnp.ndarray
+    highest: jnp.ndarray
+    stop: jnp.ndarray
+    activation_price: jnp.ndarray
+    activated: jnp.ndarray       # bool
+    n_adjustments: jnp.ndarray   # i32
+
+
+def trailing_stop_init(entry_price, initial_stop,
+                       activation_threshold_pct: float = 1.0) -> TrailingStopState:
+    """register_trailing_stop (`trade_executor_service.py:104-140`)."""
+    entry = jnp.asarray(entry_price, jnp.float32)
+    return TrailingStopState(
+        entry=entry,
+        highest=entry,
+        stop=jnp.asarray(initial_stop, jnp.float32),
+        activation_price=entry * (1.0 + activation_threshold_pct / 100.0),
+        activated=jnp.asarray(False),
+        n_adjustments=jnp.asarray(0, jnp.int32),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("strategy",))
+def trailing_stop_update(state: TrailingStopState, price, *,
+                         strategy: str = "percent_based",
+                         trail_percent: float = 0.8,
+                         min_trail_distance_pct: float = 0.5,
+                         atr=0.0, atr_multiplier: float = 2.0,
+                         volatility=0.0, volatility_multiplier: float = 1.5,
+                         fixed_trail_amount: float = 5.0,
+                         min_adjustment_pct: float = 0.2):
+    """One price update (`update_price` + `_adjust_trailing_stop`,
+    `trade_executor_service.py:142-276`).
+
+    Returns (state', triggered) where triggered = price fell to/below the
+    active stop. The stop only ratchets up; adjustment happens only while
+    activated and on new highs — exactly the reference's control flow,
+    expressed branch-free."""
+    price = jnp.asarray(price, jnp.float32)
+    new_high = price > state.highest
+    highest = jnp.maximum(state.highest, price)
+    activated = state.activated | (price >= state.activation_price)
+
+    if strategy == "percent_based":
+        cand = highest * (1.0 - trail_percent / 100.0)
+        min_stop = highest * (1.0 - min_trail_distance_pct / 100.0)
+        cand = jnp.minimum(cand, min_stop)
+    elif strategy == "atr_based":
+        cand = highest - jnp.asarray(atr) * atr_multiplier
+    elif strategy == "volatility_based":
+        cand = highest - jnp.asarray(volatility) * volatility_multiplier
+    elif strategy == "fixed_amount":
+        trail = jnp.maximum(jnp.asarray(fixed_trail_amount),
+                            highest * (min_adjustment_pct / 100.0))
+        cand = highest - trail
+    else:
+        raise ValueError(f"unknown trailing strategy {strategy!r}")
+
+    adjust = activated & new_high & (cand > state.stop)
+    stop = jnp.where(adjust, cand, state.stop)
+    triggered = activated & (price <= stop)
+
+    return TrailingStopState(
+        entry=state.entry, highest=highest, stop=stop,
+        activation_price=state.activation_price, activated=activated,
+        n_adjustments=state.n_adjustments + adjust.astype(jnp.int32),
+    ), triggered

@@ -1,0 +1,123 @@
+"""Portfolio risk analytics: VaR / CVaR / correlation / sizing.
+
+Capability parity with PortfolioRiskService
+(`services/portfolio_risk_service.py`):
+  * historical + parametric VaR and CVaR (:217-285),
+  * asset correlation matrix (:286),
+  * correlation-aware portfolio VaR (:328),
+  * equal-risk ("risk parity light") optimal position sizes (:400),
+  * diversification analysis (:718).
+
+All functions are jitted array programs over return matrices
+[n_assets, T]; the host shell feeds them live return windows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def historical_var(returns: jnp.ndarray, confidence: float = 0.95):
+    """Empirical VaR: the (1-c) quantile of the return distribution,
+    reported positive (loss). returns: [..., T]."""
+    q = jnp.quantile(returns, 1.0 - confidence, axis=-1)
+    return jnp.maximum(-q, 0.0)
+
+
+@jax.jit
+def parametric_var(returns: jnp.ndarray, confidence: float = 0.95):
+    """Gaussian VaR: -(μ + z·σ). z hard-coded per reference's use of the
+    normal quantile (z_{0.05} = -1.645, z_{0.01} = -2.326)."""
+    mu = jnp.mean(returns, axis=-1)
+    sd = jnp.std(returns, axis=-1)
+    z = jnp.interp(jnp.asarray(confidence),
+                   jnp.asarray([0.90, 0.95, 0.99]),
+                   jnp.asarray([1.2816, 1.6449, 2.3263]))
+    return jnp.maximum(-(mu - z * sd), 0.0)
+
+
+@jax.jit
+def cvar(returns: jnp.ndarray, confidence: float = 0.95):
+    """Expected shortfall beyond the historical VaR."""
+    var = historical_var(returns, confidence)
+    tail = returns <= -var[..., None]
+    tail_sum = jnp.sum(jnp.where(tail, returns, 0.0), axis=-1)
+    tail_n = jnp.maximum(jnp.sum(tail, axis=-1), 1)
+    return jnp.maximum(-(tail_sum / tail_n), 0.0)
+
+
+@jax.jit
+def correlation_matrix(returns: jnp.ndarray):
+    """[n, n] Pearson correlations from [n, T] returns."""
+    x = returns - jnp.mean(returns, axis=-1, keepdims=True)
+    cov = x @ x.T / returns.shape[-1]
+    sd = jnp.sqrt(jnp.diagonal(cov))
+    denom = jnp.outer(sd, sd)
+    return cov / jnp.where(denom == 0.0, 1.0, denom)
+
+
+@jax.jit
+def portfolio_var(weights: jnp.ndarray, returns: jnp.ndarray,
+                  confidence: float = 0.95):
+    """Correlation-aware portfolio VaR: σ_p = √(wᵀ Σ w), VaR = z·σ_p - μ_p
+    (`portfolio_risk_service.py:328`)."""
+    x = returns - jnp.mean(returns, axis=-1, keepdims=True)
+    cov = x @ x.T / returns.shape[-1]
+    mu_p = jnp.sum(weights * jnp.mean(returns, axis=-1))
+    sigma_p = jnp.sqrt(jnp.maximum(weights @ cov @ weights, 0.0))
+    z = jnp.interp(jnp.asarray(confidence),
+                   jnp.asarray([0.90, 0.95, 0.99]),
+                   jnp.asarray([1.2816, 1.6449, 2.3263]))
+    return jnp.maximum(z * sigma_p - mu_p, 0.0)
+
+
+@jax.jit
+def equal_risk_position_sizes(volatilities: jnp.ndarray,
+                              total_capital: float = 1.0,
+                              max_allocation: float = 0.25):
+    """Inverse-volatility sizing with a per-asset allocation cap
+    (`calculate_optimal_position_sizes`, `portfolio_risk_service.py:400`).
+
+    Caps are enforced iteratively by redistributing the excess — expressed
+    as a fixed small number of projection steps (capped weights can free no
+    more than n rounds of excess)."""
+    inv = 1.0 / jnp.maximum(volatilities, 1e-8)
+    w = inv / jnp.sum(inv)
+
+    def project(w, _):
+        over = jnp.maximum(w - max_allocation, 0.0)
+        w = jnp.minimum(w, max_allocation)
+        free = w < max_allocation
+        freeable = jnp.where(free, w, 0.0)
+        denom = jnp.sum(freeable)
+        w = w + jnp.where(free, freeable / jnp.where(denom == 0, 1.0, denom), 0.0) * jnp.sum(over)
+        return w, None
+
+    w, _ = jax.lax.scan(project, w, None, length=4)
+    w = jnp.minimum(w, max_allocation)
+    return w * total_capital
+
+
+@jax.jit
+def diversification_analysis(weights: jnp.ndarray, returns: jnp.ndarray):
+    """Concentration + correlation diagnostics
+    (`portfolio_risk_service.py:718`): Herfindahl index, effective number of
+    assets, average pairwise correlation, diversification ratio."""
+    corr = correlation_matrix(returns)
+    n = weights.shape[0]
+    hhi = jnp.sum(weights**2)
+    off = corr - jnp.eye(n) * corr
+    avg_corr = jnp.sum(off) / jnp.maximum(n * (n - 1), 1)
+    sd = jnp.std(returns, axis=-1)
+    x = returns - jnp.mean(returns, axis=-1, keepdims=True)
+    cov = x @ x.T / returns.shape[-1]
+    sigma_p = jnp.sqrt(jnp.maximum(weights @ cov @ weights, 1e-12))
+    div_ratio = jnp.sum(weights * sd) / sigma_p
+    return {
+        "herfindahl": hhi,
+        "effective_assets": 1.0 / jnp.maximum(hhi, 1e-9),
+        "avg_pairwise_correlation": avg_corr,
+        "diversification_ratio": div_ratio,
+    }

@@ -1,0 +1,13 @@
+from ai_crypto_trader_tpu.shell.bus import EventBus  # noqa: F401
+from ai_crypto_trader_tpu.shell.exchange import (  # noqa: F401
+    ExchangeInterface,
+    FakeExchange,
+    make_exchange,
+)
+from ai_crypto_trader_tpu.shell.llm import (  # noqa: F401
+    LLMTrader,
+    TechnicalPolicyBackend,
+)
+from ai_crypto_trader_tpu.shell.monitor import MarketMonitor  # noqa: F401
+from ai_crypto_trader_tpu.shell.analyzer import SignalAnalyzer  # noqa: F401
+from ai_crypto_trader_tpu.shell.executor import TradeExecutor  # noqa: F401

@@ -1,0 +1,86 @@
+"""Signal analyzer service: market_updates → AI gate → trading_signals.
+
+Capability parity with AIAnalyzerService (`services/ai_analyzer_service.py`):
+per-symbol analysis-interval gate (60 s, :382), market-context assembly from
+technical + social + news inputs (:153-380), LLM analysis via the adapter,
+and publication of `trading_signals` carrying decision/confidence plus the
+technical signal (the executor cross-checks both, as in the reference).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.shell.llm import LLMTrader
+
+
+@dataclass
+class SignalAnalyzer:
+    bus: EventBus
+    trader: LLMTrader = field(default_factory=LLMTrader)
+    analysis_interval_s: float = 60.0
+    now_fn: any = time.time
+    _last_analysis: dict = field(default_factory=dict)
+
+    def _build_context(self, update: dict) -> dict:
+        """Market context string/dict (`ai_analyzer_service.py:153-380`) —
+        technical core plus whatever social/news state services posted."""
+        ctx = dict(update)
+        symbol = update["symbol"]
+        social = self.bus.get(f"social_metrics_{symbol}")
+        if social:
+            ctx["social"] = social
+        news = self.bus.get(f"news_analysis_{symbol}")
+        if news:
+            ctx["news"] = news
+        pattern = self.bus.get(f"pattern_signals_{symbol}")
+        if pattern:
+            ctx["chart_pattern"] = pattern
+        return ctx
+
+    async def handle_update(self, update: dict) -> dict | None:
+        """Process one market update; returns the published signal or None
+        when gated."""
+        symbol = update["symbol"]
+        now = self.now_fn()
+        if now - self._last_analysis.get(symbol, -1e18) < self.analysis_interval_s:
+            return None
+        self._last_analysis[symbol] = now
+
+        ctx = self._build_context(update)
+        analysis = await self.trader.analyze_trade_opportunity(ctx)
+        signal = {
+            "symbol": symbol,
+            "timestamp": now,
+            "current_price": update["current_price"],
+            "signal": update.get("signal", "NEUTRAL"),
+            "signal_strength": update.get("signal_strength", 0.0),
+            "volatility": update.get("volatility", 0.0),
+            "avg_volume": update.get("avg_volume", 0.0),
+            "decision": analysis.get("decision", "HOLD"),
+            "confidence": float(analysis.get("confidence", 0.0)),
+            "reasoning": analysis.get("reasoning", ""),
+            "model_version": analysis.get("model_version"),
+        }
+        await self.bus.publish("trading_signals", signal)
+        self.bus.set(f"latest_signal_{symbol}", signal)
+        return signal
+
+    def _queue(self):
+        # Persistent subscription — a fresh queue per drain would miss every
+        # message published before the drain started.
+        if not hasattr(self, "_q"):
+            self._q = self.bus.subscribe("market_updates")
+        return self._q
+
+    async def run_once(self) -> int:
+        """Drain pending market_updates (used by tests / the launcher tick)."""
+        n = 0
+        q = self._queue()
+        while not q.empty():
+            env = q.get_nowait()
+            if await self.handle_update(env["data"]):
+                n += 1
+        return n

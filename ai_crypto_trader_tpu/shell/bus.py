@@ -1,0 +1,82 @@
+"""In-process async event bus — the control plane that replaces Redis.
+
+The reference's entire inter-service fabric is Redis pub/sub + key-value
+state over TCP (`services/utils/redis_pool.py`; SURVEY §1 L1, §5.8): every
+numeric result crosses a network bus.  In the TPU-native design, numbers
+move over ICI inside XLA collectives; what remains is *control*: signal
+fan-out, hot-swapped strategy params, dashboard feeds.  This bus serves that
+role in-process (one asyncio loop per host) with the same surface the
+reference's services use — publish/subscribe channels + a key-value store —
+so every reference channel (`market_updates`, `trading_signals`,
+`pattern_signals`, `strategy_update`, …, `dashboard.py:91-99`) has a direct
+equivalent.  A multi-host deployment can swap in any transport behind the
+same interface without touching services.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import time
+from collections import defaultdict
+from typing import Any, AsyncIterator
+
+
+class EventBus:
+    """Channels + KV store. Subscribers get bounded asyncio queues; slow
+    consumers drop oldest (the reference's fire-and-forget pub/sub has no
+    delivery guarantee either — parity, but explicit)."""
+
+    def __init__(self, max_queue: int = 1024, now_fn=time.time):
+        self._subs: dict[str, list[asyncio.Queue]] = defaultdict(list)
+        self._kv: dict[str, Any] = {}
+        self._max_queue = max_queue
+        self._now = now_fn
+        self.published_counts: dict[str, int] = defaultdict(int)
+
+    # --- pub/sub -----------------------------------------------------------
+    def subscribe(self, channel: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(self._max_queue)
+        self._subs[channel].append(q)
+        return q
+
+    def unsubscribe(self, channel: str, q: asyncio.Queue) -> None:
+        if q in self._subs.get(channel, []):
+            self._subs[channel].remove(q)
+
+    async def publish(self, channel: str, message: Any) -> int:
+        self.published_counts[channel] += 1
+        delivered = 0
+        envelope = {"channel": channel, "ts": self._now(), "data": message}
+        for pattern, queues in list(self._subs.items()):
+            if pattern == channel or fnmatch.fnmatch(channel, pattern):
+                for q in queues:
+                    if q.full():
+                        try:
+                            q.get_nowait()          # drop oldest
+                        except asyncio.QueueEmpty:
+                            pass
+                    q.put_nowait(envelope)
+                    delivered += 1
+        return delivered
+
+    async def listen(self, channel: str) -> AsyncIterator[dict]:
+        q = self.subscribe(channel)
+        try:
+            while True:
+                yield await q.get()
+        finally:
+            self.unsubscribe(channel, q)
+
+    # --- key-value state (Redis get/set/hget parity) -----------------------
+    def set(self, key: str, value: Any) -> None:
+        self._kv[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._kv.get(key, default)
+
+    def keys(self, pattern: str = "*") -> list[str]:
+        return [k for k in self._kv if fnmatch.fnmatch(k, pattern)]
+
+    def delete(self, key: str) -> None:
+        self._kv.pop(key, None)

@@ -1,0 +1,256 @@
+"""Exchange adapters: the abstract interface, a deterministic fake, and a
+network-gated Binance adapter.
+
+Capability parity with `services/utils/exchange_interface.py:10-215`
+(abstract ExchangeInterface + BinanceExchange + ExchangeFactory), plus the
+fake backend the reference never had (its tests hit live Binance —
+SURVEY §4): FakeExchange replays a synthetic (or loaded) OHLCV series with
+a virtual clock, fills market/limit/stop orders against candle prices,
+tracks balances, and is fully deterministic — the substrate for executor /
+monitor / integration tests and paper trading.
+"""
+
+from __future__ import annotations
+
+import itertools
+from abc import ABC, abstractmethod
+from typing import Any
+
+import numpy as np
+
+from ai_crypto_trader_tpu.data.ingest import OHLCV
+
+
+class ExchangeInterface(ABC):
+    """`exchange_interface.py:10-60` surface."""
+
+    @abstractmethod
+    def get_ticker(self, symbol: str) -> dict: ...
+
+    @abstractmethod
+    def get_order_book(self, symbol: str, limit: int = 20) -> dict: ...
+
+    @abstractmethod
+    def get_klines(self, symbol: str, interval: str = "1m",
+                   limit: int = 100) -> list: ...
+
+    @abstractmethod
+    def place_order(self, symbol: str, side: str, order_type: str,
+                    quantity: float, price: float | None = None,
+                    stop_price: float | None = None) -> dict: ...
+
+    @abstractmethod
+    def cancel_order(self, symbol: str, order_id: int) -> dict: ...
+
+    @abstractmethod
+    def get_balances(self) -> dict: ...
+
+    def order_is_open(self, symbol: str, order_id: int) -> bool:
+        """Whether a previously-placed order is still resting (False once
+        filled or canceled). Default pessimistically True for adapters that
+        don't track state."""
+        return True
+
+
+class FakeExchange(ExchangeInterface):
+    """Deterministic candle-replay exchange with a virtual clock.
+
+    `advance()` moves to the next candle; open limit/stop orders are
+    evaluated against each new candle's high/low, like a real matching
+    engine at candle granularity."""
+
+    def __init__(self, series: dict[str, OHLCV], quote_balance: float = 10_000.0,
+                 fee_rate: float = 0.001):
+        self.series = series
+        self.cursor = {s: 0 for s in series}
+        self.balances: dict[str, float] = {"USDC": quote_balance}
+        self.fee_rate = fee_rate
+        self.open_orders: dict[int, dict] = {}
+        self.fills: list[dict] = []
+        self._order_ids = itertools.count(1)
+
+    # --- clock -------------------------------------------------------------
+    def advance(self, symbol: str | None = None, steps: int = 1) -> None:
+        for sym in ([symbol] if symbol else list(self.series)):
+            self.cursor[sym] = min(self.cursor[sym] + steps,
+                                   len(self.series[sym]) - 1)
+            self._match_orders(sym)
+
+    def _candle(self, symbol: str, offset: int = 0):
+        i = max(self.cursor[symbol] - offset, 0)
+        s = self.series[symbol]
+        return {k: float(getattr(s, k)[i]) for k in
+                ("open", "high", "low", "close", "volume")} | {
+                    "timestamp": int(s.timestamp[i])}
+
+    # --- market data -------------------------------------------------------
+    def get_ticker(self, symbol: str) -> dict:
+        c = self._candle(symbol)
+        return {"symbol": symbol, "price": c["close"], "volume": c["volume"],
+                "timestamp": c["timestamp"]}
+
+    def get_order_book(self, symbol: str, limit: int = 20) -> dict:
+        """Synthetic book around the candle close: geometric level spacing,
+        sizes decaying with depth — enough structure for the order-book
+        analytics (imbalance/walls/impact) to chew on."""
+        c = self._candle(symbol)
+        mid = c["close"]
+        spread = max(mid * 1e-4, 1e-8)
+        levels = np.arange(1, limit + 1)
+        rng = np.random.default_rng(self.cursor[symbol])  # deterministic per candle
+        sizes = c["volume"] / limit * np.exp(-levels / limit) * (1 + 0.3 * rng.random(limit))
+        bids = [[mid - spread * i, float(s)] for i, s in zip(levels, sizes)]
+        asks = [[mid + spread * i, float(s)] for i, s in zip(levels, sizes)]
+        return {"symbol": symbol, "bids": bids, "asks": asks,
+                "timestamp": c["timestamp"]}
+
+    def get_klines(self, symbol: str, interval: str = "1m",
+                   limit: int = 100) -> list:
+        s = self.series[symbol]
+        end = self.cursor[symbol] + 1
+        start = max(end - limit, 0)
+        rows = []
+        for i in range(start, end):
+            rows.append([int(s.timestamp[i]), float(s.open[i]), float(s.high[i]),
+                         float(s.low[i]), float(s.close[i]), float(s.volume[i]),
+                         0, 0.0, 0, 0.0, 0.0, 0])
+        return rows
+
+    # --- trading -----------------------------------------------------------
+    def _base_asset(self, symbol: str) -> str:
+        for quote in ("USDC", "USDT", "BUSD"):
+            if symbol.endswith(quote):
+                return symbol[: -len(quote)]
+        return symbol
+
+    def _quote_asset(self, symbol: str) -> str:
+        for quote in ("USDC", "USDT", "BUSD"):
+            if symbol.endswith(quote):
+                return quote
+        return "USDC"
+
+    def _fill(self, order: dict, price: float) -> dict:
+        symbol, side, qty = order["symbol"], order["side"], order["quantity"]
+        base, quote = self._base_asset(symbol), self._quote_asset(symbol)
+        cost = qty * price
+        fee = cost * self.fee_rate
+        if side == "BUY":
+            if self.balances.get(quote, 0.0) < cost + fee:
+                return {**order, "status": "REJECTED", "reason": "insufficient_balance"}
+            self.balances[quote] = self.balances.get(quote, 0.0) - cost - fee
+            self.balances[base] = self.balances.get(base, 0.0) + qty
+        else:
+            if self.balances.get(base, 0.0) < qty:
+                return {**order, "status": "REJECTED", "reason": "insufficient_balance"}
+            self.balances[base] -= qty
+            self.balances[quote] = self.balances.get(quote, 0.0) + cost - fee
+        filled = {**order, "status": "FILLED", "price": price, "fee": fee}
+        self.fills.append(filled)
+        return filled
+
+    def place_order(self, symbol: str, side: str, order_type: str,
+                    quantity: float, price: float | None = None,
+                    stop_price: float | None = None) -> dict:
+        oid = next(self._order_ids)
+        order = {"order_id": oid, "symbol": symbol, "side": side.upper(),
+                 "type": order_type.upper(), "quantity": float(quantity),
+                 "limit_price": price, "stop_price": stop_price}
+        if order["type"] == "MARKET":
+            return self._fill(order, self._candle(symbol)["close"])
+        order["status"] = "OPEN"
+        self.open_orders[oid] = order
+        return dict(order)
+
+    def _match_orders(self, symbol: str) -> None:
+        c = self._candle(symbol)
+        for oid, o in list(self.open_orders.items()):
+            if o["symbol"] != symbol:
+                continue
+            t, side = o["type"], o["side"]
+            fill_price = None
+            if t == "LIMIT":
+                if side == "BUY" and c["low"] <= o["limit_price"]:
+                    fill_price = o["limit_price"]
+                elif side == "SELL" and c["high"] >= o["limit_price"]:
+                    fill_price = o["limit_price"]
+            elif t in ("STOP_LOSS", "STOP_LOSS_LIMIT"):
+                if side == "SELL" and c["low"] <= o["stop_price"]:
+                    fill_price = o["limit_price"] or o["stop_price"]
+                elif side == "BUY" and c["high"] >= o["stop_price"]:
+                    fill_price = o["limit_price"] or o["stop_price"]
+            if fill_price is not None:
+                result = self._fill(o, fill_price)
+                if result["status"] == "FILLED":
+                    del self.open_orders[oid]
+
+    def cancel_order(self, symbol: str, order_id: int) -> dict:
+        o = self.open_orders.pop(order_id, None)
+        if o is None:
+            return {"order_id": order_id, "status": "NOT_FOUND"}
+        return {**o, "status": "CANCELED"}
+
+    def order_is_open(self, symbol: str, order_id: int) -> bool:
+        return order_id in self.open_orders
+
+    def last_fill(self, order_id: int) -> dict | None:
+        for f in reversed(self.fills):
+            if f.get("order_id") == order_id:
+                return f
+        return None
+
+    def get_balances(self) -> dict:
+        return dict(self.balances)
+
+
+class BinanceExchange(ExchangeInterface):
+    """Live Binance adapter (`exchange_interface.py:61-180` surface).
+
+    Network access is absent in this environment, so construction is gated:
+    it raises with a clear message unless a client object is injected."""
+
+    def __init__(self, client: Any = None):
+        if client is None:
+            raise RuntimeError(
+                "BinanceExchange requires an injected client (e.g. "
+                "binance.Client). This environment has no network; use "
+                "FakeExchange for tests/paper trading.")
+        self.client = client
+
+    def get_ticker(self, symbol):
+        t = self.client.get_symbol_ticker(symbol=symbol)
+        return {"symbol": symbol, "price": float(t["price"])}
+
+    def get_order_book(self, symbol, limit=20):
+        return self.client.get_order_book(symbol=symbol, limit=limit)
+
+    def get_klines(self, symbol, interval="1m", limit=100):
+        return self.client.get_klines(symbol=symbol, interval=interval, limit=limit)
+
+    def place_order(self, symbol, side, order_type, quantity, price=None,
+                    stop_price=None):
+        kw = dict(symbol=symbol, side=side, type=order_type, quantity=quantity)
+        if price is not None:
+            kw["price"] = price
+        if stop_price is not None:
+            kw["stopPrice"] = stop_price
+        return self.client.create_order(**kw)
+
+    def cancel_order(self, symbol, order_id):
+        return self.client.cancel_order(symbol=symbol, orderId=order_id)
+
+    def order_is_open(self, symbol, order_id):
+        o = self.client.get_order(symbol=symbol, orderId=order_id)
+        return o.get("status") in ("NEW", "PARTIALLY_FILLED")
+
+    def get_balances(self):
+        acct = self.client.get_account()
+        return {b["asset"]: float(b["free"]) for b in acct["balances"]}
+
+
+def make_exchange(kind: str = "fake", **kw) -> ExchangeInterface:
+    """ExchangeFactory parity (`exchange_interface.py:181-215`)."""
+    if kind == "fake":
+        return FakeExchange(**kw)
+    if kind == "binance":
+        return BinanceExchange(**kw)
+    raise ValueError(f"unknown exchange kind {kind!r}")

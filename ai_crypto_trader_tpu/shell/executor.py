@@ -1,0 +1,227 @@
+"""Trade executor service: trading_signals → gated orders → SL/TP/trailing.
+
+Capability parity with TradeExecutorService
+(`services/trade_executor_service.py`):
+  * `execute_trade` (:816-1046): confidence gate → market BUY → adaptive &
+    socially-adjusted SL/TP percentages (:921-976) → protective
+    STOP_LOSS_LIMIT + LIMIT take-profit orders (:978-999) → active-trade
+    record (:1002-1015) → trailing-stop registration (:1017-1034);
+  * trailing-stop maintenance on price updates with stop-order replacement
+    (:333) — the stop math is the pure state machine in risk/stops.py;
+  * `should_execute_trade` agreement gate (signal == decision == BUY,
+    strength ≥ 70, confidence ≥ threshold — `strategy_tester.py:371-401`);
+  * max-positions cap and holdings tracking.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ai_crypto_trader_tpu.backtest.signals import position_size as technical_position_size
+from ai_crypto_trader_tpu.config import TradingParams, TrailingStopParams
+from ai_crypto_trader_tpu.risk.social import SocialSnapshot, social_risk_adjustment
+from ai_crypto_trader_tpu.risk.stops import (
+    trailing_stop_init,
+    trailing_stop_update,
+)
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
+
+
+@dataclass
+class ActiveTrade:
+    symbol: str
+    entry_price: float
+    quantity: float
+    stop_loss_pct: float
+    take_profit_pct: float
+    stop_order_id: int | None
+    tp_order_id: int | None
+    trailing_state: object
+    opened_at: float
+
+
+@dataclass
+class TradeExecutor:
+    bus: EventBus
+    exchange: ExchangeInterface
+    trading: TradingParams = field(default_factory=TradingParams)
+    trailing: TrailingStopParams = field(default_factory=TrailingStopParams)
+    now_fn: any = time.time
+    active_trades: dict = field(default_factory=dict)
+    closed_trades: list = field(default_factory=list)
+
+    # --- gates (strategy_tester.py:371-401 / trade_executor_service.py) ----
+    def should_execute(self, signal: dict) -> bool:
+        return (
+            signal.get("confidence", 0.0) >= self.trading.ai_confidence_threshold
+            and signal.get("signal_strength", 0.0) >= self.trading.min_signal_strength
+            and signal.get("signal") == signal.get("decision")
+            and signal.get("decision") == "BUY"
+            and signal["symbol"] not in self.active_trades
+            and len(self.active_trades) < self.trading.max_positions
+        )
+
+    def _social_factors(self, symbol: str) -> dict:
+        snap = self.bus.get(f"social_snapshot_{symbol}")
+        if snap is None:
+            return {"position_size_factor": 1.0, "stop_loss_factor": 1.0,
+                    "take_profit_factor": 1.0}
+        if isinstance(snap, SocialSnapshot):
+            return {k: float(v) for k, v in social_risk_adjustment(snap).items()
+                    if k.endswith("_factor")}
+        return snap
+
+    async def handle_signal(self, signal: dict) -> ActiveTrade | None:
+        """`execute_trade` (:816-1046)."""
+        if not self.should_execute(signal):
+            return None
+        symbol = signal["symbol"]
+        balance = self.exchange.get_balances().get("USDC", 0.0)
+
+        plan = technical_position_size(balance, signal.get("volatility", 0.01),
+                                       signal.get("avg_volume", 0.0))
+        social = self._social_factors(symbol)
+        size = float(np.asarray(plan.size)) * social["position_size_factor"]
+        size = min(size, balance * 0.95)
+        if size < self.trading.min_trade_amount:
+            return None
+        # sizer fractions interpreted as percent (the corrected semantics;
+        # see engine.reference_quirks docs), then socially adjusted
+        sl_pct = float(np.asarray(plan.stop_loss_pct)) * 100.0 * social["stop_loss_factor"]
+        tp_pct = float(np.asarray(plan.take_profit_pct)) * 100.0 * social["take_profit_factor"]
+
+        order = self.exchange.place_order(symbol, "BUY", "MARKET",
+                                          quantity=size / signal["current_price"])
+        if order.get("status") != "FILLED":
+            return None
+        entry = order["price"]
+        qty = order["quantity"]
+
+        stop_price = entry * (1 - sl_pct / 100.0)
+        tp_price = entry * (1 + tp_pct / 100.0)
+        stop_order = self.exchange.place_order(
+            symbol, "SELL", "STOP_LOSS_LIMIT", qty,
+            price=stop_price * 0.999, stop_price=stop_price)
+        tp_order = self.exchange.place_order(
+            symbol, "SELL", "LIMIT", qty, price=tp_price)
+
+        trade = ActiveTrade(
+            symbol=symbol, entry_price=entry, quantity=qty,
+            stop_loss_pct=sl_pct, take_profit_pct=tp_pct,
+            stop_order_id=stop_order.get("order_id"),
+            tp_order_id=tp_order.get("order_id"),
+            trailing_state=trailing_stop_init(
+                entry, stop_price, self.trailing.activation_threshold_pct),
+            opened_at=self.now_fn(),
+        )
+        self.active_trades[symbol] = trade
+        self.bus.set("active_trades", {s: vars(t) | {"trailing_state": None}
+                                       for s, t in self.active_trades.items()})
+        await self.bus.publish("trade_executions", {
+            "symbol": symbol, "side": "BUY", "price": entry, "quantity": qty,
+            "stop_loss_pct": sl_pct, "take_profit_pct": tp_pct})
+        return trade
+
+    def _reconcile_protective_fills(self, symbol: str, price: float):
+        """Detect server-side fills of the protective SL/TP orders and
+        finalize the trade — otherwise a filled TP leaves the trade in
+        active_trades and a later trailing trigger double-sells."""
+        trade = self.active_trades.get(symbol)
+        if trade is None:
+            return None
+        for oid, reason, px_factor in (
+                (trade.tp_order_id, "Take Profit", 1 + trade.take_profit_pct / 100),
+                (trade.stop_order_id, "Stop Loss", 1 - trade.stop_loss_pct / 100)):
+            if oid is not None and not self.exchange.order_is_open(symbol, oid):
+                fill = getattr(self.exchange, "last_fill", lambda _o: None)(oid)
+                exit_price = fill["price"] if fill else trade.entry_price * px_factor
+                return (reason, exit_price)
+        return None
+
+    async def on_price(self, symbol: str, price: float) -> None:
+        """Trailing-stop maintenance (`TrailingStopManager.update_price` +
+        stop replacement, :142-333), after reconciling protective fills."""
+        filled = self._reconcile_protective_fills(symbol, price)
+        if filled is not None:
+            reason, exit_price = filled
+            await self._finalize_filled(symbol, exit_price, reason)
+            return
+        trade = self.active_trades.get(symbol)
+        if trade is None:
+            return
+        md = self.bus.get(f"market_data_{symbol}") or {}
+        prev_stop = float(np.asarray(trade.trailing_state.stop))
+        st, triggered = trailing_stop_update(
+            trade.trailing_state, price,
+            strategy=self.trailing.strategy,
+            trail_percent=self.trailing.trail_percent,
+            min_trail_distance_pct=self.trailing.min_trail_distance_pct,
+            atr=md.get("atr", 0.0),
+            atr_multiplier=self.trailing.atr_multiplier,
+            volatility=md.get("volatility", 0.0) * price,
+            volatility_multiplier=self.trailing.volatility_multiplier,
+            fixed_trail_amount=self.trailing.fixed_trail_amount)
+        trade.trailing_state = st
+        new_stop = float(np.asarray(st.stop))
+        if new_stop > prev_stop and trade.stop_order_id is not None:
+            # replace the protective stop order at the ratcheted level
+            self.exchange.cancel_order(symbol, trade.stop_order_id)
+            o = self.exchange.place_order(symbol, "SELL", "STOP_LOSS_LIMIT",
+                                          trade.quantity,
+                                          price=new_stop * 0.999,
+                                          stop_price=new_stop)
+            trade.stop_order_id = o.get("order_id")
+        if bool(triggered):
+            await self.close_trade(symbol, price, "Trailing Stop")
+
+    async def _finalize_filled(self, symbol: str, exit_price: float,
+                               reason: str) -> None:
+        """Close the books on a trade whose protective order already sold
+        the position server-side — cancel the sibling order, no re-sell."""
+        trade = self.active_trades.pop(symbol, None)
+        if trade is None:
+            return
+        for oid in (trade.stop_order_id, trade.tp_order_id):
+            if oid is not None and self.exchange.order_is_open(symbol, oid):
+                self.exchange.cancel_order(symbol, oid)
+        pnl = (exit_price - trade.entry_price) * trade.quantity
+        record = {"symbol": symbol, "entry_price": trade.entry_price,
+                  "exit_price": exit_price, "quantity": trade.quantity,
+                  "pnl": pnl, "reason": reason, "closed_at": self.now_fn()}
+        self.closed_trades.append(record)
+        await self.bus.publish("trade_closures", record)
+
+    async def close_trade(self, symbol: str, price: float, reason: str) -> None:
+        trade = self.active_trades.pop(symbol, None)
+        if trade is None:
+            return
+        for oid in (trade.stop_order_id, trade.tp_order_id):
+            if oid is not None:
+                self.exchange.cancel_order(symbol, oid)
+        self.exchange.place_order(symbol, "SELL", "MARKET", trade.quantity)
+        pnl = (price - trade.entry_price) * trade.quantity
+        record = {"symbol": symbol, "entry_price": trade.entry_price,
+                  "exit_price": price, "quantity": trade.quantity,
+                  "pnl": pnl, "reason": reason, "closed_at": self.now_fn()}
+        self.closed_trades.append(record)
+        await self.bus.publish("trade_closures", record)
+
+    def _queue(self):
+        # Persistent subscription (see analyzer._queue).
+        if not hasattr(self, "_q"):
+            self._q = self.bus.subscribe("trading_signals")
+        return self._q
+
+    async def run_once(self) -> int:
+        """Drain pending trading_signals (test/launcher tick)."""
+        n = 0
+        q = self._queue()
+        while not q.empty():
+            env = q.get_nowait()
+            if await self.handle_signal(env["data"]):
+                n += 1
+        return n

@@ -1,0 +1,122 @@
+"""LLM trade-analysis adapter — the host-side AI gate.
+
+Capability parity with AITrader (`services/ai_trader.py`): JSON-structured
+trade analysis (:36-189), risk/position-sizing analysis (:191-234),
+market-wide analysis (:236-342), `should_take_trade` = confidence ≥ 0.7 and
+decision BUY (:368-387), `adjust_position_size` averaging AI + technical
+sizes and taking the conservative SL/TP (:389-418), model-version UUIDs
+(:25-27).
+
+The LLM itself is non-batchable, non-deterministic, seconds of latency —
+exactly why it stays OUT of the jit compute path (SURVEY §7.4 "The AI
+gate").  Backends are pluggable:
+
+  * TechnicalPolicyBackend — deterministic, derived from the same
+    vectorized signal scoring the backtester uses; the zero-egress and
+    batch-replay configuration (BASELINE.md's reproducible setup);
+  * any object with `.complete(prompt) -> str` returning JSON — an
+    OpenAI-compatible client can be injected in connected deployments.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Protocol
+
+
+class LLMBackend(Protocol):
+    def complete(self, prompt: str) -> str: ...
+
+
+@dataclass
+class TechnicalPolicyBackend:
+    """Deterministic stand-in scoring the same features the prompts cite."""
+
+    confidence_scale: float = 0.9
+
+    def complete(self, prompt: str) -> str:
+        ctx = json.loads(prompt.split("MARKET_DATA:", 1)[1])
+        rsi = float(ctx.get("rsi", 50.0))
+        strength = float(ctx.get("signal_strength", 0.0))
+        signal = ctx.get("signal", "NEUTRAL")
+        confidence = min(strength / 100.0, 1.0) * self.confidence_scale
+        decision = signal if signal in ("BUY", "SELL") else "HOLD"
+        reasoning = (f"rule-based: signal={signal} strength={strength:.0f} "
+                     f"rsi={rsi:.1f}")
+        return json.dumps({
+            "decision": decision, "confidence": round(confidence, 3),
+            "reasoning": reasoning,
+            "key_factors": [k for k in ("rsi", "macd", "bb_position")
+                            if k in ctx],
+        })
+
+
+@dataclass
+class LLMTrader:
+    """ai_trader.AITrader equivalent."""
+
+    backend: LLMBackend = field(default_factory=TechnicalPolicyBackend)
+    confidence_threshold: float = 0.7
+    model_version: str = field(default_factory=lambda: str(uuid.uuid4()))
+
+    async def analyze_trade_opportunity(self, market_data: dict) -> dict:
+        """`ai_trader.py:36-189`: per-symbol decision with explainability."""
+        prompt = ("Analyze this trading opportunity and answer in JSON with "
+                  "decision/confidence/reasoning/key_factors.\nMARKET_DATA:"
+                  + json.dumps(market_data))
+        out = self._safe_json(self.backend.complete(prompt))
+        out.setdefault("decision", "HOLD")
+        out.setdefault("confidence", 0.0)
+        out["model_version"] = self.model_version
+        return out
+
+    async def analyze_risk_setup(self, risk_setup: dict) -> dict:
+        """`ai_trader.py:191-234`: position-size / SL / TP proposal."""
+        capital = float(risk_setup.get("available_capital", 0.0))
+        vol = float(risk_setup.get("volatility", 0.01))
+        prompt = ("Propose position sizing as JSON with position_size/"
+                  "stop_loss_pct/take_profit_pct.\nMARKET_DATA:"
+                  + json.dumps(risk_setup))
+        out = self._safe_json(self.backend.complete(prompt))
+        # deterministic fallback mirrors a volatility ladder
+        out.setdefault("position_size", capital * (0.25 if vol > 0.02 else 0.35))
+        out.setdefault("stop_loss_pct", 2.0 if vol > 0.02 else 1.5)
+        out.setdefault("take_profit_pct", out["stop_loss_pct"] * 2.0)
+        return out
+
+    async def analyze_market_conditions(self, symbols_data: list[dict]) -> dict:
+        """`ai_trader.py:236-342`: market-wide regime read."""
+        ups = sum(1 for s in symbols_data if s.get("price_change_5m", 0) > 0)
+        frac = ups / max(len(symbols_data), 1)
+        sentiment = ("bullish" if frac > 0.6 else
+                     "bearish" if frac < 0.4 else "neutral")
+        return {"market_sentiment": sentiment,
+                "breadth": round(frac, 3),
+                "model_version": self.model_version}
+
+    def should_take_trade(self, analysis: dict) -> bool:
+        """`ai_trader.py:368-387`."""
+        return (analysis.get("decision") == "BUY"
+                and float(analysis.get("confidence", 0.0)) >= self.confidence_threshold)
+
+    def adjust_position_size(self, risk_analysis: dict,
+                             technical_position: dict) -> dict:
+        """`ai_trader.py:389-418`: average sizes, conservative SL/TP."""
+        size = (float(risk_analysis["position_size"])
+                + float(technical_position["position_size"])) / 2.0
+        sl = min(float(risk_analysis["stop_loss_pct"]),
+                 float(technical_position["stop_loss_pct"]))
+        tp = min(float(risk_analysis["take_profit_pct"]),
+                 float(technical_position["take_profit_pct"]))
+        return {**technical_position, "position_size": size,
+                "stop_loss_pct": sl, "take_profit_pct": tp}
+
+    @staticmethod
+    def _safe_json(text: str) -> dict:
+        try:
+            out = json.loads(text)
+            return out if isinstance(out, dict) else {}
+        except (json.JSONDecodeError, TypeError):
+            return {}

@@ -1,0 +1,156 @@
+"""Strategy evaluation: trade-list metrics, time-ordered k-fold CV with
+regime labels, and strategy comparison.
+
+Capability parity with StrategyPerformanceMetrics / the two
+StrategyEvaluationSystem variants (`services/strategy_evaluation.py:32-319,
+1197-1439`; `services/strategy_evaluation_system.py:433-587`):
+  * full metric suite from trade records — win rate, profit factor, Sharpe
+    (daily, √252), max drawdown, Sortino, Calmar, streaks, expectancy,
+    recovery factor, per-symbol P&L;
+  * k-fold cross-validation over time-ordered folds with per-fold market-
+    regime labeling — BUT the fold simulator is the *real* vectorized
+    backtester (backtest/evolvable.py), not the reference's acknowledged
+    placeholder RSI rule (`strategy_evaluation_system.py:358-431`);
+  * multi-strategy comparison table.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu.backtest.evolvable import evolvable_backtest
+from ai_crypto_trader_tpu.backtest.metrics import compute_metrics
+from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
+from ai_crypto_trader_tpu.regime import RegimeDetector
+
+
+def trade_metrics(trades: list[dict], initial_balance: float = 10_000.0,
+                  annualization: float = 252.0) -> dict:
+    """Metric suite from a list of closed-trade records
+    ({'pnl': float, 'symbol': str, ...}) — `strategy_evaluation.py:32-319`."""
+    if not trades:
+        return {"total_trades": 0, "win_rate": 0.0, "profit_factor": 0.0,
+                "sharpe_ratio": 0.0, "sortino_ratio": 0.0, "calmar_ratio": 0.0,
+                "max_drawdown": 0.0, "max_drawdown_pct": 0.0,
+                "expectancy": 0.0, "max_win_streak": 0, "max_loss_streak": 0,
+                "total_pnl": 0.0, "recovery_factor": 0.0, "symbol_pnl": {}}
+    pnl = np.asarray([t["pnl"] for t in trades], np.float64)
+    wins = pnl > 0
+    total_profit = pnl[wins].sum()
+    total_loss = -pnl[~wins].sum()
+
+    equity = initial_balance + np.cumsum(pnl)
+    peak = np.maximum.accumulate(np.concatenate([[initial_balance], equity]))
+    dd = peak[1:] - equity
+    dd_pct = dd / peak[1:] * 100.0
+    # absolute and percentage maxima tracked independently — an early small-
+    # equity dip can be the percent max while a late dip is the dollar max
+    max_dd = float(dd.max()) if len(dd) else 0.0
+    max_dd_pct = float(dd_pct.max()) if len(dd) else 0.0
+
+    rets = pnl / np.concatenate([[initial_balance], equity[:-1]])
+    sharpe = 0.0
+    if len(rets) > 1 and rets.std() > 0:
+        sharpe = float(rets.mean() / rets.std() * np.sqrt(annualization))
+    downside = rets[rets < 0]
+    sortino = 0.0
+    if len(downside) and downside.std() > 0:
+        sortino = float(rets.mean() / np.sqrt((downside**2).mean()) * np.sqrt(annualization))
+
+    # streaks
+    mw = ml = cw = cl = 0
+    for w in wins:
+        cw, cl = (cw + 1, 0) if w else (0, cl + 1)
+        mw, ml = max(mw, cw), max(ml, cl)
+
+    win_rate = float(wins.mean() * 100.0)
+    avg_win = float(pnl[wins].mean()) if wins.any() else 0.0
+    avg_loss = float(-pnl[~wins].mean()) if (~wins).any() else 0.0
+    expectancy = win_rate / 100 * avg_win - (1 - win_rate / 100) * avg_loss
+
+    total_pnl = float(pnl.sum())
+    total_return = total_pnl / initial_balance
+    ann_return = float(rets.mean() * annualization * 100.0)
+    calmar = ann_return / max_dd_pct if max_dd_pct > 0 else 0.0
+
+    symbol_pnl: dict[str, float] = {}
+    for t in trades:
+        symbol_pnl[t.get("symbol", "?")] = symbol_pnl.get(t.get("symbol", "?"), 0.0) + t["pnl"]
+
+    return {
+        "total_trades": len(trades),
+        "winning_trades": int(wins.sum()),
+        "losing_trades": int((~wins).sum()),
+        "win_rate": win_rate,
+        "profit_factor": float(total_profit / total_loss) if total_loss > 0 else 0.0,
+        "total_pnl": total_pnl,
+        "total_return_pct": total_return * 100.0,
+        "sharpe_ratio": sharpe,
+        "sortino_ratio": sortino,
+        "calmar_ratio": float(calmar),
+        "max_drawdown": max_dd,
+        "max_drawdown_pct": max_dd_pct,
+        "expectancy": float(expectancy),
+        "avg_win": avg_win,
+        "avg_loss": avg_loss,
+        "max_win_streak": mw,
+        "max_loss_streak": ml,
+        "recovery_factor": float(total_pnl / max_dd) if max_dd > 0 else 0.0,
+        "symbol_pnl": symbol_pnl,
+    }
+
+
+def cross_validate(ohlcv: dict, params: StrategyParams, k: int = 5,
+                   regime_method: str = "rules") -> dict:
+    """Time-ordered k-fold CV: each fold is backtested with the REAL scan
+    engine and labeled with its dominant market regime
+    (`strategy_evaluation_system.py:433-547`, placeholder simulator
+    replaced).  All folds evaluate as one vmapped batch."""
+    T = len(np.asarray(ohlcv["close"]))
+    fold_len = T // k
+    det = RegimeDetector(method=regime_method).fit(ohlcv)
+    labels = det.label_series(ohlcv)
+
+    folds = []
+    for i in range(k):
+        sl = slice(i * fold_len, (i + 1) * fold_len)
+        fold_arrays = {kk: jnp.asarray(np.asarray(v)[sl])
+                       for kk, v in ohlcv.items() if kk != "regime"}
+        stats = evolvable_backtest(fold_arrays, params)
+        m = {kk: float(v) for kk, v in compute_metrics(stats).items()}
+        regime_counts = np.bincount(labels[sl], minlength=4)
+        from ai_crypto_trader_tpu.regime import REGIME_NAMES
+        folds.append({
+            "fold": i,
+            "regime": REGIME_NAMES[int(np.argmax(regime_counts))],
+            "metrics": m,
+        })
+
+    sharpes = [f["metrics"]["sharpe_ratio"] for f in folds]
+    # per-regime aggregation (`strategy_evaluation_system.py:587`)
+    by_regime: dict[str, list] = {}
+    for f in folds:
+        by_regime.setdefault(f["regime"], []).append(f["metrics"]["sharpe_ratio"])
+    return {
+        "folds": folds,
+        "mean_sharpe": float(np.mean(sharpes)),
+        "std_sharpe": float(np.std(sharpes)),
+        "regime_sharpe": {r: float(np.mean(v)) for r, v in by_regime.items()},
+    }
+
+
+def compare_strategies(ohlcv: dict, named_params: dict[str, StrategyParams]) -> dict:
+    """Side-by-side comparison (`strategy_evaluation.py:1439`) — all
+    strategies evaluated in one vmapped batch."""
+    from ai_crypto_trader_tpu.backtest.strategy import stack_params, unstack_params
+    names = list(named_params)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *named_params.values())
+    stats = jax.vmap(lambda p: evolvable_backtest(ohlcv, p))(stacked)
+    metrics = compute_metrics(stats)
+    table = {}
+    for i, name in enumerate(names):
+        table[name] = {kk: float(np.asarray(v)[i]) for kk, v in metrics.items()}
+    ranked = sorted(names, key=lambda n: -table[n]["sharpe_ratio"])
+    return {"table": table, "ranked": ranked, "best": ranked[0]}

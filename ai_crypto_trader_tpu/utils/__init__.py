@@ -1,0 +1,12 @@
+from ai_crypto_trader_tpu.utils.circuit_breaker import (  # noqa: F401
+    CircuitBreaker,
+    CircuitState,
+    get_circuit_breaker,
+    retry_with_backoff,
+)
+from ai_crypto_trader_tpu.utils.rate_limiter import TokenBucket  # noqa: F401
+from ai_crypto_trader_tpu.utils.checkpoint import (  # noqa: F401
+    load_checkpoint,
+    save_checkpoint,
+)
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry  # noqa: F401

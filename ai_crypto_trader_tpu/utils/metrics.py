@@ -1,0 +1,104 @@
+"""Observability: metrics registry with Prometheus text exposition.
+
+Capability parity with `services/utils/metrics.py` (PrometheusMetrics —
+counters/gauges/histograms like `trades_executed_total`,
+`portfolio_value_usd`, `ai_model_confidence`, `request_latency_seconds`,
+plus /metrics + /health endpoints :189-221) without the prometheus_client
+dependency: exposition is generated directly; an asyncio TCP server serves
+it.  `measure_time` mirrors the reference's latency decorator (:222-281).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, float("inf"))
+
+
+@dataclass
+class MetricsRegistry:
+    namespace: str = "crypto_trader_tpu"
+    counters: dict = field(default_factory=lambda: defaultdict(float))
+    gauges: dict = field(default_factory=dict)
+    histograms: dict = field(default_factory=lambda: defaultdict(
+        lambda: {"buckets": defaultdict(int), "sum": 0.0, "count": 0}))
+    now_fn: any = time.time
+
+    def _key(self, name: str, labels: dict | None):
+        lbl = ",".join(f'{k}="{v}"' for k, v in sorted((labels or {}).items()))
+        return f"{self.namespace}_{name}{{{lbl}}}" if lbl else f"{self.namespace}_{name}"
+
+    def inc(self, name: str, value: float = 1.0, **labels):
+        self.counters[self._key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float, **labels):
+        self.gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value: float, **labels):
+        h = self.histograms[self._key(name, labels)]
+        h["sum"] += value
+        h["count"] += 1
+        # store per-bucket (non-cumulative) counts; exposition() cumulates
+        for b in _BUCKETS:
+            if value <= b:
+                h["buckets"][b] += 1
+                break
+
+    @contextmanager
+    def measure_time(self, name: str, **labels):
+        """`metrics.py:222-281` decorator equivalent."""
+        t0 = self.now_fn()
+        try:
+            yield
+        finally:
+            self.observe(name, self.now_fn() - t0, **labels)
+
+    def exposition(self) -> str:
+        lines = []
+        for k, v in sorted(self.counters.items()):
+            lines.append(f"{k} {v}")
+        for k, v in sorted(self.gauges.items()):
+            lines.append(f"{k} {v}")
+        for k, h in sorted(self.histograms.items()):
+            base, _, lbl = k.partition("{")
+            lbl = ("{" + lbl) if lbl else ""
+            cum = 0
+            for b in _BUCKETS:
+                cum += h["buckets"].get(b, 0)
+                le = "+Inf" if b == float("inf") else str(b)
+                sep = "," if lbl else ""
+                l2 = (lbl[:-1] + f',le="{le}"}}') if lbl else f'{{le="{le}"}}'
+                lines.append(f"{base}_bucket{l2} {cum}")
+            lines.append(f"{base}_sum{lbl} {h['sum']}")
+            lines.append(f"{base}_count{lbl} {h['count']}")
+        return "\n".join(lines) + "\n"
+
+    async def serve(self, host: str = "127.0.0.1", port: int = 9090):
+        """Minimal HTTP /metrics + /health server (the reference gives every
+        service a TCP health port, e.g. monte_carlo_service.py:825-845)."""
+
+        async def handler(reader, writer):
+            try:
+                req = await reader.readline()
+                path = req.split()[1].decode() if len(req.split()) > 1 else "/"
+                while (await reader.readline()).strip():
+                    pass
+                if path == "/health":
+                    body = '{"status": "healthy"}'
+                    ctype = "application/json"
+                else:
+                    body = self.exposition()
+                    ctype = "text/plain"
+                resp = (f"HTTP/1.1 200 OK\r\nContent-Type: {ctype}\r\n"
+                        f"Content-Length: {len(body)}\r\n\r\n{body}")
+                writer.write(resp.encode())
+                await writer.drain()
+            finally:
+                writer.close()
+
+        return await asyncio.start_server(handler, host, port)

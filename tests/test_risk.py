@@ -1,0 +1,167 @@
+"""Risk stack: VaR/CVaR vs numpy oracles, trailing-stop state machine
+invariants, adaptive stops, social adjustment caps and gates."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu.config import SocialRiskParams
+from ai_crypto_trader_tpu.risk import (
+    SocialSnapshot,
+    adaptive_stop_loss,
+    correlation_matrix,
+    cvar,
+    diversification_analysis,
+    equal_risk_position_sizes,
+    historical_var,
+    parametric_var,
+    portfolio_var,
+    social_risk_adjustment,
+    trailing_stop_init,
+    trailing_stop_update,
+    weighted_sentiment,
+)
+
+
+@pytest.fixture
+def returns(rng):
+    return jnp.asarray(rng.normal(0.0002, 0.02, (4, 500)).astype(np.float32))
+
+
+class TestVaR:
+    def test_historical_matches_numpy(self, returns):
+        r = np.asarray(returns)
+        ours = np.asarray(historical_var(returns, 0.95))
+        ref = np.maximum(-np.quantile(r, 0.05, axis=-1), 0)
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_cvar_geq_var(self, returns):
+        v = np.asarray(historical_var(returns))
+        c = np.asarray(cvar(returns))
+        assert (c >= v - 1e-6).all()
+
+    def test_parametric_scales_with_vol(self, rng):
+        lo = jnp.asarray(rng.normal(0, 0.01, 1000).astype(np.float32))
+        hi = jnp.asarray(rng.normal(0, 0.03, 1000).astype(np.float32))
+        assert float(parametric_var(hi)) > float(parametric_var(lo)) * 2
+
+    def test_correlation_matrix(self, returns):
+        ours = np.asarray(correlation_matrix(returns))
+        ref = np.corrcoef(np.asarray(returns))
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_portfolio_var_diversification(self, rng):
+        """Two uncorrelated assets: portfolio VaR < weighted sum of VaRs."""
+        a = rng.normal(0, 0.02, 2000)
+        b = rng.normal(0, 0.02, 2000)
+        rets = jnp.asarray(np.stack([a, b]).astype(np.float32))
+        w = jnp.asarray([0.5, 0.5])
+        pv = float(portfolio_var(w, rets))
+        individual = np.asarray(parametric_var(rets))
+        assert pv < individual.mean() * 0.9
+
+    def test_equal_risk_sizes(self):
+        vols = jnp.asarray([0.01, 0.02, 0.04])
+        w = np.asarray(equal_risk_position_sizes(vols, max_allocation=1.0))
+        np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-4)
+        assert w[0] > w[1] > w[2]       # lower vol → bigger size
+        w_capped = np.asarray(equal_risk_position_sizes(vols, max_allocation=0.4))
+        assert w_capped.max() <= 0.4 + 1e-5
+
+    def test_diversification_analysis(self, returns):
+        w = jnp.asarray([0.25] * 4)
+        out = {k: float(v) for k, v in diversification_analysis(w, returns).items()}
+        assert 3.5 < out["effective_assets"] <= 4.01
+        assert out["diversification_ratio"] >= 1.0
+
+
+class TestAdaptiveStop:
+    def test_vol_widens_stop(self):
+        _, pct_lo = adaptive_stop_loss(100.0, 0.05, base_stop_pct=2.0)
+        _, pct_hi = adaptive_stop_loss(100.0, 0.50, base_stop_pct=2.0)
+        # vol 0.05 → vol_pct 0.1 → factor 0.5 + 1.5·0.1 = 0.65 → 1.3 %
+        np.testing.assert_allclose(float(pct_lo), 1.3, rtol=1e-5)
+        np.testing.assert_allclose(float(pct_hi), 4.0, rtol=1e-5)  # max factor 2
+
+    def test_price_formula(self):
+        price, pct = adaptive_stop_loss(200.0, 0.25)
+        np.testing.assert_allclose(float(price), 200 * (1 - float(pct) / 100), rtol=1e-6)
+
+
+class TestTrailingStop:
+    def test_activation_then_ratchet(self):
+        st = trailing_stop_init(100.0, 98.0, activation_threshold_pct=1.0)
+        st, trig = trailing_stop_update(st, 100.5)      # below activation
+        assert not bool(st.activated) and not bool(trig)
+        st, trig = trailing_stop_update(st, 101.5)      # activates
+        assert bool(st.activated)
+        st, trig = trailing_stop_update(st, 103.0)      # new high → adjust
+        stop_after_high = float(st.stop)
+        assert stop_after_high > 98.0
+        np.testing.assert_allclose(stop_after_high, 103.0 * (1 - 0.8 / 100), rtol=1e-5)
+
+    def test_stop_never_moves_down(self):
+        st = trailing_stop_init(100.0, 98.0)
+        prices = [102.0, 105.0, 103.0, 101.0, 104.0]
+        stops = []
+        for p in prices:
+            st, _ = trailing_stop_update(st, p)
+            stops.append(float(st.stop))
+        assert all(b >= a - 1e-6 for a, b in zip(stops, stops[1:]))
+
+    def test_trigger_fires(self):
+        st = trailing_stop_init(100.0, 98.0)
+        st, _ = trailing_stop_update(st, 105.0)          # activate + ratchet
+        st, trig = trailing_stop_update(st, float(st.stop) - 0.01)
+        assert bool(trig)
+
+    @pytest.mark.parametrize("strategy,kw", [
+        ("atr_based", {"atr": 1.5}),
+        ("volatility_based", {"volatility": 2.0}),
+        ("fixed_amount", {"fixed_trail_amount": 3.0}),
+    ])
+    def test_other_strategies(self, strategy, kw):
+        st = trailing_stop_init(100.0, 95.0)
+        st, _ = trailing_stop_update(st, 110.0, strategy=strategy, **kw)
+        assert float(st.stop) > 95.0
+        if strategy == "atr_based":
+            np.testing.assert_allclose(float(st.stop), 110 - 1.5 * 2.0, rtol=1e-5)
+
+
+class TestSocial:
+    def _snap(self, s, age=0.0, q=1.0):
+        return SocialSnapshot(
+            sentiments=jnp.full((1, 4), jnp.asarray(s, jnp.float32)),
+            age_hours=jnp.asarray([age], jnp.float32),
+            data_quality=jnp.asarray(q, jnp.float32))
+
+    def test_half_life_decay(self):
+        old = SocialSnapshot(
+            sentiments=jnp.asarray([[1.0] * 4, [0.0] * 4], jnp.float32),
+            age_hours=jnp.asarray([0.0, 6.0], jnp.float32),
+            data_quality=jnp.asarray(1.0))
+        # weight of 6h-old obs is exactly half → (1·1 + 0·0.5)/1.5 = 2/3
+        np.testing.assert_allclose(float(weighted_sentiment(old)), 2 / 3, rtol=1e-4)
+
+    def test_bullish_sizes_up_bearish_down(self):
+        up = social_risk_adjustment(self._snap(0.9))
+        dn = social_risk_adjustment(self._snap(0.1))
+        assert float(up["position_size_factor"]) > 1.0
+        assert float(dn["position_size_factor"]) < 1.0
+
+    def test_neutral_band_is_exact_one(self):
+        mid = social_risk_adjustment(self._snap(0.5))
+        np.testing.assert_allclose(float(mid["position_size_factor"]), 1.0)
+
+    def test_caps_respected(self):
+        p = SocialRiskParams(max_adjustment_percent=0.5)
+        out = social_risk_adjustment(self._snap(1.0), p)
+        for k in ("position_size_factor", "stop_loss_factor",
+                  "take_profit_factor", "correlation_limit_factor"):
+            assert 0.5 - 1e-6 <= float(out[k]) <= 1.5 + 1e-6
+
+    def test_quality_gate_neutralizes(self):
+        out = social_risk_adjustment(self._snap(1.0, q=0.2))
+        np.testing.assert_allclose(float(out["position_size_factor"]), 1.0)
+        assert not bool(out["data_quality_ok"])

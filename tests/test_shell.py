@@ -1,0 +1,315 @@
+"""Host shell: event bus, fake exchange matching, circuit breaker, rate
+limiter, metrics exposition, checkpointing, and the full monitor → analyzer
+→ executor pipeline on deterministic data — the integration test the
+reference never had (its tests require live Binance + OpenAI, SURVEY §4)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.config import TradingParams
+from ai_crypto_trader_tpu.data.ingest import OHLCV
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.shell import (
+    EventBus,
+    FakeExchange,
+    MarketMonitor,
+    SignalAnalyzer,
+    TradeExecutor,
+)
+from ai_crypto_trader_tpu.utils import (
+    CircuitBreaker,
+    MetricsRegistry,
+    TokenBucket,
+    load_checkpoint,
+    retry_with_backoff,
+    save_checkpoint,
+)
+
+
+def _series(n=600, seed=5, symbol="BTCUSDC"):
+    d = generate_ohlcv(n=n, seed=seed)
+    return OHLCV(timestamp=np.arange(n, dtype=np.int64) * 60_000,
+                 open=d["open"], high=d["high"], low=d["low"],
+                 close=d["close"], volume=d["volume"] * 1000, symbol=symbol)
+
+
+class VirtualClock:
+    def __init__(self):
+        self.t = 1_000_000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestBus:
+    def test_pubsub_and_kv(self):
+        async def go():
+            bus = EventBus()
+            q = bus.subscribe("market_updates")
+            await bus.publish("market_updates", {"x": 1})
+            env = q.get_nowait()
+            assert env["data"] == {"x": 1}
+            bus.set("holdings", {"BTC": 2})
+            assert bus.get("holdings")["BTC"] == 2
+            assert bus.keys("hold*") == ["holdings"]
+        asyncio.run(go())
+
+    def test_slow_consumer_drops_oldest(self):
+        async def go():
+            bus = EventBus(max_queue=2)
+            q = bus.subscribe("c")
+            for i in range(5):
+                await bus.publish("c", i)
+            assert q.get_nowait()["data"] == 3
+            assert q.get_nowait()["data"] == 4
+        asyncio.run(go())
+
+
+class TestFakeExchange:
+    def test_market_order_and_balances(self):
+        ex = FakeExchange({"BTCUSDC": _series()}, quote_balance=10_000, fee_rate=0.0)
+        px = ex.get_ticker("BTCUSDC")["price"]
+        out = ex.place_order("BTCUSDC", "BUY", "MARKET", quantity=0.01)
+        assert out["status"] == "FILLED" and out["price"] == px
+        b = ex.get_balances()
+        np.testing.assert_allclose(b["BTC"], 0.01)
+        np.testing.assert_allclose(b["USDC"], 10_000 - 0.01 * px, rtol=1e-6)
+
+    def test_insufficient_balance_rejected(self):
+        ex = FakeExchange({"BTCUSDC": _series()}, quote_balance=10.0)
+        out = ex.place_order("BTCUSDC", "BUY", "MARKET", quantity=100.0)
+        assert out["status"] == "REJECTED"
+
+    def test_stop_order_fills_on_breach(self):
+        s = _series()
+        ex = FakeExchange({"BTCUSDC": s}, quote_balance=1e9, fee_rate=0.0)
+        ex.place_order("BTCUSDC", "BUY", "MARKET", quantity=1.0)
+        px = ex.get_ticker("BTCUSDC")["price"]
+        stop = px * 0.9995
+        ex.place_order("BTCUSDC", "SELL", "STOP_LOSS", 1.0, stop_price=stop)
+        for _ in range(400):
+            ex.advance("BTCUSDC")
+            if not ex.open_orders:
+                break
+        assert not ex.open_orders, "stop should eventually trigger"
+        assert ex.fills[-1]["type"] == "STOP_LOSS"
+
+    def test_order_book_shape(self):
+        ex = FakeExchange({"BTCUSDC": _series()})
+        ob = ex.get_order_book("BTCUSDC", limit=10)
+        assert len(ob["bids"]) == 10 and len(ob["asks"]) == 10
+        assert ob["bids"][0][0] < ob["asks"][0][0]
+
+
+class TestResilience:
+    def test_circuit_breaker_opens_and_recovers(self):
+        clock = VirtualClock()
+        br = CircuitBreaker("t", failure_threshold=2, reset_timeout_s=10,
+                            now_fn=clock)
+        boom = lambda: (_ for _ in ()).throw(RuntimeError("x"))
+        assert br.call(lambda: 42) == 42
+        br.call(boom); br.call(boom)
+        assert br.state.value == "open"
+        assert br.call(lambda: 42) is None            # rejected while open
+        clock.t += 11
+        assert br.call(lambda: 42) == 42              # half-open probe passes
+        assert br.state.value == "closed"
+
+    def test_retry_with_backoff(self):
+        calls = []
+
+        async def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("nope")
+            return "ok"
+
+        async def fast_sleep(_):
+            pass
+
+        out = asyncio.run(retry_with_backoff(flaky, max_retries=5,
+                                             sleep=fast_sleep))
+        assert out == "ok" and len(calls) == 3
+
+    def test_token_bucket(self):
+        clock = VirtualClock()
+        tb = TokenBucket(rate_per_s=1.0, capacity=2.0, now_fn=clock)
+        assert tb.try_acquire() and tb.try_acquire()
+        assert not tb.try_acquire()
+        clock.t += 1.0
+        assert tb.try_acquire()
+
+
+class TestMetrics:
+    def test_exposition(self):
+        m = MetricsRegistry()
+        m.inc("trades_executed_total", symbol="BTCUSDC")
+        m.set_gauge("portfolio_value_usd", 12345.0)
+        with m.measure_time("request_latency_seconds", service="x"):
+            pass
+        text = m.exposition()
+        assert 'crypto_trader_tpu_trades_executed_total{symbol="BTCUSDC"} 1.0' in text
+        assert "crypto_trader_tpu_portfolio_value_usd 12345.0" in text
+        assert "request_latency_seconds_count" in text
+
+    def test_histogram_buckets_valid(self):
+        """+Inf cumulative bucket must equal _count (Prometheus contract)."""
+        m = MetricsRegistry()
+        for v in (0.003, 0.003, 0.2):
+            m.observe("lat", v)
+        text = m.exposition()
+        inf_line = [l for l in text.splitlines() if 'le="+Inf"' in l][0]
+        count_line = [l for l in text.splitlines() if l.startswith(
+            "crypto_trader_tpu_lat_count")][0]
+        assert inf_line.rsplit(" ", 1)[1] == count_line.rsplit(" ", 1)[1] == "3"
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        tree = {"params": {"w": np.ones((3, 2)), "b": np.zeros(2)},
+                "step": np.asarray(7)}
+        p = save_checkpoint(str(tmp_path / "ckpt"), tree, {"note": "hi"})
+        loaded, meta = load_checkpoint(p)
+        np.testing.assert_allclose(loaded["params"]["w"], 1.0)
+        assert int(loaded["step"]) == 7 and meta["note"] == "hi"
+
+
+class TestPipeline:
+    """monitor → analyzer → executor on the fake exchange, virtual clock."""
+
+    def test_end_to_end_trade_flow(self):
+        async def go():
+            clock = VirtualClock()
+            bus = EventBus(now_fn=clock)
+            ex = FakeExchange({"BTCUSDC": _series(seed=12)}, quote_balance=10_000)
+            mon = MarketMonitor(bus, ex, symbols=["BTCUSDC"], now_fn=clock,
+                                kline_limit=128)
+            ana = SignalAnalyzer(bus, now_fn=clock, analysis_interval_s=0.0)
+            # permissive gates so the synthetic series actually trades
+            execu = TradeExecutor(
+                bus, ex, now_fn=clock,
+                trading=TradingParams(ai_confidence_threshold=0.0,
+                                      min_signal_strength=0.0))
+            executed = 0
+            for step in range(300):
+                ex.advance("BTCUSDC")
+                clock.t += 60.0
+                await mon.poll()
+                await ana.run_once()
+                executed += await execu.run_once()
+                # trailing stop maintenance on every tick
+                px = ex.get_ticker("BTCUSDC")["price"]
+                await execu.on_price("BTCUSDC", px)
+            # first kline_limit-1 polls lack a full window (fixed-shape rule)
+            assert bus.published_counts["market_updates"] > 100
+            assert bus.published_counts["trading_signals"] > 100
+            # at least one trade opened end-to-end through the bus
+            assert executed >= 1
+            assert len(ex.fills) >= 1
+            return executed
+
+        asyncio.run(go())
+
+    def test_gates_block_low_confidence(self):
+        async def go():
+            bus = EventBus()
+            ex = FakeExchange({"BTCUSDC": _series()})
+            execu = TradeExecutor(bus, ex)
+            out = await execu.handle_signal({
+                "symbol": "BTCUSDC", "current_price": 100.0, "signal": "BUY",
+                "decision": "BUY", "confidence": 0.3, "signal_strength": 90.0,
+                "volatility": 0.02, "avg_volume": 1e6})
+            assert out is None
+            out = await execu.handle_signal({
+                "symbol": "BTCUSDC", "current_price": 100.0, "signal": "BUY",
+                "decision": "SELL", "confidence": 0.9, "signal_strength": 90.0,
+                "volatility": 0.02, "avg_volume": 1e6})
+            assert out is None
+        asyncio.run(go())
+
+    def test_trade_opens_with_protective_orders(self):
+        async def go():
+            bus = EventBus()
+            ex = FakeExchange({"BTCUSDC": _series()}, quote_balance=10_000)
+            execu = TradeExecutor(bus, ex)
+            trade = await execu.handle_signal({
+                "symbol": "BTCUSDC",
+                "current_price": ex.get_ticker("BTCUSDC")["price"],
+                "signal": "BUY", "decision": "BUY", "confidence": 0.95,
+                "signal_strength": 85.0, "volatility": 0.02, "avg_volume": 1e6})
+            assert trade is not None
+            assert len(ex.open_orders) == 2          # stop + take-profit
+            assert trade.stop_loss_pct > 0
+            # trailing ratchet replaces the stop order on a strong move up
+            old_stop_id = trade.stop_order_id
+            await execu.on_price("BTCUSDC", trade.entry_price * 1.05)
+            assert execu.active_trades["BTCUSDC"].stop_order_id != old_stop_id
+        asyncio.run(go())
+
+    def test_max_positions_cap(self):
+        async def go():
+            bus = EventBus()
+            series = {f"S{i}USDC": _series(seed=i, symbol=f"S{i}USDC") for i in range(7)}
+            ex = FakeExchange(series, quote_balance=100_000)
+            execu = TradeExecutor(bus, ex,
+                                  trading=TradingParams(max_positions=2))
+            opened = 0
+            for i in range(7):
+                sym = f"S{i}USDC"
+                t = await execu.handle_signal({
+                    "symbol": sym, "current_price": ex.get_ticker(sym)["price"],
+                    "signal": "BUY", "decision": "BUY", "confidence": 0.95,
+                    "signal_strength": 85.0, "volatility": 0.02,
+                    "avg_volume": 1e6})
+                opened += t is not None
+            assert opened == 2
+        asyncio.run(go())
+
+    def test_tp_fill_reconciled_not_double_sold(self):
+        """A server-side TP fill must finalize the trade instead of leaving
+        it active and double-selling later."""
+        async def go():
+            bus = EventBus()
+            s = _series()
+            ex = FakeExchange({"BTCUSDC": s}, quote_balance=10_000, fee_rate=0.0)
+            execu = TradeExecutor(bus, ex)
+            trade = await execu.handle_signal({
+                "symbol": "BTCUSDC",
+                "current_price": ex.get_ticker("BTCUSDC")["price"],
+                "signal": "BUY", "decision": "BUY", "confidence": 0.95,
+                "signal_strength": 85.0, "volatility": 0.02, "avg_volume": 1e6})
+            # march candles until one protective order fills
+            for _ in range(500):
+                ex.advance("BTCUSDC")
+                if len(ex.open_orders) < 2:
+                    break
+            assert len(ex.open_orders) < 2, "a protective order should fill"
+            base_before = ex.get_balances().get("BTC", 0.0)
+            await execu.on_price("BTCUSDC", ex.get_ticker("BTCUSDC")["price"])
+            assert "BTCUSDC" not in execu.active_trades
+            assert execu.closed_trades[-1]["reason"] in ("Take Profit", "Stop Loss")
+            # no second market sell happened
+            np.testing.assert_allclose(ex.get_balances().get("BTC", 0.0),
+                                       base_before, atol=1e-9)
+            assert not ex.open_orders     # sibling canceled
+        asyncio.run(go())
+
+    def test_close_trade_records_pnl(self):
+        async def go():
+            bus = EventBus()
+            ex = FakeExchange({"BTCUSDC": _series()}, quote_balance=10_000)
+            execu = TradeExecutor(bus, ex)
+            trade = await execu.handle_signal({
+                "symbol": "BTCUSDC",
+                "current_price": ex.get_ticker("BTCUSDC")["price"],
+                "signal": "BUY", "decision": "BUY", "confidence": 0.95,
+                "signal_strength": 85.0, "volatility": 0.02, "avg_volume": 1e6})
+            await execu.close_trade("BTCUSDC", trade.entry_price * 1.02, "Take Profit")
+            assert not execu.active_trades
+            rec = execu.closed_trades[-1]
+            assert rec["reason"] == "Take Profit"
+            np.testing.assert_allclose(
+                rec["pnl"], trade.entry_price * 0.02 * trade.quantity, rtol=1e-5)
+        asyncio.run(go())

@@ -1,0 +1,285 @@
+"""Strategy layer: evaluation metrics, CV, comparison, selection scoring,
+evolution dispatch + hot swap, registry lifecycle, explainability,
+grid / DCA / arbitrage."""
+
+import asyncio
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu.backtest import default_params
+from ai_crypto_trader_tpu.config import EvolutionParams, GAParams
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.strategy import (
+    DCAStrategy,
+    GridTrader,
+    ModelRegistry,
+    StrategyEvolver,
+    StrategySelector,
+    compare_strategies,
+    cross_validate,
+    explain_signal,
+    find_triangle_arbitrage,
+    trade_metrics,
+)
+
+
+def _arrays(n=1024, seed=7):
+    d = generate_ohlcv(n=n, seed=seed)
+    return {k: jnp.asarray(v) for k, v in d.items() if k != "regime"}
+
+
+class TestTradeMetrics:
+    TRADES = [{"pnl": p, "symbol": "BTCUSDC"} for p in
+              [50, -20, 30, -10, 40, -20, 25, 60, -15, 10]]
+
+    def test_suite_values(self):
+        m = trade_metrics(self.TRADES, initial_balance=1000.0)
+        assert m["total_trades"] == 10
+        assert m["winning_trades"] == 6
+        np.testing.assert_allclose(m["win_rate"], 60.0)
+        np.testing.assert_allclose(m["profit_factor"], 215 / 65, rtol=1e-6)
+        np.testing.assert_allclose(m["total_pnl"], 150.0)
+        assert m["max_win_streak"] == 2
+        assert m["max_loss_streak"] == 1
+        assert m["sharpe_ratio"] > 0
+        assert m["symbol_pnl"]["BTCUSDC"] == 150.0
+
+    def test_empty(self):
+        m = trade_metrics([])
+        assert m["total_trades"] == 0 and m["sharpe_ratio"] == 0.0
+
+
+class TestCVAndComparison:
+    def test_cross_validate(self):
+        out = cross_validate(_arrays(), default_params(), k=3)
+        assert len(out["folds"]) == 3
+        assert set(f["regime"] for f in out["folds"]) <= {
+            "bull", "bear", "ranging", "volatile"}
+        assert np.isfinite(out["mean_sharpe"])
+
+    def test_compare(self):
+        import jax
+        from ai_crypto_trader_tpu.backtest import sample_params
+        p = sample_params(jax.random.PRNGKey(0), 3)
+        named = {f"s{i}": jax.tree.map(lambda x: x[i], p) for i in range(3)}
+        out = compare_strategies(_arrays(n=512), named)
+        assert len(out["table"]) == 3
+        assert out["best"] == out["ranked"][0]
+        best, worst = out["ranked"][0], out["ranked"][-1]
+        assert (out["table"][best]["sharpe_ratio"]
+                >= out["table"][worst]["sharpe_ratio"])
+
+
+class TestSelector:
+    def test_regime_preference(self):
+        sel = StrategySelector()
+        strategies = [
+            {"worker_id": "trend", "archetype": "trend_following",
+             "metrics": {"sharpe_ratio": 1.0, "max_drawdown_pct": 5}},
+            {"worker_id": "grid", "archetype": "grid",
+             "metrics": {"sharpe_ratio": 1.0, "max_drawdown_pct": 5}},
+        ]
+        bull = sel.select(strategies, regime="bull")
+        rang = sel.select(strategies, regime="ranging")
+        assert bull["worker_id"] == "trend"
+        assert rang["worker_id"] == "grid"
+
+    def test_cooldown_blocks_switch(self):
+        clock = [0.0]
+        sel = StrategySelector(switch_cooldown_s=100, now_fn=lambda: clock[0])
+        sel.record_switch("a")
+        assert not sel.should_switch(0.5, 0.9)
+        clock[0] += 101
+        assert sel.should_switch(0.5, 0.9)
+        assert not sel.should_switch(0.5, 0.55)  # below min edge
+
+
+class TestEvolver:
+    def test_needs_improvement_thresholds(self):
+        ev = StrategyEvolver(EventBus(), cfg=EvolutionParams())
+        assert ev.needs_improvement({"sharpe_ratio": 0.5, "win_rate": 60,
+                                     "profit_factor": 2, "max_drawdown_pct": 5})
+        assert not ev.needs_improvement({"sharpe_ratio": 2.0, "win_rate": 60,
+                                         "profit_factor": 2.0,
+                                         "max_drawdown_pct": 5})
+
+    def test_dispatch(self):
+        ev = StrategyEvolver(EventBus())
+        assert ev.pick_method("volatile", 0) == "rl"
+        assert ev.pick_method("bull", 50) == "ga"
+        assert ev.pick_method("ranging", 0) == "llm"
+        assert ev.pick_method("bear", 0) == "ga"
+
+    def test_evolve_llm_path_and_hot_swap(self):
+        async def go():
+            bus = EventBus()
+            reg = ModelRegistry()
+            ev = StrategyEvolver(bus, registry=reg)
+            q = bus.subscribe("strategy_update")
+            out = await ev.evolve(_arrays(n=256), regime="ranging",
+                                  metrics={"sharpe_ratio": 0.0, "win_rate": 0,
+                                           "profit_factor": 0,
+                                           "max_drawdown_pct": 50})
+            assert out["evolved"] and out["method"] == "llm"
+            assert bus.get("strategy_params") is not None
+            env = q.get_nowait()
+            assert "params" in env["data"]
+            assert out["version"] in reg.entries
+        asyncio.run(go())
+
+    def test_evolve_ga_path(self):
+        async def go():
+            bus = EventBus()
+            cfg = EvolutionParams(ga=GAParams(population_size=4, generations=1))
+            ev = StrategyEvolver(bus, cfg=cfg)
+            out = await ev.evolve(_arrays(n=256), regime="bull",
+                                  history_length=30)
+            assert out["evolved"] and out["method"] == "ga"
+        asyncio.run(go())
+
+    def test_regime_adjustment_clamped(self):
+        from ai_crypto_trader_tpu.strategy.evolution import adjust_for_regime
+        from ai_crypto_trader_tpu.backtest.strategy import PARAM_RANGES
+        p = adjust_for_regime(default_params(), "volatile")
+        for name, (lo, hi, _) in PARAM_RANGES.items():
+            v = float(getattr(p, name))
+            assert lo - 1e-6 <= v <= hi + 1e-6, name
+
+
+class TestRegistry:
+    def test_lifecycle_and_dedup(self, tmp_path):
+        reg = ModelRegistry(path=str(tmp_path / "reg.json"))
+        v1 = reg.register("strategy_params", {"a": 1.0, "b": 2.0})
+        v_dup = reg.register("strategy_params", {"a": 1.0001, "b": 2.0001})
+        assert v_dup == v1                     # near-duplicate suppressed
+        v2 = reg.register("strategy_params", {"a": -5.0, "b": 9.0})
+        assert v2 != v1
+        reg.update_performance(v1, {"sharpe_ratio": 1.0})
+        reg.update_performance(v2, {"sharpe_ratio": 2.0})
+        assert reg.best("strategy_params")["version"] == v2
+        reg.set_status(v2, "retired")
+        assert reg.best("strategy_params")["version"] == v1
+        cmp = reg.compare([v1, v2])
+        assert cmp["best"] == v2
+        # persistence round-trip
+        reg2 = ModelRegistry(path=str(tmp_path / "reg.json"))
+        assert v1 in reg2.entries
+
+
+class TestExplain:
+    def test_structure_and_artifact(self, tmp_path):
+        out = explain_signal({"symbol": "BTCUSDC", "decision": "BUY",
+                              "rsi": 28.0, "stoch_k": 15.0, "macd": 0.5,
+                              "avg_volume": 2e5, "trend": "uptrend",
+                              "trend_strength": 12.0, "confidence": 0.8},
+                             out_dir=str(tmp_path))
+        assert "rsi" in out["supporting_factors"]
+        assert "stochastic" in out["supporting_factors"]
+        assert sum(f["weight"] for f in out["factors"].values()) == 1.0
+        assert "BUY" in out["narrative"]
+        import os
+        assert os.path.exists(out["artifact"])
+
+
+class TestGrid:
+    def test_levels(self):
+        from ai_crypto_trader_tpu.strategy.grid import generate_grid_levels
+        ar = generate_grid_levels(100, 200, 10, "arithmetic")
+        assert len(ar) == 11
+        np.testing.assert_allclose(np.diff(ar), 10.0)
+        geo = generate_grid_levels(100, 400, 4, "geometric")
+        np.testing.assert_allclose(geo[1] / geo[0], geo[2] / geo[1], rtol=1e-9)
+
+    def test_round_trip_profit(self):
+        g = GridTrader(lower=90, upper=110, n_grids=10, order_size=100,
+                       fee_rate=0.0)
+        out1 = g.step_simulation(high=100.0, low=94.9)   # fills buys ≤ 100
+        assert out1["buys"] >= 2
+        out2 = g.step_simulation(high=105.0, low=99.0)   # sells levels below 105
+        assert out2["sells"] >= 1 and out2["pnl"] > 0
+        assert g.realized_pnl > 0
+
+    def test_oscillating_market_harvests(self):
+        t = np.linspace(0, 8 * np.pi, 500)
+        mid = 100 + 8 * np.sin(t)
+        g = GridTrader(lower=88, upper=112, n_grids=12, fee_rate=0.0005)
+        out = g.run_simulation(mid + 0.5, mid - 0.5)
+        assert out["round_trips"] > 5
+        assert out["realized_pnl"] > 0
+
+    def test_regime_adaptive_counts(self):
+        close = np.linspace(95, 105, 600)
+        g = GridTrader.for_regime(close, "ranging")
+        assert g.n_grids == 14
+        g2 = GridTrader.for_regime(close, "volatile")
+        assert g2.n_grids == 6
+
+
+class TestDCA:
+    def test_scheduling_and_dip_boost(self):
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from tests.test_shell import _series
+        ex = FakeExchange({"BTCUSDC": _series()}, quote_balance=100_000)
+        dca = DCAStrategy(base_amount=100, interval_s=3600)
+        r1 = dca.maybe_purchase(ex, now=0.0)
+        assert r1 is not None
+        assert dca.maybe_purchase(ex, now=100.0) is None       # within interval
+        r2 = dca.maybe_purchase(ex, now=3601.0)
+        assert r2 is not None
+        assert dca.average_cost() > 0
+
+    def test_dip_multiplier(self):
+        dca = DCAStrategy(base_amount=100, dip_threshold_pct=5, dip_multiplier=2)
+        normal = dca.purchase_amount(price=100, recent_high=102)
+        dip = dca.purchase_amount(price=94, recent_high=100)
+        assert normal == 100 and dip == 200
+
+    def test_value_averaging(self):
+        dca = DCAStrategy(schedule="value_averaging", target_value_growth=100)
+        assert dca.purchase_amount(100, 100, holdings_value=0) == 100
+        dca.purchases.append({"price": 100, "quantity": 1, "amount": 100, "t": 0})
+        # period 2 target 200, holdings now worth 150 → buy 50
+        assert dca.purchase_amount(150, 150, holdings_value=150) == 50
+
+    def test_rebalance(self):
+        orders = DCAStrategy.rebalance_orders(
+            holdings={"BTC": 1.0, "ETH": 0.0},
+            prices={"BTC": 100.0, "ETH": 10.0},
+            targets={"BTC": 0.5, "ETH": 0.5})
+        sides = {o["symbol"]: o["side"] for o in orders}
+        assert sides == {"BTCUSDC": "SELL", "ETHUSDC": "BUY"}
+
+
+class TestArbitrage:
+    def test_finds_planted_cycle(self):
+        # USDC→BTC→ETH→USDC with a 1% planted edge
+        tickers = {
+            "BTCUSDC": {"bid": 100.0, "ask": 100.0},
+            "ETHUSDC": {"bid": 10.1, "ask": 10.1},
+            "ETHBTC": {"bid": 0.1, "ask": 0.1},
+        }
+        out = find_triangle_arbitrage(tickers, ["USDC", "BTC", "ETH"],
+                                      fee_rate=0.0, min_profit_pct=0.1)
+        assert out, "planted arbitrage must be found"
+        assert out[0]["profit_pct"] == pytest.approx(1.0, rel=1e-3)
+
+    def test_fees_kill_marginal_cycle(self):
+        tickers = {
+            "BTCUSDC": {"bid": 100.0, "ask": 100.0},
+            "ETHUSDC": {"bid": 10.02, "ask": 10.02},
+            "ETHBTC": {"bid": 0.1, "ask": 0.1},
+        }
+        out = find_triangle_arbitrage(tickers, ["USDC", "BTC", "ETH"],
+                                      fee_rate=0.001, min_profit_pct=0.0)
+        assert not out                        # 0.2% gross < 0.3% fees
+
+    def test_executable_volume(self):
+        from ai_crypto_trader_tpu.strategy.arbitrage import executable_volume
+        books = [{"asks": [[100, 5]], "bids": []},
+                 {"asks": [], "bids": [[10, 20]]},
+                 {"asks": [[0.1, 1000]], "bids": []}]
+        v = executable_volume(books, ["BUY", "SELL", "BUY"])
+        assert v == pytest.approx(100.0)      # binding leg: 0.1 × 1000
