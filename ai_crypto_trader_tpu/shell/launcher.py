@@ -1,0 +1,129 @@
+"""The all-in-one launcher — `run_trader.py` re-designed.
+
+The reference launches 14 daemon threads each spinning a private asyncio
+loop plus an AutoTrader and a 5-second status printer
+(`run_trader.py:1326-1494`).  Here every service is an async task on ONE
+event loop sharing ONE bus (no GIL-bound thread zoo), with the numeric work
+already living inside jit on the device:
+
+    monitor → analyzer → executor            (the live signal path)
+    evolver                                  (periodic strategy evolution)
+    alerts + metrics + dashboard             (observability)
+
+`TradingSystem.tick()` advances everything once (deterministic, used by
+tests and paper-mode stepping); `run()` is the wall-clock loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from ai_crypto_trader_tpu.config import FrameworkConfig
+from ai_crypto_trader_tpu.shell.analyzer import SignalAnalyzer
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.shell.dashboard import write_dashboard
+from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
+from ai_crypto_trader_tpu.shell.executor import TradeExecutor
+from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
+from ai_crypto_trader_tpu.utils.alerts import AlertManager
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+
+@dataclass
+class TradingSystem:
+    exchange: ExchangeInterface
+    symbols: list[str]
+    config: FrameworkConfig = field(default_factory=FrameworkConfig)
+    now_fn: any = time.time
+    dashboard_path: str | None = None
+
+    def __post_init__(self):
+        self.bus = EventBus(now_fn=self.now_fn)
+        self.metrics = MetricsRegistry(now_fn=self.now_fn)
+        self.alerts = AlertManager(now_fn=self.now_fn)
+        self.monitor = MarketMonitor(self.bus, self.exchange,
+                                     symbols=self.symbols, now_fn=self.now_fn)
+        self.analyzer = SignalAnalyzer(
+            self.bus, now_fn=self.now_fn,
+            analysis_interval_s=self.config.trading.ai_analysis_interval)
+        self.executor = TradeExecutor(self.bus, self.exchange,
+                                      trading=self.config.trading,
+                                      trailing=self.config.risk.trailing_stop,
+                                      now_fn=self.now_fn)
+        # subscribe before any publish so tick-0 messages aren't missed
+        self.analyzer._queue()
+        self.executor._queue()
+        self._last_market_update = self.now_fn()
+
+    async def tick(self) -> dict:
+        """One full pass of the live signal path + observability."""
+        published = await self.monitor.poll()
+        analyzed = await self.analyzer.run_once()
+        executed = await self.executor.run_once()
+        if published:
+            self._last_market_update = self.now_fn()
+        for symbol in self.symbols:
+            md = self.bus.get(f"market_data_{symbol}")
+            if md and symbol in self.executor.active_trades:
+                await self.executor.on_price(symbol, md["current_price"])
+
+        balances = self.exchange.get_balances()
+        # total portfolio value: quote balances + base holdings marked at the
+        # latest price (free USDC alone would show a phantom loss while a
+        # position is open)
+        total = sum(v for a, v in balances.items()
+                    if a in ("USDC", "USDT", "BUSD"))
+        for symbol in self.symbols:
+            md = self.bus.get(f"market_data_{symbol}")
+            base = symbol
+            for q in ("USDC", "USDT", "BUSD"):
+                if symbol.endswith(q):
+                    base = symbol[: -len(q)]
+                    break
+            if md and balances.get(base):
+                total += balances[base] * md["current_price"]
+        self.metrics.set_gauge("portfolio_value_usd", total)
+        self.metrics.set_gauge("open_positions", len(self.executor.active_trades))
+
+        fired = self.alerts.evaluate({
+            "market_data_age_s": self.now_fn() - self._last_market_update,
+            "open_positions": len(self.executor.active_trades),
+            "max_positions": self.config.trading.max_positions,
+        })
+        for alert in fired:
+            await self.bus.publish("alerts", alert)
+        if self.dashboard_path:
+            self._render_dashboard()
+        return {"published": published, "analyzed": analyzed,
+                "executed": executed, "alerts": len(fired)}
+
+    def _render_dashboard(self):
+        sym = self.symbols[0]
+        klines = self.bus.get(f"historical_data_{sym}_1m") or []
+        prices = [row[4] for row in klines] if klines else None
+        write_dashboard(self.dashboard_path, bus=self.bus,
+                        price_series=prices,
+                        alerts=list(self.alerts.active.values()),
+                        now_fn=self.now_fn)
+
+    def status(self) -> dict:
+        """`print_status` parity (`run_trader.py:39`)."""
+        return {
+            "balances": self.exchange.get_balances(),
+            "active_trades": {s: t.entry_price
+                              for s, t in self.executor.active_trades.items()},
+            "closed_trades": len(self.executor.closed_trades),
+            "total_pnl": sum(t["pnl"] for t in self.executor.closed_trades),
+            "alerts": list(self.alerts.active),
+            "channels": dict(self.bus.published_counts),
+        }
+
+    async def run(self, duration_s: float | None = None,
+                  tick_interval_s: float = 5.0):
+        """Wall-clock loop (the `while running` of run_trader.py:1492)."""
+        start = self.now_fn()
+        while duration_s is None or self.now_fn() - start < duration_s:
+            await self.tick()
+            await asyncio.sleep(tick_interval_s)

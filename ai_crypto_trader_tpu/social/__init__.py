@@ -1,0 +1,12 @@
+from ai_crypto_trader_tpu.social.analyzer import (  # noqa: F401
+    adaptive_source_weights,
+    detect_anomalies,
+    fit_anomaly_model,
+    lead_lag_correlation,
+    normalize_metrics,
+    sentiment_accuracy,
+)
+from ai_crypto_trader_tpu.social.news import (  # noqa: F401
+    NewsAnalyzer,
+    lexicon_sentiment,
+)

@@ -1,0 +1,124 @@
+"""Social-metrics analytics: normalization, anomaly detection, lead/lag
+cross-correlation, sentiment accuracy, adaptive source weights.
+
+Capability parity with SocialMetricsAnalyzer
+(`services/utils/social_metrics_analyzer.py`):
+  * metric normalization (:76) — robust min-max over a rolling history;
+  * anomaly model train/detect (:175-290) — the sklearn IsolationForest is
+    replaced by a Mahalanobis-distance detector (mean + covariance fit, χ²
+    threshold): pure linalg, jit-compiled, same contamination semantics;
+  * social↔price lead/lag cross-correlation over ±24 h of lags (:321-456)
+    as one vectorized gather instead of a Python lag loop;
+  * sentiment directional accuracy vs subsequent price moves (:457-634);
+  * adaptive source weights from per-source accuracy (:635).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def normalize_metrics(x: jnp.ndarray) -> jnp.ndarray:
+    """[T, F] → [0, 1] per feature using 5th/95th percentile bounds
+    (robust to the outliers social feeds are full of)."""
+    lo = jnp.percentile(x, 5.0, axis=0)
+    hi = jnp.percentile(x, 95.0, axis=0)
+    rng = jnp.where(hi - lo == 0.0, 1.0, hi - lo)
+    return jnp.clip((x - lo) / rng, 0.0, 1.0)
+
+
+class AnomalyModel(NamedTuple):
+    mean: jnp.ndarray       # [F]
+    prec: jnp.ndarray       # [F, F] inverse covariance
+    threshold: jnp.ndarray  # squared-distance cutoff
+
+
+@functools.partial(jax.jit, static_argnames=())
+def fit_anomaly_model(x: jnp.ndarray, contamination: float = 0.05) -> AnomalyModel:
+    """Fit on [T, F] history; threshold set so `contamination` of the
+    training data is flagged (IsolationForest-equivalent contract)."""
+    mean = jnp.mean(x, axis=0)
+    xc = x - mean
+    cov = xc.T @ xc / x.shape[0] + 1e-6 * jnp.eye(x.shape[1])
+    prec = jnp.linalg.inv(cov)
+    d2 = jnp.einsum("tf,fg,tg->t", xc, prec, xc)
+    threshold = jnp.percentile(d2, 100.0 * (1.0 - contamination))
+    return AnomalyModel(mean, prec, threshold)
+
+
+@jax.jit
+def detect_anomalies(model: AnomalyModel, x: jnp.ndarray):
+    """Returns (is_anomaly [T] bool, score [T] — distance / threshold)."""
+    xc = x - model.mean
+    d2 = jnp.einsum("tf,fg,tg->t", xc, model.prec, xc)
+    return d2 > model.threshold, d2 / jnp.maximum(model.threshold, 1e-9)
+
+
+@functools.partial(jax.jit, static_argnames=("max_lag",))
+def lead_lag_correlation(social: jnp.ndarray, returns: jnp.ndarray,
+                         max_lag: int = 24):
+    """Pearson correlation of social[t-lag] vs returns[t] for lag ∈
+    [-max_lag, max_lag] (positive lag = social LEADS price).
+
+    Returns (lags, correlations); the argmax lag is the detected lead
+    (`social_metrics_analyzer.py:321-456`)."""
+    T = social.shape[0]
+    lags = jnp.arange(-max_lag, max_lag + 1)
+
+    def corr_at(lag):
+        s = jnp.roll(social, lag)
+        t = jnp.arange(T)
+        mask = (t >= jnp.maximum(lag, 0)) & (t < T + jnp.minimum(lag, 0))
+        w = mask.astype(jnp.float32)
+        n = jnp.maximum(jnp.sum(w), 1.0)
+        ms = jnp.sum(s * w) / n
+        mr = jnp.sum(returns * w) / n
+        cov = jnp.sum((s - ms) * (returns - mr) * w) / n
+        vs = jnp.sum((s - ms) ** 2 * w) / n
+        vr = jnp.sum((returns - mr) ** 2 * w) / n
+        denom = jnp.sqrt(vs * vr)
+        return jnp.where(denom > 0, cov / denom, 0.0)
+
+    return lags, jax.vmap(corr_at)(lags)
+
+
+@functools.partial(jax.jit, static_argnames=("horizon",))
+def sentiment_accuracy(sentiment: jnp.ndarray, close: jnp.ndarray,
+                       horizon: int = 12, neutral_band: float = 0.05):
+    """Directional hit rate: bullish sentiment (>0.5+band) predicting an
+    up-move over `horizon`, bearish predicting down
+    (`social_metrics_analyzer.py:457-634`)."""
+    fwd = jnp.roll(close, -horizon) / close - 1.0
+    t = jnp.arange(close.shape[0])
+    valid = t < close.shape[0] - horizon
+    bullish = sentiment > 0.5 + neutral_band
+    bearish = sentiment < 0.5 - neutral_band
+    decided = (bullish | bearish) & valid
+    correct = (bullish & (fwd > 0)) | (bearish & (fwd < 0))
+    n = jnp.maximum(jnp.sum(decided), 1)
+    return {
+        "accuracy": jnp.sum(correct & decided) / n,
+        "n_calls": jnp.sum(decided),
+        "coverage": jnp.sum(decided) / jnp.maximum(jnp.sum(valid), 1),
+    }
+
+
+def adaptive_source_weights(per_source_sentiment: dict[str, np.ndarray],
+                            close: np.ndarray, horizon: int = 12,
+                            floor: float = 0.05) -> dict[str, float]:
+    """Re-weight sources by their directional accuracy (:635): weight ∝
+    max(accuracy - 0.5, floor) so a coin-flip source decays toward the
+    floor rather than zero."""
+    close_j = jnp.asarray(close)
+    raw = {}
+    for name, s in per_source_sentiment.items():
+        acc = float(sentiment_accuracy(jnp.asarray(s), close_j, horizon)["accuracy"])
+        raw[name] = max(acc - 0.5, floor)
+    total = sum(raw.values())
+    return {k: v / total for k, v in raw.items()}

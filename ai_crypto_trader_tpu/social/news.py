@@ -1,0 +1,203 @@
+"""News analysis: sentiment, entities, topics, summaries, market impact.
+
+Capability parity with NewsAnalysisService + NewsAnalyzer
+(`services/news_analysis_service.py`, `services/utils/news_analyzer.py`):
+  * sentiment scoring (:409-501) — the VADER dependency is replaced by a
+    built-in crypto-tuned lexicon with negation and intensifier handling
+    (same output range: compound ∈ [-1, 1]); an optional transformers
+    pipeline can be injected where available;
+  * entity extraction (:502-560) — asset/ticker recognition over a symbol
+    table + $TICKER / capitalized-name patterns;
+  * topic extraction (:561-595) — keyword buckets (regulation, adoption,
+    hacks, defi, etfs, macro, mining, stablecoins);
+  * extractive summarization (:596-640) — frequency-scored sentences;
+  * market-impact score (config.json:612-623) — relevance × recency ×
+    sentiment-magnitude weighted blend.
+
+Source fetching (CryptoPanic / RSS / LunarCrush, :144-370) is network I/O
+and is injected: the analyzer consumes article dicts from any provider.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import time
+from dataclasses import dataclass, field
+
+POSITIVE = {
+    "surge": 2.0, "rally": 2.0, "bullish": 2.5, "gain": 1.5, "gains": 1.5,
+    "soar": 2.5, "soars": 2.5, "adoption": 1.5, "approval": 2.0,
+    "approve": 2.0, "approved": 2.0, "partnership": 1.5, "upgrade": 1.5,
+    "breakout": 1.5, "record": 1.5, "high": 1.0, "growth": 1.5,
+    "institutional": 1.0, "etf": 1.0, "halving": 0.5, "moon": 2.0,
+    "profit": 1.5, "win": 1.0, "success": 1.5, "launch": 1.0,
+    "integration": 1.0, "support": 0.5, "recover": 1.5, "recovery": 1.5,
+}
+NEGATIVE = {
+    "crash": -2.5, "plunge": -2.5, "plunges": -2.5, "bearish": -2.5,
+    "dump": -2.0, "hack": -2.5, "hacked": -2.5, "exploit": -2.0,
+    "scam": -2.5, "fraud": -2.5, "ban": -2.0, "banned": -2.0,
+    "lawsuit": -1.5, "sec": -0.5, "crackdown": -2.0, "selloff": -2.0,
+    "liquidation": -1.5, "liquidations": -1.5, "fear": -1.5, "fud": -1.5,
+    "collapse": -2.5, "bankruptcy": -2.5, "insolvent": -2.5, "loss": -1.5,
+    "losses": -1.5, "drop": -1.5, "drops": -1.5, "decline": -1.5,
+    "warning": -1.0, "risk": -0.5, "delay": -1.0, "outage": -1.5,
+}
+NEGATORS = {"not", "no", "never", "without", "barely", "hardly"}
+INTENSIFIERS = {"very": 1.5, "extremely": 2.0, "massive": 1.8, "huge": 1.6,
+                "slightly": 0.5, "somewhat": 0.7}
+
+KNOWN_ASSETS = {
+    "bitcoin": "BTC", "btc": "BTC", "ethereum": "ETH", "eth": "ETH",
+    "solana": "SOL", "sol": "SOL", "ripple": "XRP", "xrp": "XRP",
+    "dogecoin": "DOGE", "doge": "DOGE", "cardano": "ADA", "ada": "ADA",
+    "binance": "BNB", "bnb": "BNB", "polygon": "MATIC", "matic": "MATIC",
+    "avalanche": "AVAX", "avax": "AVAX", "chainlink": "LINK", "link": "LINK",
+    "litecoin": "LTC", "ltc": "LTC", "polkadot": "DOT", "dot": "DOT",
+}
+
+TOPIC_KEYWORDS = {
+    "regulation": {"sec", "regulation", "regulatory", "ban", "lawsuit",
+                   "compliance", "crackdown", "license"},
+    "adoption": {"adoption", "partnership", "integration", "institutional",
+                 "payment", "merchant"},
+    "security": {"hack", "hacked", "exploit", "breach", "scam", "fraud",
+                 "vulnerability", "stolen"},
+    "defi": {"defi", "liquidity", "yield", "staking", "protocol", "dex"},
+    "etf": {"etf", "fund", "blackrock", "fidelity", "approval"},
+    "macro": {"fed", "inflation", "rates", "recession", "dollar", "cpi"},
+    "mining": {"mining", "miner", "miners", "hashrate", "halving"},
+    "stablecoins": {"stablecoin", "usdt", "usdc", "tether", "peg", "depeg"},
+}
+
+_WORD = re.compile(r"[a-z$][a-z0-9$]*")
+
+
+def lexicon_sentiment(text: str) -> dict:
+    """Compound ∈ [-1,1] + pos/neg/neu fractions — VADER-shaped output
+    (`news_analyzer.py:409-501`)."""
+    words = _WORD.findall(text.lower())
+    score, pos_n, neg_n = 0.0, 0, 0
+    for i, w in enumerate(words):
+        val = POSITIVE.get(w, 0.0) + NEGATIVE.get(w, 0.0)
+        if val == 0.0:
+            continue
+        mult = 1.0
+        window = words[max(i - 2, 0): i]
+        if any(x in NEGATORS for x in window):
+            mult = -0.8
+        for x in window:
+            mult *= INTENSIFIERS.get(x, 1.0)
+        val *= mult
+        score += val
+        if val > 0:
+            pos_n += 1
+        elif val < 0:
+            neg_n += 1
+    n = max(len(words), 1)
+    compound = math.tanh(score / 4.0)
+    return {"compound": compound, "pos": pos_n / n, "neg": neg_n / n,
+            "neu": 1.0 - (pos_n + neg_n) / n}
+
+
+def extract_entities(text: str) -> list[str]:
+    """Asset mentions: known names/tickers + $TICKER patterns
+    (`news_analyzer.py:502-560`)."""
+    found = []
+    lower = text.lower()
+    for name, ticker in KNOWN_ASSETS.items():
+        if re.search(rf"\b{re.escape(name)}\b", lower) and ticker not in found:
+            found.append(ticker)
+    for m in re.findall(r"\$([A-Z]{2,6})\b", text):
+        if m not in found:
+            found.append(m)
+    return found
+
+
+def extract_topics(text: str) -> list[str]:
+    words = set(_WORD.findall(text.lower()))
+    return [topic for topic, kws in TOPIC_KEYWORDS.items() if words & kws]
+
+
+def summarize(text: str, max_sentences: int = 2) -> str:
+    """Extractive summary: sentences ranked by normalized word-frequency
+    score (`news_analyzer.py:596-640`)."""
+    sentences = re.split(r"(?<=[.!?])\s+", text.strip())
+    if len(sentences) <= max_sentences:
+        return text.strip()
+    freqs: dict[str, int] = {}
+    for w in _WORD.findall(text.lower()):
+        if len(w) > 3:
+            freqs[w] = freqs.get(w, 0) + 1
+    def score(s):
+        ws = [w for w in _WORD.findall(s.lower()) if len(w) > 3]
+        return sum(freqs.get(w, 0) for w in ws) / max(len(ws), 1)
+    ranked = sorted(range(len(sentences)), key=lambda i: -score(sentences[i]))
+    keep = sorted(ranked[:max_sentences])
+    return " ".join(sentences[i] for i in keep)
+
+
+@dataclass
+class NewsAnalyzer:
+    """Analyze article dicts {'title', 'body'?, 'published_at'?, 'source'?}."""
+
+    relevance_weight: float = 0.4     # config.json:612-623 blend
+    recency_weight: float = 0.3
+    sentiment_weight: float = 0.3
+    recency_half_life_h: float = 12.0
+    now_fn: any = time.time
+    transformer_pipeline: any = None  # optional injected HF pipeline
+
+    def analyze_article(self, article: dict, symbol_asset: str | None = None) -> dict:
+        text = " ".join(filter(None, [article.get("title", ""),
+                                      article.get("body", "")]))
+        if self.transformer_pipeline is not None:
+            out = self.transformer_pipeline(text[:512])[0]
+            sign = {"POS": 1, "NEU": 0, "NEG": -1}.get(out["label"][:3].upper(), 0)
+            sent = {"compound": sign * float(out["score"]),
+                    "pos": 0.0, "neg": 0.0, "neu": 1.0}
+        else:
+            sent = lexicon_sentiment(text)
+        entities = extract_entities(text)
+        topics = extract_topics(text)
+
+        relevance = 1.0 if (symbol_asset and symbol_asset in entities) else \
+            (0.5 if entities else 0.2)
+        age_h = max((self.now_fn() - article.get("published_at", self.now_fn()))
+                    / 3600.0, 0.0)
+        recency = 0.5 ** (age_h / self.recency_half_life_h)
+        impact = (self.relevance_weight * relevance
+                  + self.recency_weight * recency
+                  + self.sentiment_weight * abs(sent["compound"]))
+        return {
+            "sentiment": sent, "entities": entities, "topics": topics,
+            "summary": summarize(text), "relevance": relevance,
+            "recency": recency, "market_impact": impact,
+            "direction": ("bullish" if sent["compound"] > 0.05 else
+                          "bearish" if sent["compound"] < -0.05 else "neutral"),
+        }
+
+    def aggregate(self, articles: list[dict], symbol_asset: str | None = None) -> dict:
+        """Impact-weighted aggregate sentiment for a symbol — the shape the
+        analyzer service publishes per symbol."""
+        if not articles:
+            return {"sentiment": 0.0, "n_articles": 0, "top_topics": [],
+                    "market_impact": 0.0}
+        analyses = [self.analyze_article(a, symbol_asset) for a in articles]
+        weights = [a["market_impact"] for a in analyses]
+        total_w = sum(weights) or 1.0
+        sentiment = sum(a["sentiment"]["compound"] * w
+                        for a, w in zip(analyses, weights)) / total_w
+        topic_counts: dict[str, int] = {}
+        for a in analyses:
+            for t in a["topics"]:
+                topic_counts[t] = topic_counts.get(t, 0) + 1
+        return {
+            "sentiment": sentiment,
+            "n_articles": len(articles),
+            "top_topics": sorted(topic_counts, key=topic_counts.get,
+                                 reverse=True)[:3],
+            "market_impact": max(weights),
+            "analyses": analyses,
+        }

