@@ -1,0 +1,134 @@
+"""Combined technical indicators — confirmation scores across indicators.
+
+Capability parity with IndicatorCombinations
+(`services/utils/indicator_combinations.py`): the same 15 combination
+families (trend confirmation, momentum/trend alignment, triple MA,
+volatility-adjusted momentum, volatility trend score, oscillator consensus,
+stoch-RSI, double RSI, volume-weighted price momentum, volume/price
+confirmation, trend-strength index, market-regime indicator, reversal
+probability, breakout confirmation, divergence detector) — but computed
+per-candle over whole arrays in one jit (the reference scores one snapshot
+dict at a time in Python).
+
+Input: the `compute_indicators` output dict (plus derived per-candle price
+changes). Every score is normalized to [-1, 1] (bearish → bullish) or
+[0, 1] for probability-style outputs, matching the reference's conventions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu import ops
+
+
+def _pct_change(close, n):
+    """ops.roc with zero-filled warmup (NaN would poison the tanh blends)."""
+    return jnp.nan_to_num(ops.roc(close, n))
+
+
+@jax.jit
+def combined_indicators(ind: dict) -> dict:
+    """All 15 combination scores, [T] each."""
+    close = ind["close"]
+    rsi = ind["rsi"]
+    macd_line = ind["macd"]
+    macd_sig = ind["macd_signal"]
+    stoch = ind["stoch_k"]
+    willr = ind["williams_r"]
+    bb_pos = ind["bb_position"]
+    bb_width = ind["bb_width"]
+    atr = ind["atr"]
+    volume = ind["volume"]
+    sma20, sma50, sma200 = ind["sma_20"], ind["sma_50"], ind["sma_200"]
+
+    chg1 = _pct_change(close, 1)
+    chg5 = _pct_change(close, 5)
+    vol_ma = ops.nanfill(ops.rolling_mean(volume, 20))
+    vol_ratio = volume / jnp.where(vol_ma == 0, 1.0, vol_ma)
+    volatility = atr / close
+
+    up_trend = ((close > sma20) & (sma20 > sma50)).astype(jnp.float32)
+    dn_trend = ((close < sma20) & (sma20 < sma50)).astype(jnp.float32)
+    trend_dir = up_trend - dn_trend                                # [-1, 1]
+
+    # --- trend strength combinations ---------------------------------------
+    macd_conf = jnp.tanh((macd_line - macd_sig) / close * 1e3)
+    trend_confirmation = trend_dir * 0.5 + macd_conf * 0.5
+    momentum_trend_alignment = trend_dir * jnp.tanh(chg5 / 2.0)
+    triple_ma = (jnp.sign(close - sma20) + jnp.sign(sma20 - sma50)
+                 + jnp.sign(sma50 - sma200)) / 3.0
+
+    # --- volatility-adjusted -----------------------------------------------
+    vol_safe = jnp.where(volatility == 0, 1e-6, volatility)
+    volatility_adjusted_momentum = jnp.tanh(chg5 / (vol_safe * 100.0))
+    volatility_trend_score = trend_dir * jnp.clip(1.0 - volatility / 0.05, 0.0, 1.0)
+
+    # --- oscillators --------------------------------------------------------
+    rsi_score = (50.0 - rsi) / 50.0            # oversold → +1
+    stoch_score = (50.0 - stoch) / 50.0
+    willr_score = (-50.0 - willr) / 50.0       # willr ∈ [-100, 0]
+    oscillator_consensus = (rsi_score + stoch_score + willr_score) / 3.0
+    stoch_rsi = (rsi_score + stoch_score) / 2.0
+    rsi_fast = (50.0 - ops.nanfill(ops.rsi(close, 7))) / 50.0
+    double_rsi = (rsi_score + rsi_fast) / 2.0
+
+    # --- volume -------------------------------------------------------------
+    volume_weighted_price_momentum = jnp.tanh(chg1 * jnp.minimum(vol_ratio, 3.0))
+    volume_price_confirmation = jnp.sign(chg1) * jnp.clip(vol_ratio - 1.0, 0.0, 1.0)
+
+    # --- compound -----------------------------------------------------------
+    trend_strength_index = jnp.clip(
+        jnp.abs(trend_confirmation) * 0.4 + jnp.abs(triple_ma) * 0.3
+        + jnp.abs(momentum_trend_alignment) * 0.3, 0.0, 1.0)
+    # regime: +1 trending-up, -1 trending-down, ~0 ranging; |x|>0.7 & high
+    # bb_width → volatile flavor
+    market_regime_indicator = trend_dir * trend_strength_index
+    reversal_probability = jnp.clip(
+        jnp.abs(oscillator_consensus) * 0.6
+        + (jnp.abs(bb_pos - 0.5) * 2.0) * 0.4, 0.0, 1.0)
+    bbw_ma = ops.nanfill(ops.rolling_mean(bb_width, 50))
+    squeeze = bb_width < jnp.where(bbw_ma == 0, 1.0, bbw_ma) * 0.8
+    breakout_confirmation = jnp.where(
+        squeeze & (vol_ratio > 1.5), jnp.sign(chg1), 0.0)
+    # divergence: price making new 14-bar highs while RSI is not (bearish),
+    # and vice versa
+    price_hh = close >= ops.nanfill(ops.rolling_max(close, 14))
+    rsi_hh = rsi >= ops.nanfill(ops.rolling_max(rsi, 14))
+    price_ll = close <= ops.nanfill(ops.rolling_min(close, 14))
+    rsi_ll = rsi <= ops.nanfill(ops.rolling_min(rsi, 14))
+    divergence_detector = (price_ll & ~rsi_ll).astype(jnp.float32) \
+        - (price_hh & ~rsi_hh).astype(jnp.float32)
+
+    return {
+        "trend_confirmation": trend_confirmation,
+        "momentum_trend_alignment": momentum_trend_alignment,
+        "triple_moving_average": triple_ma,
+        "volatility_adjusted_momentum": volatility_adjusted_momentum,
+        "volatility_trend_score": volatility_trend_score,
+        "oscillator_consensus": oscillator_consensus,
+        "stoch_rsi": stoch_rsi,
+        "double_rsi": double_rsi,
+        "volume_weighted_price_momentum": volume_weighted_price_momentum,
+        "volume_price_confirmation": volume_price_confirmation,
+        "trend_strength_index": trend_strength_index,
+        "market_regime_indicator": market_regime_indicator,
+        "reversal_probability": reversal_probability,
+        "breakout_confirmation": breakout_confirmation,
+        "divergence_detector": divergence_detector,
+    }
+
+
+@jax.jit
+def combination_signal(combos: dict, weights: dict | None = None):
+    """Weighted confluence score ∈ [-1, 1] across the directional combos
+    (the reference's combined-signal aggregation)."""
+    directional = ("trend_confirmation", "momentum_trend_alignment",
+                   "triple_moving_average", "oscillator_consensus",
+                   "volume_weighted_price_momentum",
+                   "market_regime_indicator")
+    w = weights or {k: 1.0 for k in directional}
+    total = sum(w.get(k, 0.0) for k in directional)
+    acc = sum(combos[k] * w.get(k, 0.0) for k in directional)
+    return acc / jnp.maximum(total, 1e-9)

@@ -1,0 +1,127 @@
+"""Pallas TPU kernels for the hot indicator ops.
+
+`fused_ewma` — the whole EMA family (ema12/ema26/Wilder-RSI gains & losses/
+Wilder ATR = any set of K smoothing factors) evaluated over a batch of
+series in ONE pass over HBM.
+
+Why a kernel: the XLA path runs one `associative_scan` per smoother — ~K
+reads of the [B, T] series from HBM plus O(log T) intermediate tensors.
+The recursion y[t] = (1-α)·y[t-1] + α·x[t] is trivially sequential per
+step but only needs the carry in registers, so a Pallas kernel can stream
+the series through VMEM once and produce all K outputs with O(1) on-chip
+state:
+
+  * layout [T, B]: the batch rides the 128-wide lane axis (each inner step
+    is a K×[1, B] VPU fma), time rides sublanes;
+  * grid over T tiles — TPU grid steps execute sequentially, so a VMEM
+    scratch [K, 1, B] carries y across tiles (the standard sequential-grid
+    carry pattern);
+  * HBM traffic: read x once, write the K outputs once — vs ≥K reads plus
+    scan temporaries for the XLA path.
+
+Numerics match `ops.indicators._ewm(..., start=0)` (recursion seeded with
+x[0]); warmup NaN masking stays the caller's concern, as in the jnp path.
+
+`fused_ewma` falls back to the associative-scan implementation on
+non-TPU backends (or under `interpret=True` for tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas import is safe everywhere; lowering needs a TPU
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+T_TILE = 256  # sublane-axis tile (multiple of 8 for f32)
+
+
+def _ewma_kernel(alpha_ref, x_ref, out_ref, carry_ref):
+    """One [T_TILE, B] block: sequential recursion over sublanes, K
+    smoothers vectorized over the lane axis.
+
+    alpha_ref: [K] SMEM; x_ref: [T_TILE, B] VMEM; out_ref: [K, T_TILE, B];
+    carry_ref: [K, 1, B] VMEM scratch persisting across grid steps."""
+    i = pl.program_id(0)
+    k_count = out_ref.shape[0]
+
+    @pl.when(i == 0)
+    def _seed():
+        first = x_ref[0:1, :]                       # [1, B]
+        for k in range(k_count):
+            carry_ref[k] = first
+
+    def step(t, _):
+        xt = x_ref[t, :][None, :]                   # [1, B]
+        for k in range(k_count):
+            a = alpha_ref[k]
+            c = carry_ref[k]
+            # seeded position: y[0] = x[0] exactly
+            is_t0 = jnp.logical_and(i == 0, t == 0)
+            new = jnp.where(is_t0, xt, (1.0 - a) * c + a * xt)
+            carry_ref[k] = new
+            out_ref[k, t, :] = new[0]
+        return 0
+
+    lax.fori_loop(0, x_ref.shape[0], step, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_ewma_pallas(x_tb: jnp.ndarray, alphas: jnp.ndarray,
+                      interpret: bool = False) -> jnp.ndarray:
+    """x_tb: [T, B] (T divisible by T_TILE), alphas: [K] → [K, T, B]."""
+    T, B = x_tb.shape
+    K = alphas.shape[0]
+    if T % T_TILE != 0 or T == 0:
+        raise ValueError(
+            f"fused_ewma_pallas requires T divisible by {T_TILE}, got {T} "
+            "(a floor-truncated grid would leave the tail unwritten)")
+    grid = (T // T_TILE,)
+    return pl.pallas_call(
+        _ewma_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((T_TILE, B), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((K, T_TILE, B), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((K, T, B), x_tb.dtype),
+        scratch_shapes=[pltpu.VMEM((K, 1, B), x_tb.dtype)],
+        interpret=interpret,
+    )(alphas, x_tb)
+
+
+def fused_ewma(x: jnp.ndarray, alphas, *, force_pallas: bool | None = None,
+               interpret: bool = False) -> jnp.ndarray:
+    """Batch EMA family: x [B, T] (or [T]), alphas length-K → [K, B, T].
+
+    Dispatches to the Pallas kernel on TPU (or when interpret=True for
+    testing); otherwise computes the same recursion via K associative
+    scans."""
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[None, :]
+    alphas = jnp.asarray(alphas, x.dtype)
+    B, T = x.shape
+
+    use_pallas = force_pallas
+    if use_pallas is None:
+        use_pallas = (_HAVE_PALLAS and T % T_TILE == 0
+                      and (interpret or jax.default_backend() == "tpu"))
+
+    if use_pallas:
+        out = fused_ewma_pallas(x.T, alphas, interpret=interpret)  # [K, T, B]
+        out = jnp.transpose(out, (0, 2, 1))
+    else:
+        from ai_crypto_trader_tpu.ops.indicators import _ewm
+
+        out = jnp.stack([_ewm(x, a, start=0) for a in alphas], axis=0)
+    return out[:, 0, :] if squeeze else out

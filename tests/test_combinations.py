@@ -1,0 +1,68 @@
+"""Combined indicators + regime data collector."""
+
+import asyncio
+
+import numpy as np
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.ops.combinations import (
+    combination_signal,
+    combined_indicators,
+)
+from ai_crypto_trader_tpu.regime.collector import RegimeDataCollector
+from ai_crypto_trader_tpu.shell.bus import EventBus
+
+
+class TestCombinations:
+    def _combos(self, ohlcv, n=1024):
+        arrays = {k: jnp.asarray(v[:n]) for k, v in ohlcv.items() if k != "regime"}
+        ind = ops.compute_indicators(arrays)
+        return combined_indicators(ind)
+
+    def test_all_fifteen_present_and_bounded(self, ohlcv):
+        combos = self._combos(ohlcv)
+        assert len(combos) == 15
+        for name, v in combos.items():
+            arr = np.asarray(v)
+            assert np.isfinite(arr).all(), name
+            assert arr.min() >= -1.0 - 1e-5 and arr.max() <= 1.0 + 1e-5, name
+
+    def test_uptrend_scores_positive(self):
+        n = 512
+        up = np.linspace(100, 160, n).astype(np.float32)
+        arrays = {"open": jnp.asarray(up), "high": jnp.asarray(up * 1.001),
+                  "low": jnp.asarray(up * 0.999), "close": jnp.asarray(up),
+                  "volume": jnp.ones(n, jnp.float32)}
+        combos = combined_indicators(ops.compute_indicators(arrays))
+        assert float(np.asarray(combos["triple_moving_average"])[-1]) == 1.0
+        assert float(np.asarray(combos["market_regime_indicator"])[-1]) > 0
+
+    def test_confluence_signal(self, ohlcv):
+        combos = self._combos(ohlcv)
+        sig = np.asarray(combination_signal(combos))
+        assert sig.shape == np.asarray(combos["stoch_rsi"]).shape
+        assert np.abs(sig).max() <= 1.0 + 1e-6
+
+
+class TestRegimeCollector:
+    def test_collect_label_train(self):
+        bus = EventBus()
+        col = RegimeDataCollector(bus)
+        for i in range(30):
+            bus.set("market_data_BTCUSDC", {
+                "timestamp": float(i * 60), "current_price": 100.0 + i,
+                "rsi": 40.0 + i, "volatility": 0.01, "trend_strength": 2.0,
+                "trend": "uptrend", "signal": "BUY", "signal_strength": 60.0})
+            col.collect_snapshot("BTCUSDC")
+        n = col.attach_outcomes([{"symbol": "BTCUSDC", "pnl": 5.0,
+                                  "closed_at": 10 * 60.0}])
+        assert n == 1
+        data = col.training_arrays()
+        assert data["features"].shape == (30, 4)
+        assert data["n_labeled"] == 1
+
+    def test_missing_data_is_none(self):
+        col = RegimeDataCollector(EventBus())
+        assert col.collect_snapshot("NOPE") is None
+        assert col.training_arrays() is None

@@ -1,0 +1,49 @@
+"""Pallas fused-EWMA kernel vs the associative-scan oracle (interpret mode
+on CPU; the same kernel lowers natively on TPU)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu.ops.pallas_kernels import T_TILE, fused_ewma
+
+
+@pytest.fixture
+def series(rng):
+    return jnp.asarray(rng.normal(100, 5, (8, 2 * T_TILE)).astype(np.float32))
+
+
+class TestFusedEWMA:
+    def test_matches_scan_path(self, series):
+        alphas = [2.0 / 13.0, 2.0 / 27.0, 1.0 / 14.0]
+        ref = fused_ewma(series, alphas, force_pallas=False)
+        out = fused_ewma(series, alphas, force_pallas=True, interpret=True)
+        assert out.shape == (3, 8, 2 * T_TILE)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=1e-3)
+
+    def test_seeded_with_first_value(self, series):
+        out = fused_ewma(series, [0.1], force_pallas=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out[0, :, 0]),
+                                   np.asarray(series[:, 0]), rtol=1e-6)
+
+    def test_carry_across_tiles(self, series):
+        """Values right after a tile boundary must continue the recursion,
+        not re-seed."""
+        a = 0.25
+        out = np.asarray(fused_ewma(series, [a], force_pallas=True,
+                                    interpret=True))[0]
+        x = np.asarray(series)
+        t = T_TILE  # first position of tile 1
+        expected = (1 - a) * out[:, t - 1] + a * x[:, t]
+        np.testing.assert_allclose(out[:, t], expected, rtol=1e-5)
+
+    def test_1d_input(self, series):
+        out = fused_ewma(series[0], [0.2], force_pallas=True, interpret=True)
+        assert out.shape == (1, 2 * T_TILE)
+
+    def test_non_tile_length_falls_back(self, rng):
+        x = jnp.asarray(rng.normal(0, 1, (4, 100)).astype(np.float32))
+        out = fused_ewma(x, [0.3])        # auto-dispatch → scan path
+        assert out.shape == (1, 4, 100)
