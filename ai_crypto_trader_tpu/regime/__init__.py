@@ -17,3 +17,5 @@ from ai_crypto_trader_tpu.regime.hmm import (  # noqa: F401
     hmm_posteriors,
     hmm_viterbi,
 )
+from ai_crypto_trader_tpu.regime.collector import RegimeDataCollector  # noqa: F401
+from ai_crypto_trader_tpu.regime.service import MarketRegimeService  # noqa: F401

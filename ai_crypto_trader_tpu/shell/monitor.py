@@ -55,6 +55,14 @@ class MarketMonitor:
         ind = ops.compute_indicators(arrays)
         feats = compute_signal_features(ind)
         signal, strength = reference_signal(feats)
+        # volume profile (reference cadence: market_monitor_service.py:303-372)
+        from ai_crypto_trader_tpu.ops.volume_profile import volume_profile
+        from ai_crypto_trader_tpu.ops.combinations import (
+            combination_signal, combined_indicators,
+        )
+        vp = volume_profile(arrays["high"], arrays["low"], arrays["close"],
+                            arrays["volume"])
+        confluence = combination_signal(combined_indicators(ind))
         i = -1
         close = arr[:, 3]
         def chg(n):
@@ -77,6 +85,12 @@ class MarketMonitor:
             "signal_strength": float(np.asarray(strength)[i]),
             "price_change_1m": chg(1), "price_change_5m": chg(5),
             "price_change_15m": chg(15),
+            "volume_profile": {
+                "poc_price": float(np.asarray(vp["poc_price"])),
+                "value_area_low": float(np.asarray(vp["value_area_low"])),
+                "value_area_high": float(np.asarray(vp["value_area_high"])),
+            },
+            "confluence": float(np.asarray(confluence)[i]),
         }
 
     @staticmethod

@@ -10,3 +10,4 @@ from ai_crypto_trader_tpu.social.news import (  # noqa: F401
     NewsAnalyzer,
     lexicon_sentiment,
 )
+from ai_crypto_trader_tpu.social.service import SocialMonitorService  # noqa: F401

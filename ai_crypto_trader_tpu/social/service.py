@@ -1,0 +1,152 @@
+"""Social monitoring service — the bus-facing wrapper over the analytics.
+
+Capability parity with SocialMonitorService / EnhancedSocialMonitorService
+(`services/social_monitor_service.py`, `enhanced_social_monitor_service.py`):
+polling with a 300 s cache, anomaly detection on incoming metrics,
+time-weighted sentiment, accuracy assessment against subsequent price moves
+(:365-452), adaptive source weights, and performance reporting — publishing
+`social_updates` and the per-symbol `social_metrics_{symbol}` /
+`social_snapshot_{symbol}` keys the analyzer and risk adjuster consume.
+
+The provider (LunarCrush in the reference) is injected as any callable
+returning metric dicts; the deterministic default derives pseudo-social
+series from price action so the full pipeline runs offline.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from ai_crypto_trader_tpu.risk.social import SocialSnapshot
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.social.analyzer import (
+    adaptive_source_weights,
+    detect_anomalies,
+    fit_anomaly_model,
+    normalize_metrics,
+    sentiment_accuracy,
+)
+
+SOURCES = ("twitter_sentiment", "reddit_sentiment", "news_sentiment",
+           "overall_sentiment")
+
+
+def deterministic_provider(bus: EventBus, symbol: str) -> dict | None:
+    """Offline stand-in provider: derives social-shaped metrics from recent
+    price action on the bus (momentum-chasing sentiment with noise-free
+    determinism)."""
+    md = bus.get(f"market_data_{symbol}")
+    if not md:
+        return None
+    chg = float(md.get("price_change_15m", 0.0))
+    base = float(np.clip(0.5 + chg / 10.0, 0.05, 0.95))
+    return {
+        "twitter_sentiment": base,
+        "reddit_sentiment": float(np.clip(base + 0.05, 0, 1)),
+        "news_sentiment": float(np.clip(base - 0.05, 0, 1)),
+        "overall_sentiment": base,
+        "social_volume": 10_000.0 * (1.0 + abs(chg)),
+        "social_engagement": 5_000.0 * (1.0 + abs(chg) / 2),
+        "social_contributors": 800.0,
+    }
+
+
+@dataclass
+class SocialMonitorService:
+    bus: EventBus
+    symbols: list[str] = field(default_factory=lambda: ["BTCUSDC"])
+    provider: any = None                # callable(bus, symbol) -> metrics
+    cache_ttl_s: float = 300.0
+    history_len: int = 500
+    now_fn: any = time.time
+    _cache: dict = field(default_factory=dict)
+    _history: dict = field(default_factory=dict)   # symbol -> list of rows
+    _anomaly_models: dict = field(default_factory=dict)
+    _samples_since_fit: dict = field(default_factory=dict)
+    source_weights: dict = field(default_factory=lambda: {
+        s: w for s, w in zip(SOURCES, (0.35, 0.30, 0.25, 0.10))})
+
+    async def poll(self, force: bool = False) -> int:
+        provider = self.provider or deterministic_provider
+        published = 0
+        now = self.now_fn()
+        for symbol in self.symbols:
+            ts, _ = self._cache.get(symbol, (-1e18, None))
+            if not force and now - ts < self.cache_ttl_s:
+                continue
+            metrics = provider(self.bus, symbol)
+            if metrics is None:
+                continue
+            self._cache[symbol] = (now, metrics)
+            hist = self._history.setdefault(symbol, [])
+            hist.append({**metrics, "ts": now})
+            del hist[: -self.history_len]
+
+            enriched = dict(metrics)
+            enriched["anomaly"] = self._check_anomaly(symbol, metrics)
+            enriched["symbol"] = symbol
+            enriched["timestamp"] = now
+
+            self.bus.set(f"social_metrics_{symbol}", enriched)
+            self.bus.set(f"social_snapshot_{symbol}", self._snapshot(symbol, now))
+            await self.bus.publish("social_updates", enriched)
+            published += 1
+        return published
+
+    def _snapshot(self, symbol: str, now: float) -> SocialSnapshot:
+        """Recent observations as the risk adjuster's input."""
+        rows = self._history.get(symbol, [])[-24:]
+        sent = np.asarray([[r.get(s, 0.5) for s in SOURCES] for r in rows]
+                          or [[0.5] * 4], np.float32)
+        ages = np.asarray([(now - r["ts"]) / 3600.0 for r in rows] or [0.0],
+                          np.float32)
+        quality = min(len(rows) / 6.0, 1.0)
+        return SocialSnapshot(sentiments=jnp.asarray(sent),
+                              age_hours=jnp.asarray(ages),
+                              data_quality=jnp.asarray(quality, jnp.float32))
+
+    def _check_anomaly(self, symbol: str, metrics: dict) -> dict:
+        hist = self._history.get(symbol, [])
+        feats = ["social_volume", "social_engagement", "overall_sentiment"]
+        if len(hist) >= 50:
+            x = jnp.asarray([[r.get(f, 0.0) for f in feats] for r in hist],
+                            jnp.float32)
+            z = normalize_metrics(x)
+            # refit every 50 appended samples (a len(hist)-based check would
+            # refit on EVERY poll once the deque saturates at history_len)
+            since = self._samples_since_fit.get(symbol, 50)
+            if symbol not in self._anomaly_models or since >= 50:
+                self._anomaly_models[symbol] = fit_anomaly_model(z)
+                self._samples_since_fit[symbol] = 0
+            self._samples_since_fit[symbol] = self._samples_since_fit.get(symbol, 0) + 1
+            flag, score = detect_anomalies(self._anomaly_models[symbol], z[-1:])
+            return {"is_anomaly": bool(flag[0]), "score": float(score[0])}
+        return {"is_anomaly": False, "score": 0.0}
+
+    def assess_accuracy(self, symbol: str, close: np.ndarray,
+                        horizon: int = 12) -> dict:
+        """Accuracy assessment + adaptive re-weighting
+        (`enhanced_social_monitor_service.py:365-452`)."""
+        hist = self._history.get(symbol, [])
+        if len(hist) < horizon + 5:
+            return {"status": "insufficient_history"}
+        per_source = {s: np.asarray([r.get(s, 0.5) for r in hist], np.float32)
+                      for s in SOURCES}
+        n = min(len(close), len(hist))
+        close = np.asarray(close[-n:], np.float32)
+        per_source = {s: v[-n:] for s, v in per_source.items()}
+        report = {s: float(sentiment_accuracy(jnp.asarray(v),
+                                              jnp.asarray(close),
+                                              horizon)["accuracy"])
+                  for s, v in per_source.items()}
+        # weights derived from the report directly (adaptive_source_weights'
+        # formula) — no second accuracy pass
+        floor = 0.05
+        raw = {s: max(acc - 0.5, floor) for s, acc in report.items()}
+        total = sum(raw.values())
+        self.source_weights = {s: v / total for s, v in raw.items()}
+        return {"accuracy": report, "weights": dict(self.source_weights)}

@@ -37,7 +37,20 @@ def reference_cpu_candles_per_sec(inputs, n=20_000) -> float:
 
 
 def main():
+    import os
+
     import jax
+
+    # persistent compilation cache: the 525k-candle graphs take minutes to
+    # compile on TPU the first time; cached re-runs start in seconds
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     import jax.numpy as jnp
 
     from ai_crypto_trader_tpu import ops

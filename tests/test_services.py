@@ -1,0 +1,105 @@
+"""Service wrappers: social monitor service and market regime service."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.regime.service import MarketRegimeService
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.social.service import SocialMonitorService
+
+
+def _bus_with_market(symbol="BTCUSDC", chg=2.0):
+    bus = EventBus()
+    bus.set(f"market_data_{symbol}", {
+        "current_price": 100.0, "price_change_15m": chg, "rsi": 50.0,
+        "volatility": 0.01, "trend_strength": 2.0, "signal_strength": 60.0,
+        "timestamp": 0.0})
+    return bus
+
+
+class TestSocialService:
+    def test_poll_publishes_and_caches(self):
+        async def go():
+            clock = {"t": 0.0}
+            bus = _bus_with_market()
+            svc = SocialMonitorService(bus, now_fn=lambda: clock["t"])
+            n = await svc.poll()
+            assert n == 1
+            assert bus.get("social_metrics_BTCUSDC")["overall_sentiment"] > 0.5
+            snap = bus.get("social_snapshot_BTCUSDC")
+            assert snap.sentiments.shape[1] == 4
+            # cached within ttl
+            assert await svc.poll() == 0
+            clock["t"] += 301.0
+            assert await svc.poll() == 1
+        asyncio.run(go())
+
+    def test_accuracy_assessment_reweights(self):
+        async def go():
+            clock = {"t": 0.0}
+            bus = _bus_with_market()
+            svc = SocialMonitorService(bus, cache_ttl_s=0.0,
+                                       now_fn=lambda: clock["t"])
+            rng = np.random.default_rng(0)
+            for i in range(80):
+                chg = float(rng.normal(0, 2))
+                bus.set("market_data_BTCUSDC",
+                        {"current_price": 100.0, "price_change_15m": chg,
+                         "timestamp": clock["t"]})
+                await svc.poll(force=True)
+                clock["t"] += 60.0
+            close = 100 * np.cumprod(1 + rng.normal(0, 0.01, 80)).astype(np.float32)
+            out = svc.assess_accuracy("BTCUSDC", close)
+            assert set(out["accuracy"]) == {"twitter_sentiment",
+                                            "reddit_sentiment",
+                                            "news_sentiment",
+                                            "overall_sentiment"}
+            np.testing.assert_allclose(sum(out["weights"].values()), 1.0,
+                                       rtol=1e-6)
+        asyncio.run(go())
+
+
+class TestRegimeService:
+    def _bus_with_history(self, n=400, seed=3):
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        bus = EventBus()
+        d = generate_ohlcv(n=n, seed=seed)
+        klines = [[i * 60000, float(d["open"][i]), float(d["high"][i]),
+                   float(d["low"][i]), float(d["close"][i]),
+                   float(d["volume"][i])] for i in range(n)]
+        bus.set("historical_data_BTCUSDC_1m", klines)
+        return bus
+
+    def test_update_detects_and_publishes(self):
+        async def go():
+            bus = self._bus_with_history()
+            svc = MarketRegimeService(bus, now_fn=lambda: 0.0)
+            q = bus.subscribe("regime_updates")
+            out = await svc.update("BTCUSDC")
+            assert out["regime"] in ("bull", "bear", "ranging", "volatile")
+            assert bus.get("market_regime")["regime"] == out["regime"]
+            assert q.get_nowait()["data"]["regime"] == out["regime"]
+        asyncio.run(go())
+
+    def test_insufficient_history_keeps_default(self):
+        async def go():
+            bus = EventBus()
+            svc = MarketRegimeService(bus)
+            out = await svc.update("BTCUSDC")
+            assert out["regime"] == "ranging" and out["confidence"] == 0.0
+        asyncio.run(go())
+
+    def test_per_regime_performance_and_switch(self):
+        svc = MarketRegimeService(EventBus())
+        svc.regimes["BTCUSDC"] = {"regime": "bull", "confidence": 0.9,
+                                  "timestamp": 1.0}
+        for _ in range(10):
+            svc.record_trade("trend", 20.0)
+            svc.record_trade("grid", -10.0)
+        assert svc.regime_score("trend") > svc.regime_score("grid")
+        assert svc.best_strategy_for_regime() == "trend"
+        rec = svc.switch_recommendation("grid")
+        assert rec["switch"] and rec["candidate"] == "trend"
+        assert not svc.switch_recommendation("trend")["switch"]
