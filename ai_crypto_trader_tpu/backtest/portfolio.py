@@ -1,0 +1,72 @@
+"""Portfolio-level backtesting: (strategy × symbol) in one program.
+
+The reference iterates symbols × intervals sequentially
+(`run_multiple_backtests`, `backtesting/backtest_engine.py:127-178` /
+`strategy_tester.py:460-487`).  Here the symbol axis is just another vmap:
+stack per-symbol BacktestInputs (pad to a common length) and evaluate
+every (strategy, symbol) cell at once; portfolio metrics aggregate across
+the symbol axis on-device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.backtest.engine import BacktestInputs, prepare_inputs, run_backtest
+from ai_crypto_trader_tpu.backtest.metrics import compute_metrics
+from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
+
+
+def stack_symbol_inputs(per_symbol: dict[str, dict]) -> tuple[BacktestInputs, list[str]]:
+    """{symbol: ohlcv dict} → BacktestInputs with a leading symbol axis.
+
+    Shorter series are LEFT-padded by repeating their first candle (prices
+    flat → no trades during padding; masks stay static) — padding + masking
+    per SURVEY §7.4 'Ragged reality'."""
+    symbols = sorted(per_symbol)
+    T = max(len(np.asarray(d["close"])) for d in per_symbol.values())
+
+    def pad(d):
+        arrays = {}
+        for k in ("open", "high", "low", "close", "volume"):
+            v = np.asarray(d[k], np.float32)
+            if len(v) < T:
+                v = np.concatenate([np.full(T - len(v), v[0], np.float32), v])
+            arrays[k] = jnp.asarray(v)
+        return arrays
+
+    stacked_inputs = []
+    for s in symbols:
+        ind = ops.compute_indicators(pad(per_symbol[s]))
+        stacked_inputs.append(prepare_inputs(ind))
+    batched = jax.tree.map(lambda *xs: jnp.stack(xs), *stacked_inputs)
+    return batched, symbols
+
+
+@functools.partial(jax.jit, static_argnames=("use_param_sl_tp",))
+def portfolio_backtest(inputs: BacktestInputs, params: StrategyParams | None = None,
+                       initial_balance_per_symbol: float = 10_000.0,
+                       use_param_sl_tp: bool = False):
+    """Run every symbol (leading axis of `inputs`) under one strategy; the
+    per-symbol stats come back batched, plus portfolio aggregates."""
+    stats = jax.vmap(lambda inp: run_backtest(
+        inp, params, initial_balance=initial_balance_per_symbol,
+        use_param_sl_tp=use_param_sl_tp))(inputs)
+    m = compute_metrics(stats)
+    n = stats.final_balance.shape[0]
+    total_initial = initial_balance_per_symbol * n
+    total_final = jnp.sum(stats.final_balance)
+    portfolio = {
+        "total_initial": jnp.asarray(total_initial, jnp.float32),
+        "total_final": total_final,
+        "total_return_pct": (total_final - total_initial) / total_initial * 100.0,
+        "total_trades": jnp.sum(stats.total_trades),
+        "mean_sharpe": jnp.mean(m["sharpe_ratio"]),
+        "worst_symbol_drawdown_pct": jnp.max(stats.max_drawdown_pct),
+    }
+    return stats, m, portfolio

@@ -1,0 +1,77 @@
+"""Failure detection & recovery: heartbeats, device liveness, resume.
+
+The reference's failure story is per-container TCP health ports +
+docker-compose restarts + per-service Redis reconnect loops (SURVEY §5.3).
+The TPU-native equivalents:
+
+  * `HeartbeatRegistry` — services beat on every loop; the checker flags
+    stale services (the ServiceDown alert input);
+  * `device_liveness` — a tiny computation round-trips through every
+    visible device; a chip that can't complete it is reported dead;
+  * `resume_or_init` — the elastic-recovery primitive: reload the single
+    checkpoint (params, opt state, PRNG, cursors — utils/checkpoint.py) or
+    build fresh state, so a restarted host rejoins from the last step
+    instead of cold-starting (the reference re-reads scattered Redis keys
+    and .h5 files);
+  * for multi-host pods, recovery = restart process → `initialize_distributed`
+    (parallel/mesh.py) → `resume_or_init` — documented here as the runbook.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class HeartbeatRegistry:
+    stale_after_s: float = 30.0
+    now_fn: Callable[[], float] = time.time
+    beats: dict = field(default_factory=dict)
+
+    def beat(self, service: str) -> None:
+        self.beats[service] = self.now_fn()
+
+    def stale(self) -> list[str]:
+        now = self.now_fn()
+        return [s for s, t in self.beats.items()
+                if now - t > self.stale_after_s]
+
+    def health(self) -> dict:
+        """The `service_health` map the alert rules consume."""
+        stale = set(self.stale())
+        return {s: s not in stale for s in self.beats}
+
+
+def device_liveness() -> dict:
+    """Round-trip a tiny computation through every device."""
+    out = {}
+    for d in jax.devices():
+        try:
+            x = jax.device_put(jnp.ones((8,)), d)
+            jax.block_until_ready(x + 1.0)
+            out[str(d)] = True
+        except Exception:
+            out[str(d)] = False
+    return out
+
+
+def resume_or_init(path: str, init_fn: Callable[[], tuple]):
+    """Load (state, metadata) from the checkpoint at `path`, or build fresh
+    via init_fn() when absent/corrupt. Returns (state, metadata, resumed)."""
+    import os
+
+    from ai_crypto_trader_tpu.utils.checkpoint import load_checkpoint
+
+    if os.path.isdir(path):
+        try:
+            tree, meta = load_checkpoint(path)
+            return tree, meta, True
+        except Exception:
+            pass
+    state = init_fn()
+    return state, {}, False

@@ -1,0 +1,67 @@
+"""Portfolio multi-symbol backtest + health/recovery utilities."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu.backtest.portfolio import (
+    portfolio_backtest,
+    stack_symbol_inputs,
+)
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.utils.health import (
+    HeartbeatRegistry,
+    device_liveness,
+    resume_or_init,
+)
+
+
+class TestPortfolio:
+    def _per_symbol(self):
+        return {f"S{i}USDC": {k: v for k, v in
+                              generate_ohlcv(n=800 - 100 * i, seed=i).items()
+                              if k != "regime"}
+                for i in range(3)}
+
+    def test_stack_pads_ragged(self):
+        inputs, symbols = stack_symbol_inputs(self._per_symbol())
+        assert symbols == ["S0USDC", "S1USDC", "S2USDC"]
+        assert inputs.close.shape == (3, 800)     # padded to longest
+        # left-padding repeats the first candle → flat prices
+        np.testing.assert_allclose(np.asarray(inputs.close[2, :100]),
+                                   np.asarray(inputs.close[2, 100]), rtol=1e-6)
+
+    def test_portfolio_aggregates(self):
+        inputs, symbols = stack_symbol_inputs(self._per_symbol())
+        stats, metrics, portfolio = portfolio_backtest(inputs)
+        assert stats.final_balance.shape == (3,)
+        np.testing.assert_allclose(
+            float(portfolio["total_final"]),
+            float(np.asarray(stats.final_balance).sum()), rtol=1e-6)
+        assert float(portfolio["total_initial"]) == 30_000.0
+        assert np.isfinite(float(portfolio["mean_sharpe"]))
+
+
+class TestHealth:
+    def test_heartbeats(self):
+        clock = {"t": 0.0}
+        hb = HeartbeatRegistry(stale_after_s=10, now_fn=lambda: clock["t"])
+        hb.beat("monitor")
+        hb.beat("executor")
+        assert hb.stale() == []
+        clock["t"] = 11.0
+        hb.beat("executor")
+        assert hb.stale() == ["monitor"]
+        assert hb.health() == {"monitor": False, "executor": True}
+
+    def test_device_liveness(self):
+        out = device_liveness()
+        assert out and all(out.values())
+
+    def test_resume_or_init(self, tmp_path):
+        from ai_crypto_trader_tpu.utils.checkpoint import save_checkpoint
+        path = str(tmp_path / "ck")
+        state, meta, resumed = resume_or_init(path, lambda: {"step": 0})
+        assert not resumed and state == {"step": 0}
+        save_checkpoint(path, {"step": np.asarray(7)}, {"note": "x"})
+        state, meta, resumed = resume_or_init(path, lambda: {"step": 0})
+        assert resumed and int(state["step"]) == 7 and meta["note"] == "x"
