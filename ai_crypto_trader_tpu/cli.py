@@ -125,6 +125,18 @@ def cmd_analyze(args):
     print(json.dumps(r, indent=2))
 
 
+def cmd_report(args):
+    from ai_crypto_trader_tpu.backtest.results import (
+        load_results, render_report_html, summary_report,
+    )
+
+    results = load_results(RESULTS_DIR, symbol=args.symbol or None)
+    print(json.dumps(summary_report(results), indent=2))
+    if results:
+        path = render_report_html(results, args.out)
+        print(f"wrote {path}")
+
+
 def cmd_train(args):
     import jax
 
@@ -248,6 +260,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("analyze", help="pretty-print a result file")
     sp.add_argument("--file", required=True)
     sp.set_defaults(fn=cmd_analyze)
+    sp = sub.add_parser("report", help="multi-run summary + HTML report")
+    sp.add_argument("--symbol", default="")
+    sp.add_argument("--out", default="backtest_report.html")
+    sp.set_defaults(fn=cmd_report)
     sp = sub.add_parser("train", help="train a price model")
     common(sp)
     sp.add_argument("--model", default="lstm")

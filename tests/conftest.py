@@ -21,6 +21,18 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Persistent compilation cache: the suite is compile-heavy (scans over many
+# static shapes); cached re-runs cut minutes off iteration.
+import jax  # noqa: E402
+
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 
 @pytest.fixture(scope="session")
 def mesh8():

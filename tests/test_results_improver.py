@@ -1,0 +1,95 @@
+"""Result analyzer + systematic improver."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu.backtest.results import (
+    comparison_table,
+    load_results,
+    render_report_html,
+    summary_report,
+)
+from ai_crypto_trader_tpu.config import EvolutionParams, GAParams
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.strategy.evolution import StrategyEvolver
+from ai_crypto_trader_tpu.strategy.improver import SystematicImprover
+
+
+def _write_results(d, n=3):
+    os.makedirs(d, exist_ok=True)
+    for i in range(n):
+        with open(os.path.join(d, f"r{i}.json"), "w") as f:
+            json.dump({"symbol": "BTCUSDC" if i < 2 else "ETHUSDC",
+                       "strategy": "s", "sharpe_ratio": float(i),
+                       "win_rate": 50.0 + i, "total_return_pct": i * 2.0,
+                       "max_drawdown_pct": 5.0, "total_trades": 10 + i,
+                       "initial_balance": 10_000.0,
+                       "final_balance": 10_000.0 + 100 * i}, f)
+
+
+class TestResults:
+    def test_load_filter_summarize(self, tmp_path):
+        d = str(tmp_path / "res")
+        _write_results(d)
+        all_ = load_results(d)
+        assert len(all_) == 3
+        btc = load_results(d, symbol="BTCUSDC")
+        assert len(btc) == 2
+        s = summary_report(all_)
+        assert s["n_runs"] == 3 and s["best_sharpe"] == 2.0
+        assert s["best_run"] == "r2.json"
+        assert s["profitable_runs"] == 2   # r0 is flat
+
+    def test_comparison_and_report(self, tmp_path):
+        d = str(tmp_path / "res")
+        _write_results(d)
+        results = load_results(d)
+        cmp_ = comparison_table(results)
+        assert cmp_["ranked"][0] == "r2.json"
+        path = render_report_html(results, str(tmp_path / "report.html"),
+                                  equity_curve=np.linspace(1e4, 1.1e4, 40),
+                                  drawdown_curve=np.linspace(0, 3, 40))
+        html = open(path).read()
+        assert html.count("<svg") == 2 and "Summary" in html
+
+    def test_corrupt_file_skipped(self, tmp_path):
+        d = str(tmp_path / "res")
+        _write_results(d, 1)
+        with open(os.path.join(d, "bad.json"), "w") as f:
+            f.write("{not json")
+        assert len(load_results(d)) == 1
+
+
+class TestImprover:
+    def test_improve_iterates_and_reports(self):
+        async def go():
+            d = generate_ohlcv(n=600, seed=4)
+            arrays = {k: jnp.asarray(v) for k, v in d.items() if k != "regime"}
+            ev = StrategyEvolver(EventBus(), cfg=EvolutionParams(
+                ga=GAParams(population_size=4, generations=1)))
+            imp = SystematicImprover(ev, cv_folds=2, max_iterations=2,
+                                     target_sharpe=999.0)  # force iterations
+            out = await imp.improve(arrays, regime="bull")
+            assert out["iterations"] >= 1
+            assert not out["converged"]
+            rep = imp.report()
+            assert rep["iterations"] == out["iterations"]
+            assert "ga" in rep["methods_used"]
+            # best-by-CV is monotone vs seed
+            assert out["evaluation"]["mean_sharpe"] >= imp.history[0]["eval"]["mean_sharpe"] - 1e-9
+        asyncio.run(go())
+
+    def test_early_stop_when_target_met(self):
+        async def go():
+            d = generate_ohlcv(n=400, seed=4)
+            arrays = {k: jnp.asarray(v) for k, v in d.items() if k != "regime"}
+            ev = StrategyEvolver(EventBus())
+            imp = SystematicImprover(ev, cv_folds=2, target_sharpe=-999.0)
+            out = await imp.improve(arrays)
+            assert out["converged"] and out["iterations"] == 0
+        asyncio.run(go())
