@@ -45,8 +45,16 @@ def summary_report(results: list[dict]) -> dict:
         return {"n_runs": 0}
     def col(key):
         return np.asarray([r.get(key, 0.0) or 0.0 for r in results], float)
-    sharpe = col("sharpe_ratio")
-    best_i = int(np.argmax(sharpe))
+    # rank/average sharpe only over runs that actually carry one (same rule
+    # as profitable_runs below: missing metrics must not coerce to 0)
+    scored = [(i, float(r["sharpe_ratio"])) for i, r in enumerate(results)
+              if isinstance(r.get("sharpe_ratio"), (int, float))]
+    if scored:
+        best_i = max(scored, key=lambda t: t[1])[0]
+        mean_sharpe = float(np.mean([s for _, s in scored]))
+        best_sharpe = float(dict(scored)[best_i])
+    else:
+        best_i, mean_sharpe, best_sharpe = 0, 0.0, 0.0
     # profitability judged only on runs that actually carry both balances —
     # a missing initial_balance must not coerce to 0 and count as a win
     with_balances = [r for r in results
@@ -56,8 +64,8 @@ def summary_report(results: list[dict]) -> dict:
     return {
         "n_runs": len(results),
         "symbols": sorted({r.get("symbol", "?") for r in results}),
-        "mean_sharpe": float(sharpe.mean()),
-        "best_sharpe": float(sharpe[best_i]),
+        "mean_sharpe": mean_sharpe,
+        "best_sharpe": best_sharpe,
         "best_run": results[best_i].get("_file", f"run_{best_i}"),
         "mean_win_rate": float(col("win_rate").mean()),
         "mean_return_pct": float(col("total_return_pct").mean()),

@@ -85,9 +85,14 @@ def cmd_backtest(args):
         result["sweep_size"] = args.sweep
         result["best_index"] = best
     else:
-        stats = run_backtest(inp, default_params(), use_param_sl_tp=True)
+        stats, curve = run_backtest(inp, default_params(), use_param_sl_tp=True,
+                                    return_curve=True)
         jax.block_until_ready(stats.final_balance)
         result = {k: float(v) for k, v in compute_metrics(stats).items()}
+        # downsampled realized-equity curve for `report` plots
+        c = np.asarray(curve)
+        step = max(len(c) // 500, 1)
+        result["equity_curve"] = [round(float(v), 2) for v in c[::step]]
     dt = time.perf_counter() - t0
     n_candles = int(arrays["close"].shape[0]) * max(args.sweep, 1)
     result.update({"symbol": args.symbol, "interval": "1m",
@@ -131,9 +136,20 @@ def cmd_report(args):
     )
 
     results = load_results(RESULTS_DIR, symbol=args.symbol or None)
-    print(json.dumps(summary_report(results), indent=2))
+    summary = summary_report(results)
+    print(json.dumps(summary, indent=2))
     if results:
-        path = render_report_html(results, args.out)
+        # best run's saved equity curve drives the report plots
+        best = next((r for r in results
+                     if r.get("_file") == summary.get("best_run")), results[0])
+        eq = best.get("equity_curve")
+        dd = None
+        if eq:
+            eq_arr = np.asarray(eq, float)
+            peak = np.maximum.accumulate(eq_arr)
+            dd = (peak - eq_arr) / peak * 100.0
+        path = render_report_html(results, args.out,
+                                  equity_curve=eq, drawdown_curve=dd)
         print(f"wrote {path}")
 
 

@@ -46,9 +46,14 @@ class SystematicImprover:
         best_params, best_eval = params, self.evaluate(ohlcv, params)
         self.history = [{"iteration": 0, "eval": best_eval, "method": "seed"}]
 
+        base_seed = self.evolver.seed
         for it in range(1, self.max_iterations + 1):
             if best_eval["passes"]:
                 break
+            # fresh optimizer randomness each round — with a fixed seed and
+            # unchanged current params, a failed iteration would otherwise
+            # re-produce the identical candidate and waste the CV budget
+            self.evolver.seed = base_seed + it
             out = await self.evolver.evolve(
                 ohlcv, current=best_params, regime=regime,
                 history_length=len(self.history) * 10)
@@ -61,6 +66,7 @@ class SystematicImprover:
                                  "version": out.get("version")})
             if cand_eval["mean_sharpe"] > best_eval["mean_sharpe"]:
                 best_params, best_eval = cand, cand_eval
+        self.evolver.seed = base_seed
         return {"params": best_params, "evaluation": best_eval,
                 "iterations": len(self.history) - 1,
                 "converged": best_eval["passes"], "history": self.history}

@@ -1,0 +1,24 @@
+"""Persistent XLA compilation cache (shared by bench, tests, CLI)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache")
+
+
+def enable_compilation_cache(cache_dir: str | None = None,
+                             min_compile_secs: float = 1.0) -> bool:
+    """Best-effort enable; returns True when active."""
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          cache_dir or _DEFAULT_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+        return True
+    except Exception:
+        return False

@@ -43,13 +43,10 @@ def main():
 
     # persistent compilation cache: the 525k-candle graphs take minutes to
     # compile on TPU the first time; cached re-runs start in seconds
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ai_crypto_trader_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
 
     import jax.numpy as jnp
 
@@ -59,7 +56,18 @@ def main():
 
     T = 525_600           # 1 year of 1-minute candles
     B = 128               # strategy population width
-    log(f"devices: {jax.devices()}")
+    try:
+        log(f"devices: {jax.devices()}")
+    except RuntimeError as e:
+        # TPU backend unavailable (e.g. stale chip grant): re-exec on CPU so
+        # the driver still gets a benchmark line rather than a crash.
+        if os.environ.get("_BENCH_CPU_FALLBACK"):
+            raise
+        log(f"TPU unavailable ({e}); falling back to CPU")
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu", _BENCH_CPU_FALLBACK="1")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
     d = generate_ohlcv(n=T, seed=3)
     arrays = {k: jnp.asarray(v) for k, v in d.items() if k != "regime"}

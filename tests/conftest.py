@@ -23,15 +23,12 @@ import pytest  # noqa: E402
 
 # Persistent compilation cache: the suite is compile-heavy (scans over many
 # static shapes); cached re-runs cut minutes off iteration.
-import jax  # noqa: E402
+import sys  # noqa: E402
 
-try:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from ai_crypto_trader_tpu.utils.cache import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
 
 
 @pytest.fixture(scope="session")

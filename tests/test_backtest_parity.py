@@ -158,6 +158,17 @@ class TestParity:
         assert (sigs == sigs[-1]).all()  # frozen → constant signal
 
 
+class TestCurve:
+    def test_return_curve_shape_and_final(self, ohlcv):
+        inp = _inputs(ohlcv, n=512)
+        stats, curve = run_backtest(inp, return_curve=True)
+        assert curve.shape == (512,)
+        # realized-equity curve ends at the pre-liquidation balance; final
+        # balance additionally closes any open position at the last price
+        assert np.isfinite(np.asarray(curve)).all()
+        assert float(curve[0]) == 10_000.0
+
+
 class TestSweep:
     def test_vmap_matches_individual(self, ohlcv):
         inp = _inputs(ohlcv, n=1024)
