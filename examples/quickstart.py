@@ -78,4 +78,39 @@ print(f"[7] MC: expected {float(sim['expected_pct_change']):+.2f}%, "
       f"VaR(95) {abs(float(sim['var'])):.2f}%, "
       f"CVaR {abs(float(sim['cvar'])):.2f}%")
 
+# 8. Chart-pattern recognition ----------------------------------------------
+from ai_crypto_trader_tpu.patterns import detect_patterns, train_pattern_model
+
+rec = train_pattern_model(key, "cnn", n_per_class=16, epochs=4)
+window = np.stack([np.asarray(d[k])[-60:] for k in
+                   ("open", "high", "low", "close", "volume")], axis=1)
+pat = detect_patterns(rec, window, confidence_threshold=0.3)
+top = pat["top_patterns"][0]
+print(f"[8] patterns: top={top['pattern']} (p={top['probability']:.2f}), "
+      f"detected={pat['detected']}")
+
+# 9. Portfolio risk stack ---------------------------------------------------
+from ai_crypto_trader_tpu.risk import cvar, historical_var, portfolio_var
+
+multi = jnp.stack([jnp.asarray(np.diff(np.log(
+    generate_ohlcv(n=1001, seed=s)["close"]))) for s in (1, 2, 3)])
+w = jnp.asarray([0.4, 0.4, 0.2])
+print(f"[9] risk: per-asset VaR {np.asarray(historical_var(multi)).round(4)}, "
+      f"portfolio VaR {float(portfolio_var(w, multi)):.4f} "
+      f"(diversification benefit), CVaR {np.asarray(cvar(multi)).round(4)}")
+
+# 10. Multi-symbol portfolio backtest ---------------------------------------
+from ai_crypto_trader_tpu.backtest.portfolio import (
+    portfolio_backtest, stack_symbol_inputs,
+)
+
+per_symbol = {f"S{i}USDC": {k: v for k, v in
+                            generate_ohlcv(n=2048, seed=i).items()
+                            if k != "regime"} for i in range(3)}
+pinputs, syms = stack_symbol_inputs(per_symbol)
+_, _, port = portfolio_backtest(pinputs)
+print(f"[10] portfolio: {len(syms)} symbols, "
+      f"{int(port['total_trades'])} trades, "
+      f"total return {float(port['total_return_pct']):+.2f}%")
+
 print(f"done in {time.time()-t0:.1f}s on {jax.devices()[0].platform}")
