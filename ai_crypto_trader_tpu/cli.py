@@ -245,6 +245,22 @@ def cmd_trade(args):
     asyncio.run(go())
 
 
+def cmd_registry(args):
+    """Model-registry operations (`run_ai_model_services.py` surface)."""
+    from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+    reg = ModelRegistry(path=args.path)
+    if args.best:
+        print(json.dumps(reg.best(args.kind) or {"status": "no_entries"},
+                         indent=2, default=str))
+    else:
+        rows = [{"version": e["version"], "kind": e["kind"],
+                 "status": e["status"],
+                 "sharpe": e.get("performance", {}).get("sharpe_ratio")}
+                for e in reg.entries.values()]
+        print(json.dumps(rows, indent=2))
+
+
 def cmd_dashboard(args):
     from ai_crypto_trader_tpu.shell.dashboard import write_dashboard
 
@@ -301,6 +317,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ticks", type=int, default=100)
     sp.add_argument("--dashboard", default=None)
     sp.set_defaults(fn=cmd_trade)
+    sp = sub.add_parser("registry", help="inspect the model registry")
+    sp.add_argument("--path", default="models/registry.json")
+    sp.add_argument("--kind", default="strategy_params")
+    sp.add_argument("--best", action="store_true")
+    sp.set_defaults(fn=cmd_registry)
     sp = sub.add_parser("dashboard", help="render the HTML dashboard")
     common(sp)
     sp.add_argument("--out", default="dashboard.html")

@@ -117,6 +117,19 @@ class TestCLI:
         r = json.load(open(os.path.join("backtesting/results", results[0])))
         assert "sharpe_ratio" in r and r["candles_per_sec"] > 0
 
+    def test_registry_command(self, tmp_path, monkeypatch, capsys):
+        from ai_crypto_trader_tpu import cli
+        from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+        p = str(tmp_path / "reg.json")
+        reg = ModelRegistry(path=p)
+        v = reg.register("strategy_params", {"a": 1.0})
+        reg.update_performance(v, {"sharpe_ratio": 2.0})
+        cli.main(["registry", "--path", p])
+        out = capsys.readouterr().out
+        assert v in out
+        cli.main(["registry", "--path", p, "--best"])
+        assert '"sharpe_ratio": 2.0' in capsys.readouterr().out
+
     def test_trade_requires_paper(self, capsys):
         from ai_crypto_trader_tpu import cli
         cli.main(["trade", "--ticks", "1"])
