@@ -1,0 +1,69 @@
+"""Mesh/sharding helpers: construction, shardings, padding."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu.parallel import (
+    data_sharding,
+    make_mesh,
+    pad_to_multiple,
+    replicated,
+    shard_leading_axis,
+)
+
+
+class TestMesh:
+    def test_shapes(self, mesh8):
+        assert mesh8.shape["data"] == 8 and mesh8.shape["model"] == 1
+
+    def test_two_axis(self):
+        mesh = make_mesh(data_parallel=4, model_parallel=2)
+        assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+    def test_auto_data_parallel(self):
+        mesh = make_mesh(model_parallel=2)
+        assert mesh.shape["data"] == 4   # 8 devices / 2
+
+    def test_oversubscription_raises(self):
+        with pytest.raises(ValueError):
+            make_mesh(data_parallel=16)
+        with pytest.raises(ValueError):
+            make_mesh(model_parallel=16)
+
+
+class TestSharding:
+    def test_data_sharding_places_shards(self, mesh8):
+        x = jnp.arange(16.0).reshape(16, 1)
+        y = jax.device_put(x, data_sharding(mesh8, ndim=2))
+        assert len(y.sharding.device_set) == 8
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+    def test_replicated(self, mesh8):
+        x = jnp.ones((4,))
+        y = jax.device_put(x, replicated(mesh8))
+        assert y.sharding.is_fully_replicated
+
+    def test_shard_leading_axis_tree(self, mesh8):
+        tree = {"a": jnp.ones((8, 3)), "b": jnp.zeros((16,))}
+        out = shard_leading_axis(mesh8, tree)
+        assert len(out["a"].sharding.device_set) == 8
+        assert len(out["b"].sharding.device_set) == 8
+
+
+class TestPadding:
+    def test_pad_and_orig_size(self):
+        x, orig = pad_to_multiple(np.ones((10, 3)), 8)
+        assert x.shape == (16, 3) and orig == 10
+        np.testing.assert_allclose(x[10:], 0.0)
+
+    def test_already_aligned_untouched(self):
+        x = np.ones((16, 2))
+        y, orig = pad_to_multiple(x, 8)
+        assert y.shape == (16, 2) and orig == 16
+
+    def test_pad_other_axis(self):
+        x, orig = pad_to_multiple(np.ones((3, 10)), 4, axis=1, pad_value=-1.0)
+        assert x.shape == (3, 12) and orig == 10
+        np.testing.assert_allclose(x[:, 10:], -1.0)
