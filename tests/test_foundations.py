@@ -1,0 +1,135 @@
+"""Foundations: config file IO, data ingest round-trips, PRNG discipline,
+LLM adapter contract, launcher wall-clock loop."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu import load_config
+from ai_crypto_trader_tpu.data.ingest import from_dict, load_csv, save_csv
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.prng import fold, root_key, split_tree
+from ai_crypto_trader_tpu.shell.llm import LLMTrader, TechnicalPolicyBackend
+
+
+class TestConfigIO:
+    def test_load_from_file_with_nested_sections(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({
+            "trading": {"stop_loss_pct": 1.25, "max_positions": 3},
+            "risk": {"trailing_stop": {"strategy": "atr_based"}},
+            "unknown_section": {"x": 1},
+        }))
+        cfg = load_config(str(p))
+        assert cfg.trading.stop_loss_pct == 1.25
+        assert cfg.trading.max_positions == 3
+        assert cfg.risk.trailing_stop.strategy == "atr_based"
+        # untouched sections keep defaults
+        assert cfg.trading.take_profit_pct == 4.0
+
+    def test_int_accepted_for_float_field(self, tmp_path):
+        p = tmp_path / "c.json"
+        p.write_text(json.dumps({"trading": {"stop_loss_pct": 2}}))
+        cfg = load_config(str(p))
+        assert cfg.trading.stop_loss_pct == 2.0
+        assert isinstance(cfg.trading.stop_loss_pct, float)
+
+    def test_bool_not_accepted_as_int(self, tmp_path):
+        p = tmp_path / "c.json"
+        p.write_text(json.dumps({"trading": {"max_positions": True}}))
+        with pytest.raises(TypeError):
+            load_config(str(p))
+
+
+class TestIngest:
+    def test_csv_roundtrip(self, tmp_path):
+        d = generate_ohlcv(n=50, seed=1)
+        series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                           symbol="XUSDC")
+        path = save_csv(series, str(tmp_path))
+        loaded = load_csv(path, symbol="XUSDC")
+        np.testing.assert_allclose(loaded.close, series.close, rtol=1e-5)
+        np.testing.assert_array_equal(loaded.timestamp, series.timestamp)
+        assert len(loaded.slice(10, 20)) == 10
+
+    def test_klines_to_arrays(self):
+        from ai_crypto_trader_tpu.data.ingest import klines_to_arrays
+        rows = [[1000 + i, 1.0 + i, 2.0 + i, 0.5 + i, 1.5 + i, 10.0, 0, 0, 0,
+                 0, 0, 0] for i in range(5)]
+        s = klines_to_arrays(rows, symbol="ABC")
+        assert len(s) == 5 and s.high[0] == 2.0 and s.timestamp[0] == 1000
+
+
+class TestPRNG:
+    def test_split_tree_deterministic_and_distinct(self):
+        k = root_key(7)
+        t1 = split_tree(k, ("a", "b", "c"))
+        t2 = split_tree(root_key(7), ("a", "b", "c"))
+        np.testing.assert_array_equal(np.asarray(t1["a"]), np.asarray(t2["a"]))
+        assert not np.array_equal(np.asarray(t1["a"]), np.asarray(t1["b"]))
+
+    def test_fold_per_step(self):
+        k = root_key(0)
+        assert not np.array_equal(np.asarray(fold(k, 1)), np.asarray(fold(k, 2)))
+
+
+class TestLLMTrader:
+    def test_technical_backend_contract(self):
+        async def go():
+            t = LLMTrader(backend=TechnicalPolicyBackend())
+            out = await t.analyze_trade_opportunity({
+                "symbol": "X", "rsi": 28.0, "signal": "BUY",
+                "signal_strength": 88.0})
+            assert out["decision"] == "BUY"
+            assert 0.0 < out["confidence"] <= 1.0
+            assert "model_version" in out
+            assert t.should_take_trade(out)
+            weak = await t.analyze_trade_opportunity({
+                "symbol": "X", "rsi": 50.0, "signal": "NEUTRAL",
+                "signal_strength": 10.0})
+            assert not t.should_take_trade(weak)
+        asyncio.run(go())
+
+    def test_malformed_backend_output_safe(self):
+        class Broken:
+            def complete(self, prompt):
+                return "not json at all"
+
+        async def go():
+            t = LLMTrader(backend=Broken())
+            out = await t.analyze_trade_opportunity({"symbol": "X"})
+            assert out["decision"] == "HOLD" and out["confidence"] == 0.0
+            risk = await t.analyze_risk_setup({"available_capital": 1000.0,
+                                               "volatility": 0.03})
+            assert risk["position_size"] == 250.0        # 0.25 ladder
+            assert risk["take_profit_pct"] == risk["stop_loss_pct"] * 2
+        asyncio.run(go())
+
+    def test_adjust_position_size_conservative(self):
+        t = LLMTrader()
+        out = t.adjust_position_size(
+            {"position_size": 200.0, "stop_loss_pct": 1.0,
+             "take_profit_pct": 5.0},
+            {"position_size": 100.0, "stop_loss_pct": 2.0,
+             "take_profit_pct": 4.0})
+        assert out["position_size"] == 150.0
+        assert out["stop_loss_pct"] == 1.0      # min of the two
+        assert out["take_profit_pct"] == 4.0    # min of the two
+
+
+class TestLauncherRunLoop:
+    def test_run_wall_clock(self):
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+        from tests.test_shell import _series
+
+        async def go():
+            ex = FakeExchange({"BTCUSDC": _series(n=400)})
+            ex.advance("BTCUSDC", steps=300)
+            sys_ = TradingSystem(ex, ["BTCUSDC"])
+            await sys_.run(duration_s=0.05, tick_interval_s=0.01)
+            # loop executed at least a few ticks without error
+            assert sys_.status()["channels"].get("market_updates", 0) >= 1
+        asyncio.run(go())
