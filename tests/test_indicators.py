@@ -123,6 +123,31 @@ class TestOscillators:
         assert_close(ours, ref, rtol=1e-3, atol=5.0)
 
 
+class TestTrendVolume:
+    def test_ichimoku(self, ohlcv):
+        s = _series(ohlcv)
+        conv = (s["high"].rolling(9).max() + s["low"].rolling(9).min()) / 2
+        base = (s["high"].rolling(26).max() + s["low"].rolling(26).min()) / 2
+        a_ref = (conv + base) / 2
+        b_ref = (s["high"].rolling(52).max() + s["low"].rolling(52).min()) / 2
+        a, b = ops.ichimoku(jnp.asarray(ohlcv["high"]), jnp.asarray(ohlcv["low"]))
+        assert_close(a, a_ref, atol=5e-1)
+        assert_close(b, b_ref, atol=5e-1)
+
+    def test_obv(self, ohlcv):
+        s = _series(ohlcv)
+        sign = np.sign(s["close"].diff().fillna(0.0))
+        ref = (sign * s["volume"]).cumsum()
+        ours = ops.obv(jnp.asarray(ohlcv["close"]), jnp.asarray(ohlcv["volume"]))
+        np.testing.assert_allclose(np.asarray(ours), ref.to_numpy(),
+                                   rtol=1e-3, atol=2.0)
+
+    def test_roc(self, ohlcv):
+        s = _series(ohlcv)["close"]
+        ref = (s - s.shift(12)) / s.shift(12) * 100
+        assert_close(ops.roc(jnp.asarray(ohlcv["close"]), 12), ref, atol=5e-2)
+
+
 class TestFill:
     def test_ffill_bfill(self):
         x = jnp.array([np.nan, 1.0, np.nan, 3.0, np.nan])
