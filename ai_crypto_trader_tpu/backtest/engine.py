@@ -313,8 +313,18 @@ def sweep_sharded(mesh, inputs: BacktestInputs, params: StrategyParams, **kw):
     The population axis is split across devices; every device runs its shard
     of strategies over the (replicated) candle array, and results are
     all-gathered — the ICI collective that replaces the reference's
-    "publish fitness to Redis" (SURVEY §2.7)."""
+    "publish fitness to Redis" (SURVEY §2.7).
+
+    Populations that don't divide the data axis are transparently padded
+    (repeating the last individual) and the results sliced back."""
     data_axis = mesh.axis_names[0]
+    n_dev = mesh.shape[data_axis]
+    pop = jax.tree.leaves(params)[0].shape[0]
+    pad = (-pop) % n_dev
+    if pad:
+        params = jax.tree.map(
+            lambda x: jnp.concatenate([x, jnp.repeat(x[-1:], pad, axis=0)]),
+            params)
     pspec = P(data_axis)
 
     def local_sweep(p_shard):
@@ -328,4 +338,9 @@ def sweep_sharded(mesh, inputs: BacktestInputs, params: StrategyParams, **kw):
         check_vma=False,
     )
     params = jax.device_put(params, NamedSharding(mesh, pspec))
-    return shard_fn(params)
+    out = shard_fn(params)
+    if pad:
+        out = jax.tree.map(
+            lambda x: x[:pop] if getattr(x, "ndim", 0) >= 1
+            and x.shape[0] == pop + pad else x, out)
+    return out

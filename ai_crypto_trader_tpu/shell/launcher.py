@@ -28,6 +28,7 @@ from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
 from ai_crypto_trader_tpu.shell.executor import TradeExecutor
 from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
 from ai_crypto_trader_tpu.utils.alerts import AlertManager
+from ai_crypto_trader_tpu.utils.health import HeartbeatRegistry
 from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
 
 
@@ -43,6 +44,7 @@ class TradingSystem:
         self.bus = EventBus(now_fn=self.now_fn)
         self.metrics = MetricsRegistry(now_fn=self.now_fn)
         self.alerts = AlertManager(now_fn=self.now_fn)
+        self.heartbeats = HeartbeatRegistry(now_fn=self.now_fn)
         self.monitor = MarketMonitor(self.bus, self.exchange,
                                      symbols=self.symbols, now_fn=self.now_fn)
         self.analyzer = SignalAnalyzer(
@@ -60,8 +62,11 @@ class TradingSystem:
     async def tick(self) -> dict:
         """One full pass of the live signal path + observability."""
         published = await self.monitor.poll()
+        self.heartbeats.beat("monitor")
         analyzed = await self.analyzer.run_once()
+        self.heartbeats.beat("analyzer")
         executed = await self.executor.run_once()
+        self.heartbeats.beat("executor")
         if published:
             self._last_market_update = self.now_fn()
         for symbol in self.symbols:
@@ -91,6 +96,7 @@ class TradingSystem:
             "market_data_age_s": self.now_fn() - self._last_market_update,
             "open_positions": len(self.executor.active_trades),
             "max_positions": self.config.trading.max_positions,
+            "service_health": self.heartbeats.health(),
         })
         for alert in fired:
             await self.bus.publish("alerts", alert)

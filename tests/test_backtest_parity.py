@@ -191,6 +191,15 @@ class TestSweep:
         np.testing.assert_array_equal(np.asarray(plain.total_trades),
                                       np.asarray(sharded.total_trades))
 
+    def test_shard_map_pads_uneven_population(self, ohlcv, mesh8):
+        inp = _inputs(ohlcv, n=512)
+        params = sample_params(jax.random.PRNGKey(2), 11)  # not divisible by 8
+        plain = sweep(inp, params)
+        sharded = sweep_sharded(mesh8, inp, params)
+        assert sharded.final_balance.shape == (11,)
+        np.testing.assert_allclose(np.asarray(plain.final_balance),
+                                   np.asarray(sharded.final_balance), rtol=1e-5)
+
 
 class TestSignalRule:
     def test_scalar_oracle(self, ohlcv):
