@@ -1,0 +1,78 @@
+"""API-key security manager: issuance, auth, scopes, rotation, rate limits."""
+
+import pytest
+
+from ai_crypto_trader_tpu.utils.api_security import (
+    AccessLevel,
+    APISecurityManager,
+    KeyStatus,
+)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1_000.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def mgr(tmp_path):
+    return APISecurityManager(path=str(tmp_path / "keys.json"), now_fn=Clock())
+
+
+class TestKeys:
+    def test_create_and_authenticate(self, mgr):
+        key_id, plaintext = mgr.create_api_key("alice", AccessLevel.TRADE)
+        assert plaintext.startswith("actt_")
+        # plaintext never stored
+        assert plaintext not in str(mgr.keys)
+        out = mgr.authenticate(plaintext, scope="trade")
+        assert out.ok and out.user_id == "alice" and out.key_id == key_id
+
+    def test_scope_enforcement(self, mgr):
+        _, read_key = mgr.create_api_key("bob", AccessLevel.READ_ONLY)
+        assert mgr.authenticate(read_key, "read").ok
+        denied = mgr.authenticate(read_key, "trade")
+        assert not denied.ok and denied.reason == "insufficient_access"
+        _, admin_key = mgr.create_api_key("root", AccessLevel.ADMIN)
+        assert mgr.authenticate(admin_key, "admin").ok
+
+    def test_unknown_key(self, mgr):
+        out = mgr.authenticate("nope")
+        assert not out.ok and out.reason == "unknown_key"
+
+    def test_expiry(self, mgr):
+        _, key = mgr.create_api_key("c", ttl_s=100.0)
+        assert mgr.authenticate(key).ok
+        mgr.now_fn.t += 101.0
+        out = mgr.authenticate(key)
+        assert not out.ok and out.reason == "expired"
+        assert mgr.cleanup_expired_keys() == 0  # already transitioned
+
+    def test_revoke_and_rotate(self, mgr):
+        key_id, key = mgr.create_api_key("d", AccessLevel.TRADE)
+        assert mgr.revoke_key(key_id)
+        assert mgr.authenticate(key).reason == KeyStatus.REVOKED.value
+        new_id, new_key = mgr.rotate_key(key_id)
+        assert new_id != key_id
+        out = mgr.authenticate(new_key, "trade")
+        assert out.ok and out.user_id == "d"
+
+    def test_rate_limit(self, mgr):
+        mgr.rate_per_s, mgr.burst = 1.0, 2.0
+        _, key = mgr.create_api_key("e")
+        assert mgr.authenticate(key).ok and mgr.authenticate(key).ok
+        out = mgr.authenticate(key)
+        assert not out.ok and out.reason == "rate_limited"
+        mgr.now_fn.t += 1.1
+        assert mgr.authenticate(key).ok
+
+    def test_persistence_roundtrip(self, tmp_path):
+        clock = Clock()
+        m1 = APISecurityManager(path=str(tmp_path / "k.json"), now_fn=clock)
+        _, key = m1.create_api_key("f", AccessLevel.TRADE)
+        m2 = APISecurityManager(path=str(tmp_path / "k.json"), now_fn=clock)
+        assert m2.authenticate(key, "trade").ok
+        assert len(m2.list_user_keys("f")) == 1
