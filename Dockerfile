@@ -1,0 +1,18 @@
+# ai_crypto_trader_tpu — single-process deployment.
+# The reference ships 16 containers wired by Redis (docker-compose.yml:1-419);
+# this framework is one process per host: the compute core runs inside the
+# JAX runtime, services share one event loop, /metrics + /health are served
+# in-process. On TPU VMs, base this on a jax[tpu]-provisioned image.
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY ai_crypto_trader_tpu ./ai_crypto_trader_tpu
+COPY bench.py __graft_entry__.py ./
+
+# jax/flax/optax/orbax are expected from the accelerator base image on TPU
+# hosts; for CPU paper-trading installs:
+RUN pip install --no-cache-dir "jax[cpu]" flax optax orbax-checkpoint chex einops
+
+EXPOSE 9090
+ENTRYPOINT ["python", "-m", "ai_crypto_trader_tpu.cli"]
+CMD ["trade", "--paper", "--ticks", "1000"]
