@@ -50,7 +50,12 @@ from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
 
 
 class BacktestInputs(NamedTuple):
-    """Per-candle arrays consumed by the scan (all shape [T])."""
+    """Per-candle arrays consumed by the scan (all shape [T]).
+
+    sl_pct / tp_pct are OPTIONAL per-candle exit levels (percent) captured
+    at entry time — the ATR-adaptive stop path
+    (`portfolio_risk_service.py:489-547` applied per entry). NaN means
+    "no override": the engine falls back to StrategyParams / the sizer."""
 
     close: jnp.ndarray
     signal: jnp.ndarray        # int32 {-1,0,1}
@@ -59,6 +64,8 @@ class BacktestInputs(NamedTuple):
     volume: jnp.ndarray        # avg quote volume
     confidence: jnp.ndarray    # AI-gate confidence in [0,1]
     decision: jnp.ndarray      # AI-gate decision int32 {-1,0,1}
+    sl_pct: jnp.ndarray        # per-candle SL override (NaN = none)
+    tp_pct: jnp.ndarray        # per-candle TP override (NaN = none)
 
 
 class CarryState(NamedTuple):
@@ -118,10 +125,12 @@ def prepare_inputs(ind: dict, confidence=None, decision=None,
         confidence = jnp.ones((T,), jnp.float32)
     if decision is None:
         decision = signal
+    nan = jnp.full((T,), jnp.nan, jnp.float32)
     return BacktestInputs(
         close=feats.close, signal=signal, strength=strength,
         volatility=feats.volatility, volume=feats.volume,
         confidence=confidence, decision=decision,
+        sl_pct=nan, tp_pct=nan,
     )
 
 
@@ -189,7 +198,8 @@ def run_backtest(
     steps = jnp.arange(T, dtype=jnp.int32)
 
     def step(s: CarryState, x):
-        t, close, signal, strength, vol, volume, conf, decision = x
+        (t, close, signal, strength, vol, volume, conf, decision,
+         sl_override, tp_override) = x
         active = t >= warmup
         prev_balance = s.balance
 
@@ -225,6 +235,9 @@ def run_backtest(
             sl_new = plan.stop_loss_pct * unit
             tp_new = plan.take_profit_pct * unit
             size = plan.size
+        # per-candle overrides (ATR-adaptive stops) win where provided
+        sl_new = jnp.where(jnp.isnan(sl_override), sl_new, sl_override)
+        tp_new = jnp.where(jnp.isnan(tp_override), tp_new, tp_override)
         s = s._replace(
             in_pos=s.in_pos | gate,
             entry=jnp.where(gate, close, s.entry),
@@ -297,7 +310,13 @@ def sweep(inputs: BacktestInputs, params: StrategyParams,
 
     This is the inner loop the GA calls; `run_multiple_backtests`'s
     sequential nested for-loops (`backtest_engine.py:127-178`) become one
-    device program."""
+    device program.
+
+    `inputs` must carry NaN sl_pct/tp_pct columns (as `prepare_inputs`
+    builds them): finite per-candle overrides win over every genome's
+    stop_loss/take_profit, which would silently deaden those population
+    dimensions. Per-genome ATR-adaptive inputs belong in
+    `evolvable.population_backtest`, which rebuilds inputs per member."""
     fn = lambda p: run_backtest(
         inputs, p, initial_balance=initial_balance,
         ai_confidence_threshold=ai_confidence_threshold,

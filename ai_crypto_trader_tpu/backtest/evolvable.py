@@ -116,11 +116,25 @@ def evolvable_inputs(ohlcv: dict, p: StrategyParams,
     close = ohlcv["close"]
     avg_volume = jnp.mean(ohlcv["volume"]) * jnp.mean(close)
     T = close.shape[-1]
+    # ATR-adaptive exits — an EXTENSION inspired by the reference's adaptive
+    # stop-loss concept (`portfolio_risk_service.py:489-547` scales only the
+    # stop, from annualized std). Here both SL and TP scale with *relative*
+    # volatility (current ATR vs the series median, preserving the genome's
+    # reward:risk ratio), bounded to the same 0.5-2.0 factor range.
+    # atr_multiplier=2 at median volatility is the neutral anchor; this makes
+    # both ATR genome dims live in fitness (volatility =
+    # atr_dyn(p.atr_period)/close).
+    vol_ref = jnp.maximum(jnp.median(volatility), 1e-8)
+    factor = jnp.clip(p.atr_multiplier * volatility / (2.0 * vol_ref),
+                      0.5, 2.0)
+    sl_t = p.stop_loss * factor
+    tp_t = p.take_profit * factor
     return BacktestInputs(
         close=close, signal=signal, strength=strength, volatility=volatility,
         volume=jnp.full((T,), avg_volume, jnp.float32),
         confidence=jnp.ones((T,), jnp.float32),
         decision=signal,
+        sl_pct=sl_t, tp_pct=tp_t,
     )
 
 

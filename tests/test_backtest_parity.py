@@ -42,6 +42,7 @@ def python_position_size(capital, vol, volume, max_risk=0.15):
 
 
 def python_backtest(close, signal, strength, vol, volume, conf, decision,
+                    sl_series=None, tp_series=None,
                     initial=10_000.0, warmup=10, thresh=0.7, min_strength=70.0,
                     quirks=False, param_sl=None, param_tp=None):
     balance = initial
@@ -91,6 +92,11 @@ def python_backtest(close, signal, strength, vol, volume, conf, decision,
             else:
                 unit = 1.0 if quirks else 100.0
                 sl, tp = sl_frac * unit, tp_frac * unit
+            # per-candle overrides (ATR-adaptive exits) win where finite
+            if sl_series is not None and not np.isnan(sl_series[t]):
+                sl = float(sl_series[t])
+            if tp_series is not None and not np.isnan(tp_series[t]):
+                tp = float(tp_series[t])
             in_pos = True
         returns.append((balance - prev) / prev)
         if balance > max_eq:
@@ -148,6 +154,19 @@ class TestParity:
         args = [np.asarray(x) for x in inp]
         oracle = python_backtest(*args, param_sl=float(p.stop_loss), param_tp=float(p.take_profit))
         stats = run_backtest(inp, p, use_param_sl_tp=True)
+        _assert_parity(stats, oracle, compute_metrics(stats))
+
+    def test_per_candle_sl_tp_overrides(self, ohlcv):
+        """ATR-adaptive per-candle exit levels match the scalar oracle."""
+        rng = np.random.default_rng(5)
+        inp = _inputs(ohlcv)
+        T = inp.close.shape[0]
+        sl = rng.uniform(0.5, 3.0, T).astype(np.float32)
+        tp = rng.uniform(1.0, 6.0, T).astype(np.float32)
+        inp = inp._replace(sl_pct=jnp.asarray(sl), tp_pct=jnp.asarray(tp))
+        args = [np.asarray(x) for x in inp]
+        oracle = python_backtest(*args)
+        stats = run_backtest(inp)
         _assert_parity(stats, oracle, compute_metrics(stats))
 
     def test_frozen_features_mode(self, ohlcv):

@@ -79,6 +79,18 @@ class TestEvolvable:
         stats = evolvable_backtest(arr, default_params())
         assert np.isfinite(float(stats.final_balance))
 
+    def test_atr_params_are_live(self, ohlcv):
+        """atr_multiplier must change backtest outcomes (it scales the
+        adaptive exit levels) — no dead genome dimensions."""
+        arr = _arrays(ohlcv, n=1024)
+        base = default_params()
+        wide = base._replace(atr_multiplier=jnp.asarray(4.0))
+        tight = base._replace(atr_multiplier=jnp.asarray(1.0))
+        s_wide = evolvable_backtest(arr, wide)
+        s_tight = evolvable_backtest(arr, tight)
+        assert (float(s_wide.final_balance) != float(s_tight.final_balance)
+                or int(s_wide.total_trades) != int(s_tight.total_trades))
+
     def test_population_batch(self, ohlcv):
         arr = _arrays(ohlcv)
         pop = sample_params(jax.random.PRNGKey(0), 4)
