@@ -1,0 +1,67 @@
+"""Queued backtest execution.
+
+Capability parity with BacktestEngine's asyncio task queue
+(`backtesting/backtest_engine.py:217-304`: `add_backtest_task` /
+`process_task_queue`): callers enqueue named backtest jobs, a worker drains
+them, results land in a store + the bus.  Jobs run the vectorized engine,
+so "queueing" is for orchestration (many symbols/param sets arriving over
+time), not for parallelism — each job is already device-parallel inside.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class BacktestQueue:
+    bus: object | None = None
+    now_fn: any = time.time
+    results: dict = field(default_factory=dict)
+    _queue: asyncio.Queue = field(default_factory=asyncio.Queue)
+    _counter: int = 0
+
+    def add_backtest_task(self, ohlcv: dict, params=None, *,
+                          name: str | None = None, **kw) -> str:
+        """Enqueue; returns the task id (`add_backtest_task:217`)."""
+        self._counter += 1
+        task_id = name or f"bt_{self._counter}"
+        self._queue.put_nowait(
+            {"id": task_id, "ohlcv": ohlcv, "params": params, "kw": kw,
+             "enqueued_at": self.now_fn()})
+        return task_id
+
+    async def process_task_queue(self, max_tasks: int | None = None) -> int:
+        """Drain the queue (`process_task_queue:268-304`); returns #run."""
+        from ai_crypto_trader_tpu.backtest.evolvable import evolvable_backtest
+        from ai_crypto_trader_tpu.backtest.metrics import compute_metrics
+        from ai_crypto_trader_tpu.backtest.strategy import default_params
+
+        n = 0
+        while not self._queue.empty():
+            if max_tasks is not None and n >= max_tasks:
+                break
+            task = self._queue.get_nowait()
+            params = task["params"] if task["params"] is not None else default_params()
+            stats = evolvable_backtest(task["ohlcv"], params, **task["kw"])
+            metrics = {k: float(np.asarray(v))
+                       for k, v in compute_metrics(stats).items()}
+            record = {"id": task["id"], "metrics": metrics,
+                      "completed_at": self.now_fn(),
+                      "queue_latency_s": self.now_fn() - task["enqueued_at"]}
+            self.results[task["id"]] = record
+            if self.bus is not None:
+                await self.bus.publish("backtest_results", record)
+            n += 1
+        return n
+
+    def get_result(self, task_id: str) -> dict | None:
+        return self.results.get(task_id)
+
+    @property
+    def pending(self) -> int:
+        return self._queue.qsize()

@@ -41,6 +41,36 @@ class TestPortfolio:
         assert np.isfinite(float(portfolio["mean_sharpe"]))
 
 
+class TestBacktestQueue:
+    def test_enqueue_process_results(self):
+        import asyncio
+
+        import jax.numpy as jnp
+
+        from ai_crypto_trader_tpu.backtest.queue import BacktestQueue
+        from ai_crypto_trader_tpu.shell.bus import EventBus
+
+        async def go():
+            bus = EventBus()
+            q = BacktestQueue(bus=bus, now_fn=lambda: 0.0)
+            sub = bus.subscribe("backtest_results")
+            d = generate_ohlcv(n=300, seed=2)
+            arrays = {k: jnp.asarray(v) for k, v in d.items() if k != "regime"}
+            t1 = q.add_backtest_task(arrays)
+            t2 = q.add_backtest_task(arrays, name="custom")
+            assert q.pending == 2 and t2 == "custom"
+            ran = await q.process_task_queue()
+            assert ran == 2 and q.pending == 0
+            assert "sharpe_ratio" in q.get_result(t1)["metrics"]
+            assert sub.get_nowait()["data"]["id"] == t1
+            # max_tasks cap respected
+            q.add_backtest_task(arrays)
+            q.add_backtest_task(arrays)
+            assert await q.process_task_queue(max_tasks=1) == 1
+            assert q.pending == 1
+        asyncio.run(go())
+
+
 class TestHealth:
     def test_heartbeats(self):
         clock = {"t": 0.0}
