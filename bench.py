@@ -22,7 +22,7 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def reference_cpu_candles_per_sec(inputs, n=20_000) -> float:
+def reference_cpu_candles_per_sec(inputs, n=200_000) -> float:
     """Faithful scalar port of the reference replay loop (strategy_tester.py
     :190-300 semantics; see tests/test_backtest_parity.py oracle)."""
     import os
