@@ -163,6 +163,7 @@ def position_size(total_capital, volatility, volume,
     than intended.  This function reproduces the raw sizer; the engine
     decides the interpretation via its `reference_quirks` flag.
     """
+    volatility = jnp.asarray(volatility)
     hi = volatility > 0.02
     mid = (~hi) & (volatility > 0.01)
     position_pct = jnp.where(hi, 0.25, jnp.where(mid, 0.20, 0.15))

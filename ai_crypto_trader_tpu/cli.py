@@ -48,13 +48,28 @@ def _load_or_generate(symbol: str, candles: int, seed: int = 0):
 
 
 def cmd_fetch(args):
+    """`run_backtest.py fetch` parity. --source binance runs the real
+    paginated fetch (`data_manager.py:47-114` semantics) over the network;
+    the default synthesizes (this dev environment has no egress)."""
     from ai_crypto_trader_tpu.data.ingest import from_dict, save_csv
     from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
 
     n = args.days * 1440
-    d = generate_ohlcv(n=n, seed=args.seed)
-    series = from_dict({k: v for k, v in d.items() if k != "regime"},
-                       symbol=args.symbol, interval="1m")
+    if args.source == "binance":
+        from ai_crypto_trader_tpu.data.fetchers import (
+            UrllibTransport,
+            fetch_klines_ohlcv,
+        )
+
+        end_ms = int(time.time() * 1000)
+        series = asyncio.run(fetch_klines_ohlcv(
+            UrllibTransport(), args.symbol, "1m",
+            end_ms - args.days * 86_400_000, end_ms))
+        n = len(series)
+    else:
+        d = generate_ohlcv(n=n, seed=args.seed)
+        series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                           symbol=args.symbol, interval="1m")
     path = save_csv(series, DATA_DIR)
     print(f"saved {n} candles -> {path}")
 
@@ -219,7 +234,7 @@ def cmd_mc(args):
 def cmd_trade(args):
     from ai_crypto_trader_tpu.data.ingest import from_dict
     from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
-    from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+    from ai_crypto_trader_tpu.shell.exchange import make_exchange
     from ai_crypto_trader_tpu.shell.launcher import TradingSystem
 
     if not args.paper:
@@ -229,11 +244,26 @@ def cmd_trade(args):
     d = generate_ohlcv(n=args.ticks + 600, seed=args.seed)
     series = from_dict({k: v for k, v in d.items() if k != "regime"},
                        symbol=args.symbol)
-    ex = FakeExchange({args.symbol: series}, quote_balance=10_000.0)
+    clock = {"t": 0.0}                   # virtual clock shared by all layers
+    # Paper mode rides the same resilient adapter seam as live trading
+    # (breaker + rate limit + retries around every exchange call), on the
+    # virtual clock so rate limiting never sleeps real wall-clock time.
+    ex = make_exchange(
+        "fake", resilient=True,
+        resilient_opts={"now_fn": lambda: clock["t"],
+                        "sleep": lambda s: clock.__setitem__("t", clock["t"] + s)},
+        series={args.symbol: series}, quote_balance=10_000.0)
     ex.advance(args.symbol, steps=600)   # warm history so the monitor has a
-    clock = {"t": 0.0}                   # full fixed-shape indicator window
+    #                                      full fixed-shape indicator window
     system = TradingSystem(ex, [args.symbol], now_fn=lambda: clock["t"],
                            dashboard_path=args.dashboard)
+
+    server = None
+    if args.serve is not None:
+        from ai_crypto_trader_tpu.shell.dashboard_server import DashboardServer
+
+        server = DashboardServer(system, port=args.serve).start()
+        print(f"dashboard: http://127.0.0.1:{server.port}/", flush=True)
 
     async def go():
         for _ in range(args.ticks):
@@ -242,7 +272,13 @@ def cmd_trade(args):
             await system.tick()
         print(json.dumps(system.status(), indent=2, default=str))
 
-    asyncio.run(go())
+    try:
+        asyncio.run(go())
+        if server is not None and args.serve_hold_s > 0:
+            time.sleep(args.serve_hold_s)
+    finally:
+        if server is not None:
+            server.stop()
 
 
 def cmd_registry(args):
@@ -281,6 +317,8 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--seed", type=int, default=0)
 
     sp = sub.add_parser("fetch", help="fetch (or synthesize) candles to CSV")
+    sp.add_argument("--source", choices=("synthetic", "binance"),
+                    default="synthetic")
     common(sp); sp.set_defaults(fn=cmd_fetch)
     sp = sub.add_parser("backtest", help="run a vectorized backtest")
     common(sp)
@@ -315,7 +353,13 @@ def build_parser() -> argparse.ArgumentParser:
     common(sp)
     sp.add_argument("--paper", action="store_true")
     sp.add_argument("--ticks", type=int, default=100)
-    sp.add_argument("--dashboard", default=None)
+    sp.add_argument("--dashboard", default=None,
+                    help="write a static HTML snapshot per tick to this path")
+    sp.add_argument("--serve", type=int, default=None, metavar="PORT",
+                    help="serve the LIVE dashboard on this port during the "
+                         "run (reference dashboard.py :8050 behavior)")
+    sp.add_argument("--serve-hold-s", type=float, default=0.0,
+                    help="keep serving this many seconds after the ticks")
     sp.set_defaults(fn=cmd_trade)
     sp = sub.add_parser("registry", help="inspect the model registry")
     sp.add_argument("--path", default="models/registry.json")

@@ -1,7 +1,9 @@
 from ai_crypto_trader_tpu.shell.bus import EventBus  # noqa: F401
 from ai_crypto_trader_tpu.shell.exchange import (  # noqa: F401
     ExchangeInterface,
+    ExchangeUnavailable,
     FakeExchange,
+    ResilientExchange,
     make_exchange,
 )
 from ai_crypto_trader_tpu.shell.llm import (  # noqa: F401

@@ -54,10 +54,12 @@ def _fmt(v):
 def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
                      metrics: dict | None = None, mc_stats: dict | None = None,
                      signals: list | None = None, alerts: list | None = None,
-                     regime: dict | None = None, now_fn=time.time) -> str:
+                     regime: dict | None = None, refresh_s: float | None = None,
+                     now_fn=time.time) -> str:
     """Return the dashboard HTML. Every section is optional — sections
     render from whatever state exists (like the reference's per-callback
-    panels tolerating missing Redis keys)."""
+    panels tolerating missing Redis keys). `refresh_s` adds a meta-refresh
+    so a served page polls like the reference's 5 s Dash interval."""
     sections = []
     if price_series is not None:
         sections.append(_svg_line(price_series, label="price", color="#4af"))
@@ -87,7 +89,9 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
         sections.append(_table(rows, "Active alerts"))
 
     body = "\n".join(sections) or "<p>no data yet</p>"
-    return f"""<!doctype html><html><head><meta charset="utf-8">
+    refresh = (f'<meta http-equiv="refresh" content="{refresh_s:g}">'
+               if refresh_s else "")
+    return f"""<!doctype html><html><head><meta charset="utf-8">{refresh}
 <title>ai_crypto_trader_tpu</title><style>
 body{{background:#0a0a0a;color:#ddd;font-family:system-ui;margin:24px}}
 .card{{background:#161616;border-radius:6px;padding:12px;margin:10px 0;

@@ -1,0 +1,131 @@
+"""Live dashboard HTTP server.
+
+Parity with the reference's continuously-refreshing Dash UI on :8050
+(`dashboard.py:442-2266`, redis_listener :89-133, ~25 polling callbacks,
+5 s refresh): a stdlib ThreadingHTTPServer that re-renders the dashboard
+from live bus state on EVERY request — the polling pull model the Dash
+callbacks implement, without taking on the Dash dependency. Endpoints:
+
+  /            HTML dashboard (meta-refresh = the Dash interval component)
+  /state.json  machine-readable bus state (the Redis-keys surface the
+               reference's callbacks read)
+  /metrics     Prometheus text exposition (reference: aiohttp /metrics,
+               `services/utils/metrics.py:189-221`)
+  /health      heartbeat/liveness JSON (reference: per-service TCP health
+               listeners, e.g. `services/monte_carlo_service.py:825-845`)
+
+The server runs in a daemon thread; `port=0` binds an ephemeral port
+(tests). Reads of live bus dicts from the serving thread are safe under
+the GIL (same consistency model as the reference's Redis polling — a
+render may see a mid-tick snapshot, never a torn value).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ai_crypto_trader_tpu.shell.dashboard import render_dashboard
+
+
+class DashboardServer:
+    """Serve a TradingSystem's live state over HTTP."""
+
+    def __init__(self, system, port: int = 8050, refresh_s: float = 5.0):
+        self.system = system
+        self.refresh_s = refresh_s
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # quiet: no stderr per request
+                pass
+
+            def _send(self, body: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Cache-Control", "no-store")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/":
+                        self._send(outer.render_html().encode(),
+                                   "text/html; charset=utf-8")
+                    elif path == "/state.json":
+                        self._send(json.dumps(outer.state(),
+                                              default=str).encode(),
+                                   "application/json")
+                    elif path == "/metrics":
+                        self._send(outer.system.metrics.exposition().encode(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/health":
+                        self._send(json.dumps(outer.health()).encode(),
+                                   "application/json")
+                    else:
+                        self._send(b"not found", "text/plain", 404)
+                except Exception as exc:               # noqa: BLE001
+                    self._send(f"render error: {exc}".encode(),
+                               "text/plain", 500)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    # --- view assembly ------------------------------------------------------
+    def render_html(self) -> str:
+        # Handler threads read ONLY launcher/bus state (GIL-safe snapshot
+        # reads) — never the exchange: that would burn trading rate-limit
+        # tokens and perturb virtual clocks from a foreign thread.
+        system = self.system
+        sym = system.symbols[0] if system.symbols else None
+        klines = (system.bus.get(f"historical_data_{sym}_1m") or []) if sym else []
+        prices = [row[4] for row in klines] if klines else None
+        signals = [system.bus.get(f"latest_signal_{s}")
+                   for s in system.symbols]
+        status = system.status_cached()
+        return render_dashboard(
+            bus=system.bus,
+            price_series=prices,
+            metrics={"portfolio_value_usd": status.get(
+                         "portfolio_value_usd",
+                         status["balances"].get("USDC", 0.0)),
+                     "closed_trades": status["closed_trades"],
+                     "total_pnl": status["total_pnl"],
+                     "open_positions": len(status["active_trades"])},
+            signals=[s for s in signals if s],
+            alerts=list(system.alerts.active.values()),
+            refresh_s=self.refresh_s,
+            now_fn=system.now_fn)
+
+    def state(self) -> dict:
+        system = self.system
+        bus_state = {k: system.bus.get(k) for k in system.bus.keys("*")
+                     if isinstance(system.bus.get(k),
+                                   (int, float, str, list, dict))}
+        return {"status": system.status_cached(), "bus": bus_state}
+
+    def health(self) -> dict:
+        return {"healthy": all(self.system.heartbeats.health().values())
+                if self.system.heartbeats.health() else True,
+                "services": self.system.heartbeats.health()}
+
+    # --- lifecycle ----------------------------------------------------------
+    def start(self) -> "DashboardServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dashboard", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

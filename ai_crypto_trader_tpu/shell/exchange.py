@@ -13,8 +13,10 @@ monitor / integration tests and paper trading.
 from __future__ import annotations
 
 import itertools
+import random
+import time
 from abc import ABC, abstractmethod
-from typing import Any
+from typing import Any, Callable
 
 import numpy as np
 
@@ -247,10 +249,158 @@ class BinanceExchange(ExchangeInterface):
         return {b["asset"]: float(b["free"]) for b in acct["balances"]}
 
 
-def make_exchange(kind: str = "fake", **kw) -> ExchangeInterface:
-    """ExchangeFactory parity (`exchange_interface.py:181-215`)."""
+class ExchangeUnavailable(RuntimeError):
+    """Raised by ResilientExchange when the circuit is open or an operation
+    has exhausted its retries — the caller's cycle should skip/abort."""
+
+
+class ResilientExchange(ExchangeInterface):
+    """Resilience decorator around any ExchangeInterface.
+
+    Wires the protections the reference puts around its Binance calls
+    (`services/market_monitor_service.py:96-115`: breaker 3 failures/30 s;
+    `services/utils/rate_limiter.py`; `circuit_breaker.py:227` backoff) at
+    the adapter seam, so every consumer (monitor, executor, risk, CLI) gets
+    them without wiring its own:
+
+    - every call first passes the circuit breaker (an open circuit rejects
+      at the door without burning tokens or wall-clock), then every
+      PHYSICAL attempt — including each retry — acquires from a token
+      bucket, sleeping out any deficit (Binance weight limits hold even
+      during an error storm);
+    - reads are retried with exponential backoff + jitter; a read counts
+      as ONE breaker failure only once its retries are exhausted;
+    - mutations (place_order / cancel_order) are NEVER retried — order
+      placement is not idempotent; one attempt, and any raising attempt
+      counts toward the breaker (the reference's breaker likewise wraps
+      every Binance call, business errors included:
+      `market_monitor_service.py:96-115`);
+    - an open circuit or a final failure raises ExchangeUnavailable
+      (executor cycles fail loudly instead of silently trading on None).
+
+    Deterministic: clock, sleep and jitter rng are injectable.
+    """
+
+    def __init__(self, inner: ExchangeInterface,
+                 failure_threshold: int = 3, reset_timeout_s: float = 30.0,
+                 rate_per_s: float = 20.0, burst: float = 40.0,
+                 max_read_retries: int = 2, base_delay_s: float = 0.25,
+                 max_delay_s: float = 30.0,
+                 now_fn: Callable[[], float] = time.time,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: random.Random | None = None):
+        from ai_crypto_trader_tpu.utils.circuit_breaker import CircuitBreaker
+        from ai_crypto_trader_tpu.utils.rate_limiter import TokenBucket
+
+        self.inner = inner
+        self.breaker = CircuitBreaker("exchange",
+                                      failure_threshold=failure_threshold,
+                                      reset_timeout_s=reset_timeout_s,
+                                      now_fn=now_fn)
+        self.bucket = TokenBucket(rate_per_s=rate_per_s, capacity=burst,
+                                  now_fn=now_fn)
+        self.max_read_retries = max_read_retries
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self._sleep = sleep
+        self._rng = rng or random.Random(0)
+
+    def __getattr__(self, name):
+        # Delegate the inner adapter's extra surface (FakeExchange.advance /
+        # fills / last_fill, client handles, …) so wrapping is transparent.
+        if name == "inner":                 # pre-__init__ lookup guard
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    def _gate(self):
+        # Breaker first: an open circuit must not burn tokens or wall-clock.
+        if not self.breaker.allow():
+            raise ExchangeUnavailable(
+                f"exchange circuit {self.breaker.state.value}")
+
+    def _acquire_token(self):
+        while not self.bucket.try_acquire():
+            self._sleep(max(self.bucket.wait_time(), 1e-3))
+
+    def _read(self, fn: Callable, *args, **kw):
+        from ai_crypto_trader_tpu.utils.circuit_breaker import backoff_delays
+
+        self._gate()
+        self.breaker.stats["calls"] += 1
+        delays = backoff_delays(self.max_read_retries, self.base_delay_s,
+                                self.max_delay_s, rng=self._rng)
+        last_exc: Exception | None = None
+        for _attempt in range(self.max_read_retries + 1):
+            self._acquire_token()       # every physical attempt pays a token
+            try:
+                out = fn(*args, **kw)
+            except Exception as exc:                       # noqa: BLE001
+                last_exc = exc
+                delay = next(delays, None)
+                if delay is not None:
+                    self._sleep(delay)
+                continue
+            self.breaker.record_success()
+            return out
+        self.breaker.record_failure()
+        raise ExchangeUnavailable(f"read failed after "
+                                  f"{self.max_read_retries + 1} attempts: "
+                                  f"{last_exc}") from last_exc
+
+    def _write(self, fn: Callable, *args, **kw):
+        self._gate()
+        self._acquire_token()
+        self.breaker.stats["calls"] += 1
+        try:
+            out = fn(*args, **kw)
+        except Exception as exc:                           # noqa: BLE001
+            self.breaker.record_failure()
+            raise ExchangeUnavailable(f"order operation failed: {exc}") from exc
+        self.breaker.record_success()
+        return out
+
+    # --- reads: retried ----------------------------------------------------
+    def get_ticker(self, symbol):
+        return self._read(self.inner.get_ticker, symbol)
+
+    def get_order_book(self, symbol, limit=20):
+        return self._read(self.inner.get_order_book, symbol, limit)
+
+    def get_klines(self, symbol, interval="1m", limit=100):
+        return self._read(self.inner.get_klines, symbol, interval, limit)
+
+    def get_balances(self):
+        return self._read(self.inner.get_balances)
+
+    def order_is_open(self, symbol, order_id):
+        return self._read(self.inner.order_is_open, symbol, order_id)
+
+    # --- mutations: single attempt -----------------------------------------
+    def place_order(self, symbol, side, order_type, quantity, price=None,
+                    stop_price=None):
+        return self._write(self.inner.place_order, symbol, side, order_type,
+                           quantity, price, stop_price)
+
+    def cancel_order(self, symbol, order_id):
+        return self._write(self.inner.cancel_order, symbol, order_id)
+
+
+def make_exchange(kind: str = "fake", resilient: bool | None = None,
+                  resilient_opts: dict | None = None,
+                  **kw) -> ExchangeInterface:
+    """ExchangeFactory parity (`exchange_interface.py:181-215`).
+
+    Live adapters are wrapped in ResilientExchange by default (the
+    reference wires breakers around its Binance calls; here the factory
+    guarantees it). Pass resilient=False to get the bare adapter.
+    `resilient_opts` go to the ResilientExchange ctor — simulations on a
+    virtual clock must pass their own now_fn/sleep so the token bucket
+    doesn't throttle in real wall-clock time."""
+    opts = resilient_opts or {}
     if kind == "fake":
-        return FakeExchange(**kw)
+        ex: ExchangeInterface = FakeExchange(**kw)
+        return ResilientExchange(ex, **opts) if resilient else ex
     if kind == "binance":
-        return BinanceExchange(**kw)
+        ex = BinanceExchange(**kw)
+        return ex if resilient is False else ResilientExchange(ex, **opts)
     raise ValueError(f"unknown exchange kind {kind!r}")

@@ -28,7 +28,10 @@ from ai_crypto_trader_tpu.risk.stops import (
     trailing_stop_update,
 )
 from ai_crypto_trader_tpu.shell.bus import EventBus
-from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
+from ai_crypto_trader_tpu.shell.exchange import (
+    ExchangeInterface,
+    ExchangeUnavailable,
+)
 
 
 @dataclass
@@ -101,30 +104,48 @@ class TradeExecutor:
         entry = order["price"]
         qty = order["quantity"]
 
+        # Register the position BEFORE placing protective orders: if the
+        # exchange dies between the fill and the stop placement, the trade
+        # must exist on the books (unprotected but managed) rather than be
+        # orphaned on the exchange. _ensure_protection retries on every
+        # subsequent price update.
         stop_price = entry * (1 - sl_pct / 100.0)
-        tp_price = entry * (1 + tp_pct / 100.0)
-        stop_order = self.exchange.place_order(
-            symbol, "SELL", "STOP_LOSS_LIMIT", qty,
-            price=stop_price * 0.999, stop_price=stop_price)
-        tp_order = self.exchange.place_order(
-            symbol, "SELL", "LIMIT", qty, price=tp_price)
-
         trade = ActiveTrade(
             symbol=symbol, entry_price=entry, quantity=qty,
             stop_loss_pct=sl_pct, take_profit_pct=tp_pct,
-            stop_order_id=stop_order.get("order_id"),
-            tp_order_id=tp_order.get("order_id"),
+            stop_order_id=None, tp_order_id=None,
             trailing_state=trailing_stop_init(
                 entry, stop_price, self.trailing.activation_threshold_pct),
             opened_at=self.now_fn(),
         )
         self.active_trades[symbol] = trade
+        try:
+            self._ensure_protection(trade)
+        except ExchangeUnavailable:
+            pass        # trade stays registered; protection retried later
         self.bus.set("active_trades", {s: vars(t) | {"trailing_state": None}
                                        for s, t in self.active_trades.items()})
         await self.bus.publish("trade_executions", {
             "symbol": symbol, "side": "BUY", "price": entry, "quantity": qty,
             "stop_loss_pct": sl_pct, "take_profit_pct": tp_pct})
         return trade
+
+    def _ensure_protection(self, trade: ActiveTrade) -> None:
+        """Place whichever protective orders are missing (initial placement
+        and post-outage repair share this path). Raises ExchangeUnavailable
+        if the exchange is down; callers decide whether to swallow."""
+        symbol = trade.symbol
+        if trade.stop_order_id is None:
+            stop_price = float(np.asarray(trade.trailing_state.stop))
+            o = self.exchange.place_order(
+                symbol, "SELL", "STOP_LOSS_LIMIT", trade.quantity,
+                price=stop_price * 0.999, stop_price=stop_price)
+            trade.stop_order_id = o.get("order_id")
+        if trade.tp_order_id is None:
+            tp_price = trade.entry_price * (1 + trade.take_profit_pct / 100.0)
+            o = self.exchange.place_order(
+                symbol, "SELL", "LIMIT", trade.quantity, price=tp_price)
+            trade.tp_order_id = o.get("order_id")
 
     def _reconcile_protective_fills(self, symbol: str, price: float):
         """Detect server-side fills of the protective SL/TP orders and
@@ -145,14 +166,20 @@ class TradeExecutor:
     async def on_price(self, symbol: str, price: float) -> None:
         """Trailing-stop maintenance (`TrailingStopManager.update_price` +
         stop replacement, :142-333), after reconciling protective fills."""
+        trade = self.active_trades.get(symbol)
+        if trade is None:
+            return
+        # Reconcile BEFORE repairing: a protective order may have filled
+        # server-side during an outage — repairing first would place sells
+        # for inventory that is already gone.
         filled = self._reconcile_protective_fills(symbol, price)
         if filled is not None:
             reason, exit_price = filled
             await self._finalize_filled(symbol, exit_price, reason)
             return
-        trade = self.active_trades.get(symbol)
-        if trade is None:
-            return
+        if trade.stop_order_id is None or trade.tp_order_id is None:
+            # repair protection lost to an earlier exchange outage
+            self._ensure_protection(trade)
         md = self.bus.get(f"market_data_{symbol}") or {}
         prev_stop = float(np.asarray(trade.trailing_state.stop))
         st, triggered = trailing_stop_update(
@@ -168,8 +195,11 @@ class TradeExecutor:
         trade.trailing_state = st
         new_stop = float(np.asarray(st.stop))
         if new_stop > prev_stop and trade.stop_order_id is not None:
-            # replace the protective stop order at the ratcheted level
+            # replace the protective stop order at the ratcheted level;
+            # id goes None between cancel and place so a mid-replacement
+            # outage is repaired by _ensure_protection, not double-placed
             self.exchange.cancel_order(symbol, trade.stop_order_id)
+            trade.stop_order_id = None
             o = self.exchange.place_order(symbol, "SELL", "STOP_LOSS_LIMIT",
                                           trade.quantity,
                                           price=new_stop * 0.999,
@@ -196,13 +226,27 @@ class TradeExecutor:
         await self.bus.publish("trade_closures", record)
 
     async def close_trade(self, symbol: str, price: float, reason: str) -> None:
-        trade = self.active_trades.pop(symbol, None)
+        """Pop the trade only AFTER the exit sell succeeds: if the exchange
+        dies mid-close the position stays on the books (cancelled
+        protective orders are re-placed by _ensure_protection) and the
+        close is re-attempted on the next trigger."""
+        trade = self.active_trades.get(symbol)
         if trade is None:
             return
-        for oid in (trade.stop_order_id, trade.tp_order_id):
-            if oid is not None:
-                self.exchange.cancel_order(symbol, oid)
-        self.exchange.place_order(symbol, "SELL", "MARKET", trade.quantity)
+        if trade.stop_order_id is not None:
+            self.exchange.cancel_order(symbol, trade.stop_order_id)
+            trade.stop_order_id = None
+        if trade.tp_order_id is not None:
+            self.exchange.cancel_order(symbol, trade.tp_order_id)
+            trade.tp_order_id = None
+        order = self.exchange.place_order(symbol, "SELL", "MARKET",
+                                          trade.quantity)
+        if order.get("status") != "FILLED":
+            # REJECTED exit (e.g. a protective order already sold the
+            # inventory this same candle): keep the trade on the books —
+            # the next on_price reconciles the server-side fill properly.
+            return
+        self.active_trades.pop(symbol, None)
         pnl = (price - trade.entry_price) * trade.quantity
         record = {"symbol": symbol, "entry_price": trade.entry_price,
                   "exit_price": price, "quantity": trade.quantity,
@@ -217,11 +261,18 @@ class TradeExecutor:
         return self._q
 
     async def run_once(self) -> int:
-        """Drain pending trading_signals (test/launcher tick)."""
+        """Drain pending trading_signals (test/launcher tick). A signal
+        interrupted by an exchange outage is re-queued so the entry is
+        retried once the circuit recovers, then the outage propagates to
+        the launcher's skip-and-alert path."""
         n = 0
         q = self._queue()
         while not q.empty():
             env = q.get_nowait()
-            if await self.handle_signal(env["data"]):
-                n += 1
+            try:
+                if await self.handle_signal(env["data"]):
+                    n += 1
+            except ExchangeUnavailable:
+                q.put_nowait(env)
+                raise
         return n

@@ -60,21 +60,37 @@ class TradingSystem:
         self._last_market_update = self.now_fn()
 
     async def tick(self) -> dict:
-        """One full pass of the live signal path + observability."""
-        published = await self.monitor.poll()
-        self.heartbeats.beat("monitor")
-        analyzed = await self.analyzer.run_once()
-        self.heartbeats.beat("analyzer")
-        executed = await self.executor.run_once()
-        self.heartbeats.beat("executor")
-        if published:
-            self._last_market_update = self.now_fn()
-        for symbol in self.symbols:
-            md = self.bus.get(f"market_data_{symbol}")
-            if md and symbol in self.executor.active_trades:
-                await self.executor.on_price(symbol, md["current_price"])
+        """One full pass of the live signal path + observability.
 
-        balances = self.exchange.get_balances()
+        An exchange outage (open breaker / exhausted retries surfacing as
+        ExchangeUnavailable from the resilient adapter) skips the affected
+        stage for this tick instead of killing the loop — the reference's
+        services likewise treat a circuit-broken call as a skipped cycle
+        (`market_monitor_service.py:96-115`)."""
+        from ai_crypto_trader_tpu.shell.exchange import ExchangeUnavailable
+
+        published = analyzed = executed = 0
+        try:
+            published = await self.monitor.poll()
+            self.heartbeats.beat("monitor")
+            if published:
+                self._last_market_update = self.now_fn()
+            analyzed = await self.analyzer.run_once()
+            self.heartbeats.beat("analyzer")
+            executed = await self.executor.run_once()
+            self.heartbeats.beat("executor")
+            for symbol in self.symbols:
+                md = self.bus.get(f"market_data_{symbol}")
+                if md and symbol in self.executor.active_trades:
+                    await self.executor.on_price(symbol, md["current_price"])
+            balances = self.exchange.get_balances()
+        except ExchangeUnavailable as exc:
+            self.metrics.inc("errors_total", kind="exchange_unavailable")
+            await self.bus.publish("alerts", {
+                "name": "ExchangeUnavailable", "severity": "warning",
+                "message": str(exc), "at": self.now_fn()})
+            return {"published": published, "analyzed": analyzed,
+                    "executed": executed, "alerts": 1, "skipped": str(exc)}
         # total portfolio value: quote balances + base holdings marked at the
         # latest price (free USDC alone would show a phantom loss while a
         # position is open)
@@ -91,6 +107,11 @@ class TradingSystem:
                 total += balances[base] * md["current_price"]
         self.metrics.set_gauge("portfolio_value_usd", total)
         self.metrics.set_gauge("open_positions", len(self.executor.active_trades))
+        # Snapshot for out-of-loop readers (dashboard server handler
+        # threads): they must never call the exchange themselves — that
+        # would burn trading rate-limit tokens and, in paper mode, advance
+        # the simulation's virtual clock from a foreign thread.
+        self._status_cache = self._status_from(balances, total)
 
         fired = self.alerts.evaluate({
             "market_data_age_s": self.now_fn() - self._last_market_update,
@@ -114,10 +135,9 @@ class TradingSystem:
                         alerts=list(self.alerts.active.values()),
                         now_fn=self.now_fn)
 
-    def status(self) -> dict:
-        """`print_status` parity (`run_trader.py:39`)."""
-        return {
-            "balances": self.exchange.get_balances(),
+    def _status_from(self, balances: dict, portfolio_value: float | None = None) -> dict:
+        status = {
+            "balances": balances,
             "active_trades": {s: t.entry_price
                               for s, t in self.executor.active_trades.items()},
             "closed_trades": len(self.executor.closed_trades),
@@ -125,6 +145,19 @@ class TradingSystem:
             "alerts": list(self.alerts.active),
             "channels": dict(self.bus.published_counts),
         }
+        if portfolio_value is not None:
+            status["portfolio_value_usd"] = portfolio_value
+        return status
+
+    def status(self) -> dict:
+        """`print_status` parity (`run_trader.py:39`). Calls the exchange;
+        out-of-loop readers should use status_cached()."""
+        return self._status_from(self.exchange.get_balances())
+
+    def status_cached(self) -> dict:
+        """Last tick's snapshot — no exchange calls, safe from any thread."""
+        cached = getattr(self, "_status_cache", None)
+        return cached if cached is not None else self._status_from({})
 
     async def run(self, duration_s: float | None = None,
                   tick_interval_s: float = 5.0):

@@ -127,9 +127,19 @@ class MarketMonitor:
             # fetch enough base candles to fill the secondary timeframe too
             max_factor = max(self._interval_minutes(iv) // base_min
                              for iv in self.intervals)
-            klines = self.breaker.call(self.exchange.get_klines, symbol,
-                                       self.intervals[0],
-                                       self.kline_limit * max_factor)
+            # A ResilientExchange already provides breaker+retry at the
+            # adapter seam; stacking this service-level breaker on top
+            # would swallow its ExchangeUnavailable (the launcher's
+            # skip-and-alert path) and double-count failures.
+            from ai_crypto_trader_tpu.shell.exchange import ResilientExchange
+
+            if isinstance(self.exchange, ResilientExchange):
+                klines = self.exchange.get_klines(
+                    symbol, self.intervals[0], self.kline_limit * max_factor)
+            else:
+                klines = self.breaker.call(self.exchange.get_klines, symbol,
+                                           self.intervals[0],
+                                           self.kline_limit * max_factor)
             if klines is None:
                 continue
             update = self._features_from_klines(klines[-self.kline_limit:])

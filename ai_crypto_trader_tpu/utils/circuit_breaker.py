@@ -76,30 +76,43 @@ class CircuitBreaker:
             self._transition(CircuitState.OPEN)
             self.opened_at = self.now_fn()
 
+    # Public surface for callers that manage their own try/except around the
+    # protected operation (e.g. ResilientExchange, which retries reads
+    # before deciding the op failed). call()/call_async() are built on it.
+    def allow(self) -> bool:
+        """Whether a call may proceed now (advances OPEN→HALF_OPEN)."""
+        return self._pre_call()
+
+    def record_success(self):
+        self._on_success()
+
+    def record_failure(self):
+        self._on_failure()
+
     def call(self, fn: Callable, *args, **kw) -> Any | None:
         """Invoke fn under the breaker; returns None when rejected/failed
         (the reference's decorated services treat that as a skipped cycle)."""
-        if not self._pre_call():
+        if not self.allow():
             return None
         self.stats["calls"] += 1
         try:
             out = fn(*args, **kw)
         except Exception:
-            self._on_failure()
+            self.record_failure()
             return None
-        self._on_success()
+        self.record_success()
         return out
 
     async def call_async(self, fn: Callable, *args, **kw) -> Any | None:
-        if not self._pre_call():
+        if not self.allow():
             return None
         self.stats["calls"] += 1
         try:
             out = await fn(*args, **kw)
         except Exception:
-            self._on_failure()
+            self.record_failure()
             return None
-        self._on_success()
+        self.record_success()
         return out
 
 
@@ -113,13 +126,25 @@ def get_circuit_breaker(name: str, **kw) -> CircuitBreaker:
     return _REGISTRY[name]
 
 
+def backoff_delays(max_retries: int, base_delay_s: float = 0.5,
+                   max_delay_s: float = 30.0, jitter: float = 0.1,
+                   rng: random.Random | None = None):
+    """Yield the jittered delay before each retry — the single backoff
+    schedule shared by retry_with_backoff and sync callers
+    (ResilientExchange)."""
+    rng = rng or random.Random()
+    for attempt in range(max_retries):
+        delay = min(base_delay_s * 2**attempt, max_delay_s)
+        yield delay * (1.0 + jitter * rng.random())
+
+
 async def retry_with_backoff(fn: Callable, *args, max_retries: int = 3,
                              base_delay_s: float = 0.5, max_delay_s: float = 30.0,
                              jitter: float = 0.1,
                              rng: random.Random | None = None,
                              sleep=asyncio.sleep, **kw):
     """Exponential backoff + jitter (`circuit_breaker.py:227`)."""
-    rng = rng or random.Random()
+    delays = backoff_delays(max_retries, base_delay_s, max_delay_s, jitter, rng)
     last_exc: Exception | None = None
     for attempt in range(max_retries + 1):
         try:
@@ -129,9 +154,8 @@ async def retry_with_backoff(fn: Callable, *args, max_retries: int = 3,
             return result
         except Exception as exc:                      # noqa: BLE001
             last_exc = exc
-            if attempt == max_retries:
+            delay = next(delays, None)
+            if delay is None:
                 break
-            delay = min(base_delay_s * 2**attempt, max_delay_s)
-            delay *= 1.0 + jitter * rng.random()
             await sleep(delay)
     raise last_exc  # type: ignore[misc]

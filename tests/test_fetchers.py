@@ -1,0 +1,177 @@
+"""Fetcher logic against a recorded-fixture fake transport (the reference's
+network surfaces: `backtesting/data_manager.py:47-172`,
+`services/utils/news_analyzer.py:144-370`)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.data.fetchers import (
+    Response,
+    fetch_html_news,
+    fetch_klines,
+    fetch_klines_ohlcv,
+    fetch_news,
+    fetch_social_daily,
+)
+
+
+def kline_row(t_ms, price=100.0):
+    return [t_ms, price, price + 1, price - 1, price + 0.5, 10.0,
+            t_ms + 59_999, 1000.0, 5, 5.0, 500.0, 0]
+
+
+class PagedKlinesTransport:
+    """Serves klines [0, n_total) minute candles in pages, like Binance."""
+
+    def __init__(self, n_total, t0_ms=0, page_limit=1000, fail_at_page=None):
+        self.n_total = n_total
+        self.t0 = t0_ms
+        self.page_limit = page_limit
+        self.fail_at_page = fail_at_page
+        self.requests = []
+
+    async def __call__(self, url, params=None, headers=None):
+        self.requests.append(params)
+        if (self.fail_at_page is not None
+                and len(self.requests) == self.fail_at_page):
+            return Response(500, "oops")
+        # first candle whose open time >= startTime (Binance semantics)
+        start = -(-max(int(params["startTime"]) - self.t0, 0) // 60_000)
+        limit = min(int(params["limit"]), self.page_limit)
+        rows = [kline_row(self.t0 + i * 60_000, 100 + 0.01 * i)
+                for i in range(start, min(start + limit, self.n_total))
+                if self.t0 + i * 60_000 <= int(params["endTime"])]
+        return Response(200, json.dumps(rows))
+
+
+def no_sleep(_):
+    async def done():
+        return None
+    return done()
+
+
+def test_paginates_until_range_exhausted():
+    tr = PagedKlinesTransport(n_total=2500)
+    rows = asyncio.run(fetch_klines(tr, "BTCUSDC", "1m", 0, 2500 * 60_000,
+                                    pace_s=0, sleep=no_sleep))
+    assert len(rows) == 2500
+    # cursor advance: each page starts 1 ms after the previous page's last
+    # open time → 3 pages of 1000/1000/500
+    assert len(tr.requests) == 3 + 1     # +1 final empty-page probe
+    assert [int(r["startTime"]) for r in tr.requests[:3]] == [
+        0, 999 * 60_000 + 1, 1999 * 60_000 + 1]
+    ts = [r[0] for r in rows]
+    assert ts == sorted(set(ts))         # no duplicates, ordered
+
+
+def test_stops_on_empty_page_and_converts_to_ohlcv():
+    tr = PagedKlinesTransport(n_total=150)
+    got = asyncio.run(fetch_klines_ohlcv(tr, "ETHUSDC", "1m",
+                                         0, 10**12, pace_s=0,
+                                         sleep=no_sleep))
+    assert len(got) == 150
+    assert got.symbol == "ETHUSDC"
+    assert got.close.dtype == np.float32
+    assert int(got.timestamp[-1]) == 149 * 60_000
+
+
+def test_http_error_raises():
+    tr = PagedKlinesTransport(n_total=2500, fail_at_page=2)
+    with pytest.raises(RuntimeError, match="HTTP 500"):
+        asyncio.run(fetch_klines(tr, "BTCUSDC", "1m", 0, 2500 * 60_000,
+                                 pace_s=0, sleep=no_sleep))
+
+
+# --------------------------------------------------------------------------
+
+LUNARCRUSH_FIXTURE = {
+    "data": [{
+        "symbol": "BTC",
+        "timeSeries": [
+            {"time": 86_400 * d, "galaxy_score": 60 + d,
+             "social_volume": 1000 * d, "sentiment": 3.5,
+             "name": "ignored-non-numeric"}
+            for d in range(1, 11)
+        ],
+    }]
+}
+
+
+class OneShotTransport:
+    def __init__(self, status=200, payload=None, body=""):
+        self.status = status
+        self.body = json.dumps(payload) if payload is not None else body
+        self.calls = []
+
+    async def __call__(self, url, params=None, headers=None):
+        self.calls.append((url, params, headers))
+        return Response(self.status, self.body)
+
+
+def test_social_daily_filters_range_and_extracts_numeric_columns():
+    tr = OneShotTransport(payload=LUNARCRUSH_FIXTURE)
+    got = asyncio.run(fetch_social_daily(
+        tr, "BTCUSDC", start_s=86_400 * 3, end_s=86_400 * 7,
+        api_key="k"))
+    assert len(got) == 5                         # days 3..7
+    assert list(got.timestamp) == [86_400 * d for d in range(3, 8)]
+    assert set(got.columns) == {"galaxy_score", "social_volume", "sentiment",
+                                "time"} - {"time"}
+    assert got.columns["galaxy_score"][0] == 63.0
+    # request shape: symbol stripped of quote, 1d interval, bearer auth
+    url, params, headers = tr.calls[0]
+    assert params["symbol"] == "BTC" and params["interval"] == "1d"
+    assert headers["Authorization"] == "Bearer k"
+
+
+def test_social_daily_days_capped_at_90():
+    tr = OneShotTransport(payload=LUNARCRUSH_FIXTURE)
+    asyncio.run(fetch_social_daily(tr, "BTCUSDC", 0, 86_400 * 400,
+                                   api_key="k"))
+    assert tr.calls[0][1]["days"] == 90
+
+
+# --------------------------------------------------------------------------
+
+COINDESK_HTML = """
+<div><h4 class="heading title">Bitcoin rallies</h4>
+<a href="/markets/2026/btc-rallies">x</a>
+<time datetime="2026-07-01T10:00:00Z"></time></div>
+<div><h4 class="card title">ETF inflows grow</h4>
+<a href="https://www.coindesk.com/policy/etf-inflows">x</a>
+<time datetime="2026-07-02T10:00:00Z"></time></div>
+"""
+
+CRYPTOPANIC_FIXTURE = {"results": [
+    {"title": "Bitcoin rallies", "url": "https://news/a",
+     "published_at": "2026-07-01", "body": "up"},
+    {"title": "Dup story", "url": "https://news/a",
+     "published_at": "2026-07-01", "body": "dup"},
+    {"title": "Fed watch", "url": "https://news/b",
+     "published_at": "2026-07-02", "body": "rates"},
+]}
+
+
+def test_html_scraper_extracts_and_resolves_relative_links():
+    tr = OneShotTransport(body=COINDESK_HTML)
+    items = asyncio.run(fetch_html_news(tr, "BTCUSDC", "coindesk"))
+    assert [i["title"] for i in items] == ["Bitcoin rallies",
+                                           "ETF inflows grow"]
+    assert items[0]["url"].startswith("https://www.coindesk.com/markets")
+    assert items[0]["published_at"] == "2026-07-01T10:00:00Z"
+
+
+def test_fetch_news_dedups_by_url_and_tolerates_source_failures():
+    class Router:
+        async def __call__(self, url, params=None, headers=None):
+            if "cryptopanic" in url:
+                return Response(200, json.dumps(CRYPTOPANIC_FIXTURE))
+            raise ConnectionError("no route")      # other sources die
+
+    items = asyncio.run(fetch_news(Router(), "BTCUSDC",
+                                   api_keys={"cryptopanic": "k"}))
+    assert len(items) == 2                         # dup URL removed
+    assert {i["url"] for i in items} == {"https://news/a", "https://news/b"}

@@ -1,0 +1,41 @@
+"""The driver-facing entry points must be self-defending.
+
+Round-1 post-mortem: MULTICHIP_r01.json went red because the driver invoked
+`dryrun_multichip` in a process whose jax was already pointed at the single
+real TPU chip, and the run hung on the chip lock. The entry point now forces
+the virtual-CPU platform itself (re-exec when jax is already initialized),
+so it must succeed from an arbitrarily hostile calling environment.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_survives_hostile_env():
+    """jax pre-imported with 1 CPU device, no XLA_FLAGS: the entry point
+    must re-exec into a clean 2-device interpreter and finish."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS",
+                        "_GRAFT_DRYRUN_REEXEC")}
+    code = (
+        "import jax; assert len(jax.devices()) == 1; "
+        f"import sys; sys.path.insert(0, {REPO!r}); "
+        "import __graft_entry__; __graft_entry__.dryrun_multichip(2)"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "dryrun_multichip(2) OK" in out.stdout
+
+
+def test_force_cpu_devices_in_process_is_noop():
+    """Inside the test suite (8 virtual CPU devices already up) the guard
+    must accept the environment without re-exec'ing the pytest process."""
+    sys.path.insert(0, REPO)
+    import __graft_entry__
+
+    assert __graft_entry__._force_cpu_devices(8) is True

@@ -1,0 +1,273 @@
+"""ResilientExchange: breaker + rate-limit + retry wiring at the adapter
+seam (reference wiring: `services/market_monitor_service.py:96-115`)."""
+
+import pytest
+
+from ai_crypto_trader_tpu.shell.exchange import (
+    ExchangeInterface,
+    ExchangeUnavailable,
+    ResilientExchange,
+    make_exchange,
+)
+from ai_crypto_trader_tpu.utils.circuit_breaker import CircuitState
+
+
+class FlakyClient(ExchangeInterface):
+    """Fails the first `fail_first` calls of each method, then succeeds."""
+
+    def __init__(self, fail_first=0):
+        self.fail_first = fail_first
+        self.calls = {}
+
+    def _maybe_fail(self, name):
+        n = self.calls.get(name, 0)
+        self.calls[name] = n + 1
+        if n < self.fail_first:
+            raise ConnectionError(f"{name} flake #{n}")
+
+    def get_ticker(self, symbol):
+        self._maybe_fail("get_ticker")
+        return {"symbol": symbol, "price": 100.0}
+
+    def get_order_book(self, symbol, limit=20):
+        self._maybe_fail("get_order_book")
+        return {"bids": [], "asks": []}
+
+    def get_klines(self, symbol, interval="1m", limit=100):
+        self._maybe_fail("get_klines")
+        return []
+
+    def place_order(self, symbol, side, order_type, quantity, price=None,
+                    stop_price=None):
+        self._maybe_fail("place_order")
+        return {"order_id": 1, "status": "FILLED"}
+
+    def cancel_order(self, symbol, order_id):
+        self._maybe_fail("cancel_order")
+        return {"status": "CANCELED"}
+
+    def get_balances(self):
+        self._maybe_fail("get_balances")
+        return {"USDC": 1000.0}
+
+
+class VirtualClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def now(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.sleeps.append(dt)
+        self.t += dt
+
+
+def make_resilient(client, clock, **kw):
+    return ResilientExchange(client, now_fn=clock.now, sleep=clock.sleep,
+                             **kw)
+
+
+def test_reads_retry_through_transient_failures():
+    clock, client = VirtualClock(), FlakyClient(fail_first=2)
+    ex = make_resilient(client, clock, max_read_retries=2)
+    assert ex.get_ticker("BTCUSDC")["price"] == 100.0
+    assert client.calls["get_ticker"] == 3          # 2 flakes + success
+    assert len(clock.sleeps) == 2                   # backoff between tries
+    assert ex.breaker.failures == 0                 # recovered read ≠ failure
+
+
+def test_exhausted_read_counts_one_breaker_failure_and_raises():
+    clock, client = VirtualClock(), FlakyClient(fail_first=99)
+    ex = make_resilient(client, clock, max_read_retries=1)
+    with pytest.raises(ExchangeUnavailable):
+        ex.get_ticker("BTCUSDC")
+    assert ex.breaker.failures == 1
+
+
+def test_breaker_trips_open_then_half_open_recovers():
+    clock = VirtualClock()
+    client = FlakyClient(fail_first=6)              # 3 reads × 2 attempts
+    ex = make_resilient(client, clock, max_read_retries=1,
+                        failure_threshold=3, reset_timeout_s=30.0)
+    for _ in range(3):
+        with pytest.raises(ExchangeUnavailable):
+            ex.get_ticker("BTCUSDC")
+    assert ex.breaker.state is CircuitState.OPEN
+    inner_calls = client.calls["get_ticker"]
+
+    # while open: rejected WITHOUT touching the inner client
+    with pytest.raises(ExchangeUnavailable):
+        ex.get_ticker("BTCUSDC")
+    assert client.calls["get_ticker"] == inner_calls
+
+    # after the reset timeout the half-open trial succeeds and closes it
+    clock.t += 31.0
+    assert ex.get_ticker("BTCUSDC")["price"] == 100.0
+    assert ex.breaker.state is CircuitState.CLOSED
+
+
+def test_order_placement_is_never_retried():
+    clock, client = VirtualClock(), FlakyClient(fail_first=1)
+    ex = make_resilient(client, clock)
+    with pytest.raises(ExchangeUnavailable):
+        ex.place_order("BTCUSDC", "BUY", "MARKET", 1.0)
+    assert client.calls["place_order"] == 1         # exactly one attempt
+
+
+def test_rate_limiter_sleeps_out_the_deficit():
+    clock, client = VirtualClock(), FlakyClient()
+    ex = make_resilient(client, clock, rate_per_s=1.0, burst=2.0)
+    ex.get_ticker("A")
+    ex.get_ticker("B")                              # burst exhausted
+    ex.get_ticker("C")                              # must wait ~1s
+    assert any(s >= 0.99 for s in clock.sleeps)
+
+
+def test_resilient_fake_delegates_paper_trading_surface():
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+
+    series = from_dict(generate_ohlcv(n=32, seed=5))
+    ex = make_exchange("fake", resilient=True, series={"BTCUSDC": series})
+    assert isinstance(ex, ResilientExchange)
+    ex.advance("BTCUSDC")                           # delegated virtual clock
+    assert ex.get_ticker("BTCUSDC")["price"] > 0
+    assert ex.fills == []                           # delegated attribute
+
+
+def test_open_circuit_rejects_before_burning_tokens():
+    clock = VirtualClock()
+    client = FlakyClient(fail_first=99)
+    ex = make_resilient(client, clock, max_read_retries=0,
+                        failure_threshold=1, rate_per_s=1.0, burst=1.0)
+    with pytest.raises(ExchangeUnavailable):
+        ex.get_ticker("A")                          # trips the breaker
+    tokens_before = ex.bucket.tokens
+    sleeps_before = len(clock.sleeps)
+    with pytest.raises(ExchangeUnavailable):
+        ex.get_ticker("B")                          # rejected at the door
+    assert ex.bucket.tokens == tokens_before
+    assert len(clock.sleeps) == sleeps_before
+
+
+def test_trading_system_survives_exchange_outage_and_recovers():
+    """Full-pipeline drive: an outage mid-run must skip ticks (alert, no
+    crash) and the system must resume after the breaker's reset window."""
+    import asyncio
+
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+    series = from_dict(generate_ohlcv(n=700, seed=5), symbol="BTCUSDC")
+    inner = FakeExchange({"BTCUSDC": series})
+    inner.advance("BTCUSDC", steps=600)
+
+    clock = VirtualClock()
+    outage = {"on": False}
+
+    class Outage(FakeExchange):
+        pass
+
+    real_klines = inner.get_klines
+
+    def flaky_klines(*a, **kw):
+        if outage["on"]:
+            raise ConnectionError("exchange down")
+        return real_klines(*a, **kw)
+
+    inner.get_klines = flaky_klines
+    ex = ResilientExchange(inner, now_fn=clock.now, sleep=clock.sleep,
+                           max_read_retries=0, failure_threshold=1,
+                           reset_timeout_s=30.0)
+    system = TradingSystem(ex, ["BTCUSDC"], now_fn=clock.now)
+
+    async def go():
+        r = await system.tick()
+        assert "skipped" not in r
+
+        outage["on"] = True
+        inner.advance("BTCUSDC")
+        clock.t += 60.0
+        r = await system.tick()
+        assert "skipped" in r                      # cycle skipped, no crash
+        assert any("errors_total" in k and "exchange_unavailable" in k
+                   for k in system.metrics.counters)
+
+        outage["on"] = False
+        inner.advance("BTCUSDC")
+        clock.t += 60.0                            # > reset_timeout_s
+        r = await system.tick()
+        assert "skipped" not in r                  # recovered
+
+    asyncio.run(go())
+
+
+def test_filled_buy_with_dead_protection_stays_managed():
+    """Outage between the market-BUY fill and the protective-order
+    placement must leave the position on the books (unprotected), and the
+    next price update must repair the missing SL/TP orders."""
+    import asyncio
+
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.bus import EventBus
+    from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+    from ai_crypto_trader_tpu.shell.executor import TradeExecutor
+    from ai_crypto_trader_tpu.config import TradingParams
+
+    series = from_dict(generate_ohlcv(n=64, seed=5), symbol="BTCUSDC")
+    inner = FakeExchange({"BTCUSDC": series}, quote_balance=10_000.0)
+    inner.advance("BTCUSDC", steps=30)
+
+    outage = {"on": False}
+    real_place = inner.place_order
+
+    def place(symbol, side, order_type, quantity, price=None, stop_price=None):
+        if outage["on"] and order_type != "MARKET":
+            raise ConnectionError("down")
+        return real_place(symbol, side, order_type, quantity, price,
+                          stop_price)
+
+    inner.place_order = place
+    clock = VirtualClock()
+    ex = ResilientExchange(inner, now_fn=clock.now, sleep=clock.sleep,
+                           max_read_retries=0, failure_threshold=100)
+    execu = TradeExecutor(EventBus(now_fn=clock.now), ex,
+                          trading=TradingParams(ai_confidence_threshold=0.0,
+                                                min_signal_strength=0.0,
+                                                min_trade_amount=1.0),
+                          now_fn=clock.now)
+    price = inner.get_ticker("BTCUSDC")["price"]
+    signal = {"symbol": "BTCUSDC", "signal": "BUY", "decision": "BUY",
+              "confidence": 1.0, "signal_strength": 100.0,
+              "current_price": price, "volatility": 0.015,
+              "avg_volume": 60_000.0}
+
+    async def go():
+        outage["on"] = True                 # protective legs will fail
+        trade = await execu.handle_signal(signal)
+        assert trade is not None            # position registered anyway
+        assert trade.stop_order_id is None and trade.tp_order_id is None
+        assert "BTCUSDC" in execu.active_trades
+
+        outage["on"] = False                # exchange back: repair on tick
+        await execu.on_price("BTCUSDC", price)
+        t = execu.active_trades["BTCUSDC"]
+        assert t.stop_order_id is not None and t.tp_order_id is not None
+        assert inner.order_is_open("BTCUSDC", t.stop_order_id)
+
+    asyncio.run(go())
+
+
+def test_factory_wraps_binance_by_default():
+    class SdkStub:                                  # binance.Client surface
+        def get_symbol_ticker(self, symbol):
+            return {"price": "100.0"}
+
+    ex = make_exchange("binance", client=SdkStub())
+    assert isinstance(ex, ResilientExchange)
+    assert ex.get_ticker("BTCUSDC")["price"] == 100.0
