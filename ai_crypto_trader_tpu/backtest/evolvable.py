@@ -142,10 +142,15 @@ def evolvable_inputs(ohlcv: dict, p: StrategyParams,
 def evolvable_backtest(ohlcv: dict, p: StrategyParams,
                        initial_balance: float = 10_000.0,
                        min_signal_strength: float = 50.0,
-                       warmup: int = 10):
+                       warmup: int = 10,
+                       social: SocialInputs | None = None):
     """Full pipeline for one parameter vector: dynamic indicators → signal →
-    scan backtest with the params' SL/TP. The GA's fitness kernel."""
-    inputs = evolvable_inputs(ohlcv, p)
+    scan backtest with the params' SL/TP. The GA's fitness kernel.
+
+    ``social`` (dense per-candle arrays from
+    `social.provider.SocialDataProvider.social_inputs`) adds the social
+    vote axis and makes the three social threshold genome dims live."""
+    inputs = evolvable_inputs(ohlcv, p, social)
     return run_backtest(inputs, p, initial_balance=initial_balance,
                         min_signal_strength=min_signal_strength,
                         use_param_sl_tp=True, warmup=warmup)
@@ -154,9 +159,11 @@ def evolvable_backtest(ohlcv: dict, p: StrategyParams,
 @functools.partial(jax.jit, static_argnames=("min_signal_strength", "warmup"))
 def population_backtest(ohlcv: dict, population: StrategyParams,
                         initial_balance: float = 10_000.0,
-                        min_signal_strength: float = 50.0, warmup: int = 10):
+                        min_signal_strength: float = 50.0, warmup: int = 10,
+                        social: SocialInputs | None = None):
     """vmap the full dynamic pipeline over a stacked population (one
     compiled program — see engine.sweep note on eager dispatch)."""
     return jax.vmap(lambda p: evolvable_backtest(
         ohlcv, p, initial_balance=initial_balance,
-        min_signal_strength=min_signal_strength, warmup=warmup))(population)
+        min_signal_strength=min_signal_strength, warmup=warmup,
+        social=social))(population)

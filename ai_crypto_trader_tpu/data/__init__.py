@@ -3,5 +3,7 @@ from ai_crypto_trader_tpu.data.ingest import (  # noqa: F401
     OHLCV,
     klines_to_arrays,
     load_csv,
+    load_social_csv,
     save_csv,
+    save_social_csv,
 )

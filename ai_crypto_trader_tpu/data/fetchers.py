@@ -169,10 +169,9 @@ async def fetch_social_daily(transport: Transport, symbol: str,
 # --------------------------------------------------------------------------
 
 def _base_ticker(symbol: str) -> str:
-    for quote in ("USDC", "USDT", "BUSD"):
-        if symbol.endswith(quote):
-            return symbol[: -len(quote)]
-    return symbol
+    from ai_crypto_trader_tpu.utils.symbols import base_asset
+
+    return base_asset(symbol)
 
 
 async def fetch_cryptopanic(transport: Transport, symbol: str, *,
@@ -209,17 +208,21 @@ async def fetch_lunarcrush_news(transport: Transport, symbol: str, *,
 
 
 _HTML_SOURCES = {
-    # source -> (url builder, title regex, url regex, date regex, link base)
+    # source -> (url builder, item regex with (?P<title>)/(?P<url>) groups,
+    #            date regex, link base). Title and URL are captured by ONE
+    #            structural regex so they can never be paired by unrelated
+    #            index position (a bare href findall would sweep up every
+    #            nav/header anchor on the page).
     "coindesk": (
         lambda t: f"https://www.coindesk.com/search?s={t}",
-        r'<h4[^>]*class="[^"]*title[^"]*"[^>]*>([^<]+)</h4>',
-        r'<a[^>]*href="([^"]+)"[^>]*>',
+        r'<h4[^>]*class="[^"]*title[^"]*"[^>]*>(?P<title>[^<]+)</h4>'
+        r'\s*<a[^>]*href="(?P<url>[^"]+)"',
         r'<time[^>]*datetime="([^"]+)"[^>]*>',
         "https://www.coindesk.com"),
     "cointelegraph": (
         lambda t: f"https://cointelegraph.com/tags/{t.lower()}",
-        r'<a[^>]*class="[^"]*post-card__title-link[^"]*"[^>]*>([^<]+)</a>',
-        r'<a[^>]*class="[^"]*post-card__title-link[^"]*"[^>]*href="([^"]+)"[^>]*>',
+        r'<a[^>]*class="[^"]*post-card__title-link[^"]*"[^>]*'
+        r'href="(?P<url>[^"]+)"[^>]*>(?P<title>[^<]+)</a>',
         r'<time[^>]*datetime="([^"]+)"[^>]*>',
         "https://cointelegraph.com"),
 }
@@ -230,21 +233,18 @@ async def fetch_html_news(transport: Transport, symbol: str, source: str,
     """CoinDesk / CoinTelegraph page scraping
     (`news_analyzer.py:270-370`: regex title/url/date extraction, first 5,
     relative links resolved against the site base)."""
-    build_url, title_re, url_re, date_re, base = _HTML_SOURCES[source]
+    build_url, item_re, date_re, base = _HTML_SOURCES[source]
     r = await transport(build_url(_base_ticker(symbol)))
     if r.status != 200:
         return []
-    titles = re.findall(title_re, r.body)
-    urls = re.findall(url_re, r.body)
+    matches = list(re.finditer(item_re, r.body))[:max_items]
     dates = re.findall(date_re, r.body)
     items = []
-    for i in range(min(max_items, len(titles))):
-        if i >= len(urls):
-            break
-        url = urls[i]
+    for i, m in enumerate(matches):
+        url = m.group("url")
         if not url.startswith("http"):
             url = f"{base}{url}"
-        items.append({"title": titles[i].strip(), "url": url,
+        items.append({"title": m.group("title").strip(), "url": url,
                       "source": source.capitalize(),
                       "published_at": dates[i] if i < len(dates) else "",
                       "content": ""})

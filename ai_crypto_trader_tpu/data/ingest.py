@@ -105,3 +105,35 @@ def load_csv(path: str, symbol: str = "", interval: str = "1m") -> OHLCV:
         symbol=symbol,
         interval=interval,
     )
+
+
+def save_social_csv(daily, symbol: str, root: str) -> str:
+    """Persist a SocialDaily series cache-compatibly with the reference
+    layout (`backtesting/data/social/<symbol>/`, data_manager.py:174-212)."""
+    path = os.path.join(root, "social", symbol or "UNKNOWN")
+    os.makedirs(path, exist_ok=True)
+    fname = os.path.join(path, f"{symbol}_daily.csv")
+    names = sorted(daily.columns)
+    with open(fname, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["timestamp"] + names)
+        for i in range(len(daily)):
+            w.writerow([int(daily.timestamp[i])]
+                       + [float(daily.columns[k][i]) for k in names])
+    return fname
+
+
+def load_social_csv(path: str):
+    """Load a SocialDaily series saved by save_social_csv."""
+    from ai_crypto_trader_tpu.data.fetchers import SocialDaily
+
+    with open(path, newline="") as f:
+        r = csv.reader(f)
+        header = next(r)
+        rows = [row for row in r]
+    if not rows:
+        return SocialDaily(np.zeros(0, np.int64))
+    arr = np.asarray(rows, dtype=np.float64)
+    cols = {name: arr[:, j + 1].astype(np.float32)
+            for j, name in enumerate(header[1:])}
+    return SocialDaily(arr[:, 0].astype(np.int64), cols)

@@ -21,6 +21,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ai_crypto_trader_tpu.data.ingest import OHLCV
+from ai_crypto_trader_tpu.utils import symbols as symbols_util
 
 
 class ExchangeInterface(ABC):
@@ -120,16 +121,10 @@ class FakeExchange(ExchangeInterface):
 
     # --- trading -----------------------------------------------------------
     def _base_asset(self, symbol: str) -> str:
-        for quote in ("USDC", "USDT", "BUSD"):
-            if symbol.endswith(quote):
-                return symbol[: -len(quote)]
-        return symbol
+        return symbols_util.base_asset(symbol)
 
     def _quote_asset(self, symbol: str) -> str:
-        for quote in ("USDC", "USDT", "BUSD"):
-            if symbol.endswith(quote):
-                return quote
-        return "USDC"
+        return symbols_util.quote_asset(symbol)
 
     def _fill(self, order: dict, price: float) -> dict:
         symbol, side, qty = order["symbol"], order["side"], order["quantity"]

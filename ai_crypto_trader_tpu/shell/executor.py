@@ -233,6 +233,18 @@ class TradeExecutor:
         trade = self.active_trades.get(symbol)
         if trade is None:
             return
+        # A protective order that is already not-open BEFORE we cancel it
+        # filled server-side — finalize with that fill instead of selling
+        # inventory that is no longer held.
+        filled = self._reconcile_protective_fills(symbol, price)
+        if filled is not None:
+            fill_reason, exit_price = filled
+            await self._finalize_filled(symbol, exit_price, fill_reason)
+            return
+        prot = ((trade.tp_order_id, "Take Profit",
+                 1 + trade.take_profit_pct / 100),
+                (trade.stop_order_id, "Stop Loss",
+                 1 - trade.stop_loss_pct / 100))
         if trade.stop_order_id is not None:
             self.exchange.cancel_order(symbol, trade.stop_order_id)
             trade.stop_order_id = None
@@ -242,9 +254,19 @@ class TradeExecutor:
         order = self.exchange.place_order(symbol, "SELL", "MARKET",
                                           trade.quantity)
         if order.get("status") != "FILLED":
-            # REJECTED exit (e.g. a protective order already sold the
-            # inventory this same candle): keep the trade on the books —
-            # the next on_price reconciles the server-side fill properly.
+            # Rejected exit. Either a protective order filled in the race
+            # window between the reconcile above and our cancels (the ids
+            # are cancelled now, so on_price reconciliation can no longer
+            # see it — check the fills directly), or the rejection is
+            # transient with inventory intact (keep the trade;
+            # _ensure_protection re-places the protective orders next tick).
+            last_fill = getattr(self.exchange, "last_fill", lambda _o: None)
+            for oid, fill_reason, factor in prot:
+                fill = last_fill(oid) if oid is not None else None
+                if fill is not None:
+                    await self._finalize_filled(
+                        symbol, fill["price"], fill_reason)
+                    return
             return
         self.active_trades.pop(symbol, None)
         pnl = (price - trade.entry_price) * trade.quantity

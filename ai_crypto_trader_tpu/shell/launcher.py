@@ -30,6 +30,7 @@ from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
 from ai_crypto_trader_tpu.utils.alerts import AlertManager
 from ai_crypto_trader_tpu.utils.health import HeartbeatRegistry
 from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+from ai_crypto_trader_tpu.utils.symbols import QUOTE_ASSETS, base_asset
 
 
 @dataclass
@@ -89,20 +90,29 @@ class TradingSystem:
             await self.bus.publish("alerts", {
                 "name": "ExchangeUnavailable", "severity": "warning",
                 "message": str(exc), "at": self.now_fn()})
+            # Still evaluate the rule-based alerts: a sustained outage is
+            # exactly when StaleMarketData / service-health alerts must
+            # fire (and show on the dashboard, which renders alerts.active).
+            fired = self.alerts.evaluate({
+                "market_data_age_s": self.now_fn() - self._last_market_update,
+                "open_positions": len(self.executor.active_trades),
+                "max_positions": self.config.trading.max_positions,
+                "service_health": self.heartbeats.health(),
+            })
+            for alert in fired:
+                await self.bus.publish("alerts", alert)
+            if self.dashboard_path:
+                self._render_dashboard()
             return {"published": published, "analyzed": analyzed,
-                    "executed": executed, "alerts": 1, "skipped": str(exc)}
+                    "executed": executed, "alerts": 1 + len(fired),
+                    "skipped": str(exc)}
         # total portfolio value: quote balances + base holdings marked at the
         # latest price (free USDC alone would show a phantom loss while a
         # position is open)
-        total = sum(v for a, v in balances.items()
-                    if a in ("USDC", "USDT", "BUSD"))
+        total = sum(v for a, v in balances.items() if a in QUOTE_ASSETS)
         for symbol in self.symbols:
             md = self.bus.get(f"market_data_{symbol}")
-            base = symbol
-            for q in ("USDC", "USDT", "BUSD"):
-                if symbol.endswith(q):
-                    base = symbol[: -len(q)]
-                    break
+            base = base_asset(symbol)
             if md and balances.get(base):
                 total += balances[base] * md["current_price"]
         self.metrics.set_gauge("portfolio_value_usd", total)

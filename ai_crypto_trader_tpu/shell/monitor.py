@@ -23,7 +23,10 @@ import numpy as np
 from ai_crypto_trader_tpu import ops
 from ai_crypto_trader_tpu.backtest import compute_signal_features, reference_signal
 from ai_crypto_trader_tpu.shell.bus import EventBus
-from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
+from ai_crypto_trader_tpu.shell.exchange import (
+    ExchangeInterface,
+    ResilientExchange,
+)
 from ai_crypto_trader_tpu.utils.circuit_breaker import CircuitBreaker
 
 
@@ -36,10 +39,18 @@ class MarketMonitor:
     throttle_s: float = 5.0
     kline_limit: int = 256
     now_fn: any = time.time
-    breaker: CircuitBreaker = field(
+    breaker: CircuitBreaker | None = field(
         default_factory=lambda: CircuitBreaker("exchange", failure_threshold=3,
                                                reset_timeout_s=30.0))
     _last_pub: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        # A ResilientExchange already provides breaker+retry at the adapter
+        # seam; stacking this service-level breaker on top would swallow its
+        # ExchangeUnavailable (the launcher's skip-and-alert path) and
+        # double-count failures. Resolve the question once here.
+        if isinstance(self.exchange, ResilientExchange):
+            self.breaker = None
 
     def _features_from_klines(self, klines: list) -> dict | None:
         # Fixed-shape discipline: the indicator program is compiled for
@@ -127,13 +138,7 @@ class MarketMonitor:
             # fetch enough base candles to fill the secondary timeframe too
             max_factor = max(self._interval_minutes(iv) // base_min
                              for iv in self.intervals)
-            # A ResilientExchange already provides breaker+retry at the
-            # adapter seam; stacking this service-level breaker on top
-            # would swallow its ExchangeUnavailable (the launcher's
-            # skip-and-alert path) and double-count failures.
-            from ai_crypto_trader_tpu.shell.exchange import ResilientExchange
-
-            if isinstance(self.exchange, ResilientExchange):
+            if self.breaker is None:      # resilient seam (see __post_init__)
                 klines = self.exchange.get_klines(
                     symbol, self.intervals[0], self.kline_limit * max_factor)
             else:

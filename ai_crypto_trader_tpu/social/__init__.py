@@ -11,3 +11,8 @@ from ai_crypto_trader_tpu.social.news import (  # noqa: F401
     lexicon_sentiment,
 )
 from ai_crypto_trader_tpu.social.service import SocialMonitorService  # noqa: F401
+from ai_crypto_trader_tpu.social.provider import (  # noqa: F401
+    SocialDataProvider,
+    asof_indices,
+    resample_ffill,
+)

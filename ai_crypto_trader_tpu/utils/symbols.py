@@ -1,0 +1,24 @@
+"""Trading-pair symbol helpers shared by every layer that splits
+``BTCUSDC``-style pairs (exchange fills, portfolio marking, fetch ticker
+derivation — previously three divergent inline copies)."""
+
+from __future__ import annotations
+
+QUOTE_ASSETS = ("USDC", "USDT", "BUSD")
+
+
+def split_symbol(symbol: str, default_quote: str = "USDC") -> tuple[str, str]:
+    """``"BTCUSDC" -> ("BTC", "USDC")``; unknown quote suffix yields the
+    whole symbol as base with the default quote."""
+    for quote in QUOTE_ASSETS:
+        if symbol.endswith(quote):
+            return symbol[: -len(quote)], quote
+    return symbol, default_quote
+
+
+def base_asset(symbol: str) -> str:
+    return split_symbol(symbol)[0]
+
+
+def quote_asset(symbol: str) -> str:
+    return split_symbol(symbol)[1]

@@ -18,6 +18,15 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# The axon sitecustomize imports jax at interpreter start (before this file
+# runs) and pins the platform config to the TPU plugin — when the chip
+# tunnel is down, the first backend init then hangs forever dialing it,
+# env var notwithstanding. Overriding the live config (not just the env)
+# makes the suite immune to tunnel state.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -296,6 +296,38 @@ class TestPipeline:
             assert not ex.open_orders     # sibling canceled
         asyncio.run(go())
 
+    def test_close_trade_after_server_side_fill_finalizes(self):
+        """A protective order that filled server-side must finalize the
+        trade when close_trade races it — not strand it in active_trades
+        with re-placed protective sells for inventory no longer held."""
+        async def go():
+            bus = EventBus()
+            ex = FakeExchange({"BTCUSDC": _series()}, quote_balance=10_000)
+            execu = TradeExecutor(bus, ex)
+            await execu.handle_signal({
+                "symbol": "BTCUSDC",
+                "current_price": ex.get_ticker("BTCUSDC")["price"],
+                "signal": "BUY", "decision": "BUY", "confidence": 0.95,
+                "signal_strength": 85.0, "volatility": 0.02, "avg_volume": 1e6})
+            for _ in range(500):
+                ex.advance("BTCUSDC")
+                if len(ex.open_orders) < 2:
+                    break
+            assert len(ex.open_orders) < 2, "a protective order should fill"
+            base_before = ex.get_balances().get("BTC", 0.0)
+            # close directly (e.g. trailing trigger) without an on_price
+            # reconcile pass first
+            await execu.close_trade(
+                "BTCUSDC", ex.get_ticker("BTCUSDC")["price"], "Trailing Stop")
+            assert "BTCUSDC" not in execu.active_trades
+            assert execu.closed_trades[-1]["reason"] in ("Take Profit",
+                                                         "Stop Loss")
+            # no second market sell of already-sold inventory
+            np.testing.assert_allclose(ex.get_balances().get("BTC", 0.0),
+                                       base_before, atol=1e-9)
+            assert not ex.open_orders
+        asyncio.run(go())
+
     def test_close_trade_records_pnl(self):
         async def go():
             bus = EventBus()

@@ -1,0 +1,223 @@
+"""SocialDataProvider: vectorized as-of joins vs pandas oracles.
+
+Pins the TPU-native columnar join (social/provider.py) against the exact
+pandas pipeline the reference runs per backtest
+(`backtesting/data_manager.py:373-415` resample+ffill+merge_asof;
+`backtesting/social_data_provider.py:44-199` point-in-time lookups and
+derived indicators).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ai_crypto_trader_tpu.data.fetchers import SocialDaily
+from ai_crypto_trader_tpu.data.ingest import load_social_csv, save_social_csv
+from ai_crypto_trader_tpu.social.provider import (
+    DEFAULT_METRICS,
+    SocialDataProvider,
+    asof_indices,
+    resample_ffill,
+)
+
+DAY = 86_400
+
+
+def make_daily(rng, days=12, start=1_700_000_000 - (1_700_000_000 % DAY)):
+    ts = start + np.arange(days, dtype=np.int64) * DAY
+    cols = {
+        "social_volume": rng.integers(100, 50_000, days).astype(np.float32),
+        "social_engagement": rng.integers(10, 5_000, days).astype(np.float32),
+        "social_sentiment": rng.uniform(0.1, 0.9, days).astype(np.float32),
+        "social_contributors": rng.integers(1, 500, days).astype(np.float32),
+    }
+    return SocialDaily(ts, cols)
+
+
+@pytest.fixture()
+def daily(rng):
+    return make_daily(rng)
+
+
+class TestAsofGolden:
+    @pytest.mark.parametrize("interval,freq,step", [
+        ("1m", "1min", 60), ("5m", "5min", 300),
+        ("1h", "1h", 3600), ("1d", "1D", DAY),
+    ])
+    def test_matches_pandas_resample_merge_asof(self, daily, interval, freq, step):
+        # candle grid: 3 days of candles starting mid-series, offset by 30s
+        # so 'nearest' has to make real choices
+        t0 = int(daily.timestamp[4]) + 30
+        candle_ts = t0 + np.arange(0, 3 * DAY, step, dtype=np.int64)
+
+        prov = SocialDataProvider(daily)
+        ours = prov.metrics_at(candle_ts, interval)
+
+        sdf = pd.DataFrame(
+            {k: v for k, v in daily.columns.items()},
+            index=pd.to_datetime(daily.timestamp, unit="s"),
+        )
+        sdf.index.name = "timestamp"
+        resampled = sdf.resample(freq).ffill()
+        mdf = pd.DataFrame({"timestamp": pd.to_datetime(candle_ts, unit="s")})
+        merged = pd.merge_asof(mdf, resampled.reset_index(),
+                               on="timestamp", direction="nearest")
+        for name in daily.columns:
+            np.testing.assert_allclose(
+                ours[name], merged[name].to_numpy(np.float32),
+                rtol=1e-6, err_msg=f"{name} @ {interval}")
+
+    def test_columns_missing_get_defaults(self, daily):
+        candle_ts = daily.timestamp[2] + np.arange(10) * 60
+        ours = SocialDataProvider(daily).metrics_at(candle_ts)
+        assert np.all(ours["twitter_volume"] == 0.0)
+        assert np.all(ours["news_volume"] == 0.0)
+
+    def test_before_series_start_nearest_takes_first_row(self, daily):
+        # merge_asof direction='nearest' (data_manager.py:404-409) matches
+        # pre-start candles to the FIRST social row — not defaults
+        candle_ts = daily.timestamp[0] - DAY + np.arange(5) * 60
+        ours = SocialDataProvider(daily).metrics_at(candle_ts)
+        assert np.all(ours["social_volume"]
+                      == daily.columns["social_volume"][0])
+
+    def test_empty_series_is_default(self):
+        empty = SocialDaily(np.zeros(0, np.int64))
+        candle_ts = np.arange(5, dtype=np.int64) * 60
+        ours = SocialDataProvider(empty).metrics_at(candle_ts)
+        assert np.all(ours["social_sentiment"] == 0.5)
+        assert np.all(ours["social_volume"] == 0.0)
+
+    def test_asof_backward_matches_pandas(self, daily, rng):
+        left = np.sort(rng.integers(daily.timestamp[0] - DAY,
+                                    daily.timestamp[-1] + DAY, 200))
+        idx = asof_indices(left, daily.timestamp, "backward")
+        col = daily.columns["social_volume"]
+        ldf = pd.DataFrame({"timestamp": pd.to_datetime(left, unit="s")})
+        rdf = pd.DataFrame({
+            "timestamp": pd.to_datetime(daily.timestamp, unit="s"),
+            "v": col,
+        })
+        want = pd.merge_asof(ldf, rdf, on="timestamp",
+                             direction="backward")["v"].to_numpy()
+        got = np.where(idx >= 0, col[np.maximum(idx, 0)], np.nan)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_resample_ffill_grid(self):
+        ts = np.asarray([0, DAY, 3 * DAY], np.int64)  # gap day 2
+        grid, src = resample_ffill(ts, DAY)
+        np.testing.assert_array_equal(grid, [0, DAY, 2 * DAY, 3 * DAY])
+        np.testing.assert_array_equal(src, [0, 1, 1, 2])  # day 2 ffilled
+
+
+class TestScalarParity:
+    def test_point_lookup_is_most_recent_leq(self, daily):
+        t = int(daily.timestamp[3]) + 7200  # 2h after day 3's stamp
+        m = SocialDataProvider(daily).get_social_metrics_at(t)
+        assert m["social_volume"] == float(daily.columns["social_volume"][3])
+
+    def test_defaults_before_start(self, daily):
+        m = SocialDataProvider(daily).get_social_metrics_at(
+            int(daily.timestamp[0]) - 1)
+        assert m == DEFAULT_METRICS
+
+    def test_nan_falls_back_to_default(self, daily):
+        daily.columns["social_sentiment"][5] = np.nan
+        t = int(daily.timestamp[5]) + 60
+        m = SocialDataProvider(daily).get_social_metrics_at(t)
+        assert m["social_sentiment"] == 0.5
+
+    def test_news_sentiment_prefers_news_column(self, daily):
+        daily.columns["news_sentiment"] = np.full(len(daily), 0.8, np.float32)
+        prov = SocialDataProvider(daily)
+        t = int(daily.timestamp[-1]) + 60
+        assert prov.get_news_sentiment(t)["sentiment"] == pytest.approx(0.8)
+
+    def test_news_sentiment_falls_back_to_social(self, daily):
+        prov = SocialDataProvider(daily)
+        t = int(daily.timestamp[4]) + 60
+        want = float(daily.columns["social_sentiment"][4])
+        assert prov.get_news_sentiment(t)["sentiment"] == pytest.approx(want)
+
+
+class TestIndicators:
+    def reference_indicators(self, daily, t, intensity_window=30):
+        """Direct port of social_data_provider.py:129-199."""
+        mask = daily.timestamp <= t
+        vol = daily.columns["social_volume"][mask].astype(np.float64)
+        eng = daily.columns["social_engagement"][mask].astype(np.float64)
+        if vol.size < 2:
+            return {"social_momentum": 0.0, "social_trend": "neutral",
+                    "social_intensity": 0.0, "social_engagement_rate": 0.0}
+        momentum = (vol[-1] - vol[-2]) / max(vol[-2], 1.0) * 100.0
+        trend = ("bullish" if momentum > 20 else
+                 "bearish" if momentum < -20 else "neutral")
+        pct = np.diff(vol[-intensity_window:]) / vol[-intensity_window:-1]
+        intensity = pct.std(ddof=1) * 100.0 if pct.size > 1 else 0.0
+        rate = eng[-1] / max(vol[-1], 1.0)
+        return {"social_momentum": momentum, "social_trend": trend,
+                "social_intensity": intensity, "social_engagement_rate": rate}
+
+    def test_matches_reference_port(self, daily):
+        prov = SocialDataProvider(daily)
+        probes = [int(daily.timestamp[i]) + 3600 for i in (1, 4, 8, 11)]
+        got = prov.indicators_at(np.asarray(probes, np.int64))
+        for j, t in enumerate(probes):
+            want = self.reference_indicators(daily, t)
+            assert got["social_momentum"][j] == pytest.approx(
+                want["social_momentum"], rel=1e-5)
+            assert got["social_intensity"][j] == pytest.approx(
+                want["social_intensity"], rel=1e-4)
+            assert got["social_engagement_rate"][j] == pytest.approx(
+                want["social_engagement_rate"], rel=1e-5)
+            trend = {1.0: "bullish", -1.0: "bearish", 0.0: "neutral"}[
+                float(got["social_trend"][j])]
+            assert trend == want["social_trend"]
+
+    def test_fewer_than_two_points_zero(self, daily):
+        prov = SocialDataProvider(daily)
+        got = prov.indicators_at(np.asarray([int(daily.timestamp[0]) + 1]))
+        assert got["social_momentum"][0] == 0.0
+        assert got["social_engagement_rate"][0] == 0.0
+
+    def test_market_update_enrichment(self, daily):
+        prov = SocialDataProvider(daily)
+        t = int(daily.timestamp[6]) + 60
+        out = prov.generate_market_update_with_social(
+            {"symbol": "BTCUSDC", "price": 50_000.0}, t)
+        assert out["price"] == 50_000.0
+        assert out["social_volume"] == float(daily.columns["social_volume"][6])
+        assert out["social_trend"] in ("bullish", "bearish", "neutral")
+        assert "social_momentum" in out and "news_sentiment" in out
+
+
+class TestCsvRoundTrip:
+    def test_save_load(self, daily, tmp_path):
+        path = save_social_csv(daily, "BTCUSDC", str(tmp_path))
+        back = load_social_csv(path)
+        np.testing.assert_array_equal(back.timestamp, daily.timestamp)
+        for k, v in daily.columns.items():
+            np.testing.assert_allclose(back.columns[k], v, rtol=1e-6)
+
+
+class TestBacktestEndToEnd:
+    def test_social_inputs_drive_population_backtest(self, daily, ohlcv):
+        import jax
+
+        from ai_crypto_trader_tpu.backtest import sample_params
+        from ai_crypto_trader_tpu.backtest.evolvable import population_backtest
+
+        d = {k: v for k, v in ohlcv.items() if k != "regime"}
+        T = len(d["close"])
+        candle_ts = int(daily.timestamp[2]) + np.arange(T, dtype=np.int64) * 60
+        social = SocialDataProvider(daily).social_inputs(candle_ts, "1m")
+        assert social.sentiment.shape == (T,)
+
+        pop = sample_params(jax.random.PRNGKey(0), 8)
+        with_s = population_backtest(d, pop, social=social)
+        without = population_backtest(d, pop)
+        assert np.all(np.isfinite(with_s.final_balance))
+        # the social vote axis changes the vote denominator (5→6 indicator
+        # groups, evolvable_signal), so the signal stream must differ
+        assert (np.any(with_s.total_trades != without.total_trades)
+                or np.any(with_s.final_balance != without.final_balance))
