@@ -1,0 +1,201 @@
+"""NN prediction-service cadence — the loop that drives the model zoo.
+
+Re-expression of `services/neural_network_service.py:1314-1480`
+(`prediction_loop`): per (symbol × interval),
+
+  * re-predict only when the stored prediction is older than HALF the
+    interval (staleness gate, :1366-1387),
+  * periodic retrain every ``retrain_interval_s`` (24 h default —
+    ``model_checkpoint_interval``, :1406-1443),
+  * on-request hyperparameter optimization via the bus key
+    ``nn_optimization_request`` (:1327-1349), recording
+    ``nn_last_optimization_{symbol}_{interval}``,
+  * regime-tagged model snapshots when a market regime is known
+    (:1445-1474), through the framework's single checkpoint story
+    (utils/checkpoint.py) instead of scattered .h5 copies.
+
+All wall-clock reads go through ``now_fn`` so tests drive the cadence with
+a virtual clock (the reference's ``datetime.now()`` sprinkling is what made
+its loop untestable — SURVEY §7.4).  Training is a compiled JAX program on
+the device; this service is pure host-side orchestration.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from ai_crypto_trader_tpu.models.train import TrainResult, predict_prices, train_model
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.utils.checkpoint import save_checkpoint
+
+INTERVAL_SECONDS = {
+    "1m": 60, "3m": 180, "5m": 300, "15m": 900, "30m": 1800,
+    "1h": 3600, "2h": 7200, "4h": 14400, "12h": 43200, "1d": 86400,
+    "3d": 259200, "1w": 604800,
+}
+
+
+def _features_from_klines(klines: list) -> np.ndarray | None:
+    """Bus kline rows → [T, 5] OHLCV feature matrix (close is column 3,
+    the prediction target column used throughout models/train.py)."""
+    if not klines:
+        return None
+    arr = np.asarray([row[1:6] for row in klines], np.float32)
+    return arr if arr.shape[0] > 0 else None
+
+
+@dataclass
+class PredictionService:
+    """Launcher-attachable service; ``run_once`` advances the cadence."""
+
+    bus: EventBus
+    symbols: list[str]
+    intervals: tuple = ("1m", "5m")
+    now_fn: any = None
+    model_type: str = "lstm"
+    seq_len: int = 60
+    epochs: int = 20
+    units: int = 32
+    retrain_interval_s: float = 86_400.0     # model_checkpoint_interval
+    hpo_trials: int = 4
+    checkpoint_dir: str | None = None
+    key: any = None
+    name: str = "nn"
+
+    models: dict = field(default_factory=dict)       # (sym, iv) -> TrainResult
+    train_count: int = 0
+    predict_count: int = 0
+    _last_training: float | None = None
+
+    def __post_init__(self):
+        if self.now_fn is None:
+            import time
+
+            self.now_fn = time.time
+        if self.key is None:
+            self.key = jax.random.PRNGKey(0)
+
+    # -- data ----------------------------------------------------------------
+    def _features(self, symbol: str, interval: str) -> np.ndarray | None:
+        feats = _features_from_klines(
+            self.bus.get(f"historical_data_{symbol}_{interval}") or [])
+        if feats is None or feats.shape[0] < self.seq_len + 8:
+            return None
+        return feats
+
+    # -- training ------------------------------------------------------------
+    def _train_one(self, symbol: str, interval: str) -> TrainResult | None:
+        feats = self._features(symbol, interval)
+        if feats is None:
+            return None
+        self.key, k = jax.random.split(self.key)
+        result = train_model(k, feats, self.model_type,
+                             seq_len=self.seq_len, epochs=self.epochs,
+                             units=self.units)
+        self.models[(symbol, interval)] = result
+        self.train_count += 1
+        self._snapshot(symbol, interval, result)
+        return result
+
+    def _snapshot(self, symbol: str, interval: str, result: TrainResult):
+        """Regime-tagged checkpoint (`neural_network_service.py:1445-1474`):
+        one atomic pytree per (model, interval, regime)."""
+        if self.checkpoint_dir is None:
+            return
+        regime = (self.bus.get("market_regime") or {}).get("regime")
+        tag = f"_{regime}" if regime else ""
+        path = os.path.join(
+            self.checkpoint_dir,
+            f"nn_{self.model_type}_{symbol}_{interval}{tag}.ckpt")
+        save_checkpoint(path, result.params, metadata={
+            "symbol": symbol, "interval": interval,
+            "model_type": self.model_type, "regime": regime or "unknown",
+            "best_val_loss": float(result.best_val_loss),
+            "trained_at": self.now_fn()})
+
+    # -- cadence ---------------------------------------------------------------
+    def _needs_prediction(self, symbol: str, interval: str, now: float) -> bool:
+        prev = self.bus.get(f"nn_prediction_{symbol}_{interval}")
+        if not prev:
+            return True
+        half = INTERVAL_SECONDS.get(interval, 3600) / 2.0
+        return (now - prev.get("reference_time", -1e18)) >= half
+
+    async def _handle_hpo_request(self, now: float) -> bool:
+        req = self.bus.get("nn_optimization_request")
+        if not req or "symbol" not in req or "interval" not in req:
+            return False
+        symbol, interval = req["symbol"], req["interval"]
+        self.bus.set("nn_optimization_request", None)
+        feats = self._features(symbol, interval)
+        if feats is None:
+            return False
+        from ai_crypto_trader_tpu.models.hpo import optimize_hyperparameters
+
+        self.key, k = jax.random.split(self.key)
+        hpo = optimize_hyperparameters(
+            k, feats, n_trials=self.hpo_trials,
+            rung_epochs=(2, max(2, self.epochs // 2)), seq_len=self.seq_len)
+        best = hpo["best_params"]
+        self.bus.set(f"nn_last_optimization_{symbol}_{interval}",
+                     {"at": now, "best": best,
+                      "val_loss": float(hpo["best_val_loss"])})
+        # adopt the winning configuration for this pair
+        self.key, k2 = jax.random.split(self.key)
+        result = train_model(
+            k2, feats, best["model_type"], seq_len=self.seq_len,
+            units=best["units"], dropout=best["dropout"],
+            learning_rate=best["learning_rate"],
+            batch_size=best["batch_size"], epochs=self.epochs)
+        self.models[(symbol, interval)] = result
+        self.train_count += 1
+        self._snapshot(symbol, interval, result)
+        return True
+
+    async def run_once(self) -> dict:
+        now = self.now_fn()
+        out = {"predicted": 0, "trained": 0, "hpo": 0}
+
+        if await self._handle_hpo_request(now):
+            out["hpo"] = 1
+
+        # periodic retrain (24 h cadence, :1406-1443)
+        if (self._last_training is None
+                or now - self._last_training >= self.retrain_interval_s):
+            for symbol in self.symbols:
+                for interval in self.intervals:
+                    if self._train_one(symbol, interval) is not None:
+                        out["trained"] += 1
+            if out["trained"]:
+                self._last_training = now
+
+        # staleness-gated predictions (:1366-1401)
+        for symbol in self.symbols:
+            for interval in self.intervals:
+                if not self._needs_prediction(symbol, interval, now):
+                    continue
+                result = self.models.get((symbol, interval))
+                if result is None:
+                    continue
+                feats = self._features(symbol, interval)
+                if feats is None:
+                    continue
+                pred = predict_prices(result, feats, seq_len=self.seq_len,
+                                      target_col=3)
+                payload = {
+                    "symbol": symbol, "interval": interval,
+                    "predicted_price": float(np.ravel(pred["predicted_price"])[0]),
+                    "confidence": pred["confidence"],
+                    "reference_time": now,
+                }
+                self.bus.set(f"nn_prediction_{symbol}_{interval}", payload)
+                await self.bus.publish("neural_network_predictions",
+                                       {"type": "prediction", **payload})
+                self.predict_count += 1
+                out["predicted"] += 1
+        return out

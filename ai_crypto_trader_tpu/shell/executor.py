@@ -147,6 +147,15 @@ class TradeExecutor:
                 symbol, "SELL", "LIMIT", trade.quantity, price=tp_price)
             trade.tp_order_id = o.get("order_id")
 
+    @staticmethod
+    def _protective_orders(trade: ActiveTrade):
+        """(order_id, close reason, entry-price factor estimating the fill
+        price when no fill record is available) for both protective legs."""
+        return ((trade.tp_order_id, "Take Profit",
+                 1 + trade.take_profit_pct / 100),
+                (trade.stop_order_id, "Stop Loss",
+                 1 - trade.stop_loss_pct / 100))
+
     def _reconcile_protective_fills(self, symbol: str, price: float):
         """Detect server-side fills of the protective SL/TP orders and
         finalize the trade — otherwise a filled TP leaves the trade in
@@ -154,9 +163,7 @@ class TradeExecutor:
         trade = self.active_trades.get(symbol)
         if trade is None:
             return None
-        for oid, reason, px_factor in (
-                (trade.tp_order_id, "Take Profit", 1 + trade.take_profit_pct / 100),
-                (trade.stop_order_id, "Stop Loss", 1 - trade.stop_loss_pct / 100)):
+        for oid, reason, px_factor in self._protective_orders(trade):
             if oid is not None and not self.exchange.order_is_open(symbol, oid):
                 fill = getattr(self.exchange, "last_fill", lambda _o: None)(oid)
                 exit_price = fill["price"] if fill else trade.entry_price * px_factor
@@ -241,10 +248,7 @@ class TradeExecutor:
             fill_reason, exit_price = filled
             await self._finalize_filled(symbol, exit_price, fill_reason)
             return
-        prot = ((trade.tp_order_id, "Take Profit",
-                 1 + trade.take_profit_pct / 100),
-                (trade.stop_order_id, "Stop Loss",
-                 1 - trade.stop_loss_pct / 100))
+        prot = self._protective_orders(trade)
         if trade.stop_order_id is not None:
             self.exchange.cancel_order(symbol, trade.stop_order_id)
             trade.stop_order_id = None
@@ -265,7 +269,9 @@ class TradeExecutor:
                 fill = last_fill(oid) if oid is not None else None
                 if fill is not None:
                     await self._finalize_filled(
-                        symbol, fill["price"], fill_reason)
+                        symbol, fill.get("price",
+                                         trade.entry_price * factor),
+                        fill_reason)
                     return
             return
         self.active_trades.pop(symbol, None)

@@ -40,6 +40,10 @@ class TradingSystem:
     config: FrameworkConfig = field(default_factory=FrameworkConfig)
     now_fn: any = time.time
     dashboard_path: str | None = None
+    # Optional cadence services (objects with .name and async run_once(), e.g.
+    # models.service.PredictionService): driven every tick, exchange-independent
+    # — they read/write only the bus, so an exchange outage doesn't skip them.
+    extra_services: list = field(default_factory=list)
 
     def __post_init__(self):
         self.bus = EventBus(now_fn=self.now_fn)
@@ -90,6 +94,7 @@ class TradingSystem:
             await self.bus.publish("alerts", {
                 "name": "ExchangeUnavailable", "severity": "warning",
                 "message": str(exc), "at": self.now_fn()})
+            await self._run_extra_services()
             # Still evaluate the rule-based alerts: a sustained outage is
             # exactly when StaleMarketData / service-health alerts must
             # fire (and show on the dashboard, which renders alerts.active).
@@ -106,6 +111,7 @@ class TradingSystem:
             return {"published": published, "analyzed": analyzed,
                     "executed": executed, "alerts": 1 + len(fired),
                     "skipped": str(exc)}
+        await self._run_extra_services()
         # total portfolio value: quote balances + base holdings marked at the
         # latest price (free USDC alone would show a phantom loss while a
         # position is open)
@@ -135,6 +141,22 @@ class TradingSystem:
             self._render_dashboard()
         return {"published": published, "analyzed": analyzed,
                 "executed": executed, "alerts": len(fired)}
+
+    async def _run_extra_services(self):
+        for svc in self.extra_services:
+            name = getattr(svc, "name", type(svc).__name__)
+            try:
+                await svc.run_once()
+            except Exception as exc:       # noqa: BLE001 — service isolation:
+                # one failing cadence service must not kill the trading loop;
+                # withholding its heartbeat lets the service-health alert fire
+                self.metrics.inc("errors_total", kind=f"service_{name}")
+                await self.bus.publish("alerts", {
+                    "name": "ServiceError", "severity": "warning",
+                    "service": name, "message": str(exc),
+                    "at": self.now_fn()})
+                continue
+            self.heartbeats.beat(name)
 
     def _render_dashboard(self):
         sym = self.symbols[0]

@@ -120,8 +120,7 @@ class SocialDataProvider:
         where the series starts later than the candles."""
         candle_ts = np.asarray(candle_ts, np.int64)
         step = INTERVAL_SECONDS.get(interval, 86_400)
-        key = (interval, candle_ts[0] if candle_ts.size else 0,
-               candle_ts[-1] if candle_ts.size else 0, candle_ts.size)
+        key = (interval, hash(candle_ts.tobytes()))
         if key not in self._cache:
             grid, src = resample_ffill(self.daily.timestamp, step)
             if grid.size == 0:
@@ -168,7 +167,8 @@ class SocialDataProvider:
         pct[1:] = np.where(vol[:-1] != 0.0, (vol[1:] - vol[:-1]) / vol[:-1], 0.0)
         inten_daily = np.zeros(n)
         for i in range(2, n):
-            lo = max(1, i + 1 - intensity_window)
+            # reference: np.diff(vol[-window:]) → window-1 pct-change samples
+            lo = max(1, i + 2 - intensity_window)
             w = pct[lo:i + 1]
             inten_daily[i] = w.std(ddof=1) * 100.0 if w.size > 1 else 0.0
         # engagement rate (:183-187)

@@ -1,0 +1,133 @@
+"""PredictionService cadence on a virtual clock.
+
+Pins the reference's prediction-loop semantics
+(`services/neural_network_service.py:1314-1480`): staleness-gated
+re-predict per (symbol × interval), periodic retrain, HPO on request,
+regime-tagged snapshots — all driven deterministically via now_fn.
+"""
+
+import asyncio
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.models.service import PredictionService
+from ai_crypto_trader_tpu.shell.bus import EventBus
+
+
+class Clock:
+    def __init__(self, t0=1_000_000.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+
+def make_klines(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    close = 100.0 * np.cumprod(1 + rng.normal(0, 0.003, n))
+    rows = []
+    for i in range(n):
+        c = close[i]
+        rows.append([i * 60_000, c * 0.999, c * 1.002, c * 0.997, c,
+                     1000.0 + rng.uniform(0, 10)])
+    return rows
+
+
+@pytest.fixture()
+def svc(tmp_path):
+    bus = EventBus()
+    bus.set("historical_data_BTCUSDC_1m", make_klines())
+    clock = Clock()
+    svc = PredictionService(
+        bus, ["BTCUSDC"], intervals=("1m",), now_fn=clock,
+        seq_len=24, epochs=2, units=8, hpo_trials=2,
+        checkpoint_dir=str(tmp_path))
+    svc.clock = clock
+    return svc
+
+
+class TestCadence:
+    def test_first_tick_trains_and_predicts(self, svc):
+        out = asyncio.run(svc.run_once())
+        assert out["trained"] == 1 and out["predicted"] == 1
+        pred = svc.bus.get("nn_prediction_BTCUSDC_1m")
+        assert pred["reference_time"] == svc.clock.t
+        assert np.isfinite(pred["predicted_price"])
+        assert 0.0 < pred["confidence"] <= 1.0
+        assert svc.bus.published_counts.get("neural_network_predictions") == 1
+
+    def test_staleness_gate_half_interval(self, svc):
+        asyncio.run(svc.run_once())
+        svc.clock.t += 29          # < 30 s = half of 1m: too fresh
+        out = asyncio.run(svc.run_once())
+        assert out["predicted"] == 0
+        svc.clock.t += 2           # past the half-interval boundary
+        out = asyncio.run(svc.run_once())
+        assert out["predicted"] == 1
+
+    def test_retrain_fires_every_24h(self, svc):
+        asyncio.run(svc.run_once())
+        assert svc.train_count == 1
+        svc.clock.t += 86_399
+        asyncio.run(svc.run_once())
+        assert svc.train_count == 1      # not yet
+        svc.clock.t += 2
+        asyncio.run(svc.run_once())
+        assert svc.train_count == 2      # 24 h elapsed → retrain
+
+    def test_regime_tagged_snapshot(self, svc, tmp_path):
+        svc.bus.set("market_regime", {"regime": "bull"})
+        asyncio.run(svc.run_once())
+        snaps = glob.glob(os.path.join(str(tmp_path), "*_bull.ckpt"))
+        assert len(snaps) == 1
+
+    def test_untagged_snapshot_without_regime(self, svc, tmp_path):
+        asyncio.run(svc.run_once())
+        snaps = os.listdir(str(tmp_path))
+        assert any(s.endswith("_1m.ckpt") for s in snaps)
+
+    def test_hpo_request_adopts_winner(self, svc):
+        asyncio.run(svc.run_once())
+        svc.bus.set("nn_optimization_request",
+                    {"symbol": "BTCUSDC", "interval": "1m"})
+        svc.clock.t += 40
+        out = asyncio.run(svc.run_once())
+        assert out["hpo"] == 1
+        rec = svc.bus.get("nn_last_optimization_BTCUSDC_1m")
+        assert rec["at"] == svc.clock.t
+        assert "model_type" in rec["best"]
+        assert svc.bus.get("nn_optimization_request") is None
+
+    def test_no_data_no_crash(self):
+        bus = EventBus()
+        svc = PredictionService(bus, ["ETHUSDC"], intervals=("1m",),
+                                now_fn=Clock(), seq_len=24, epochs=2)
+        out = asyncio.run(svc.run_once())
+        assert out == {"predicted": 0, "trained": 0, "hpo": 0}
+
+
+class TestLauncherWiring:
+    def test_extra_service_driven_by_tick(self, tmp_path):
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        clock = Clock()
+        d = generate_ohlcv(n=2048, seed=3)
+        series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                           symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000)
+        ex.advance("BTCUSDC", steps=1500)   # enough history for the monitor
+        sys_ = TradingSystem(ex, ["BTCUSDC"], now_fn=clock)
+        svc = PredictionService(sys_.bus, ["BTCUSDC"], intervals=("1m",),
+                                now_fn=clock, seq_len=24, epochs=2, units=8)
+        sys_.extra_services.append(svc)
+
+        asyncio.run(sys_.tick())
+        assert svc.train_count == 1 and svc.predict_count == 1
+        assert sys_.bus.get("nn_prediction_BTCUSDC_1m") is not None
+        assert "nn" in sys_.heartbeats.health()

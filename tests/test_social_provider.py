@@ -174,6 +174,32 @@ class TestIndicators:
                 float(got["social_trend"][j])]
             assert trend == want["social_trend"]
 
+    def test_matches_reference_port_long_series(self, rng):
+        # 60 days saturates the 30-day intensity window — catches
+        # off-by-one errors in the trailing pct-change sample count
+        daily = make_daily(rng, days=60)
+        prov = SocialDataProvider(daily)
+        probes = [int(daily.timestamp[i]) + 3600 for i in (35, 45, 59)]
+        got = prov.indicators_at(np.asarray(probes, np.int64))
+        for j, t in enumerate(probes):
+            want = self.reference_indicators(daily, t)
+            assert got["social_intensity"][j] == pytest.approx(
+                want["social_intensity"], rel=1e-4)
+            assert got["social_momentum"][j] == pytest.approx(
+                want["social_momentum"], rel=1e-5)
+
+    def test_cache_distinguishes_interior_gaps(self, daily):
+        # same first/last/length, different interior grid: the cached
+        # candle→daily index map must not be reused across them
+        t0 = int(daily.timestamp[2])
+        a = np.asarray([t0, t0 + 60, t0 + 3 * DAY], np.int64)
+        b = np.asarray([t0, t0 + 2 * DAY, t0 + 3 * DAY], np.int64)
+        prov = SocialDataProvider(daily)
+        va = prov.metrics_at(a, "1m")["social_volume"]
+        vb = prov.metrics_at(b, "1m")["social_volume"]
+        assert vb[1] == daily.columns["social_volume"][4]  # day t0+2d
+        assert va[1] == daily.columns["social_volume"][2]  # still day t0
+
     def test_fewer_than_two_points_zero(self, daily):
         prov = SocialDataProvider(daily)
         got = prov.indicators_at(np.asarray([int(daily.timestamp[0]) + 1]))
