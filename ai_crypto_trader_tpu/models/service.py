@@ -67,10 +67,16 @@ class PredictionService:
     key: any = None
     name: str = "nn"
 
+    # When True, the synchronous JAX work (training / HPO / inference) runs
+    # in a worker thread via asyncio.to_thread so a 24 h-retrain tick cannot
+    # stall the trading event loop; bus reads/writes stay on the loop either
+    # way. Default False keeps tests single-threaded and deterministic.
+    offload: bool = False
+
     models: dict = field(default_factory=dict)       # (sym, iv) -> TrainResult
     train_count: int = 0
     predict_count: int = 0
-    _last_training: float | None = None
+    _last_training: dict = field(default_factory=dict)   # (sym, iv) -> time
 
     def __post_init__(self):
         if self.now_fn is None:
@@ -126,15 +132,8 @@ class PredictionService:
         half = INTERVAL_SECONDS.get(interval, 3600) / 2.0
         return (now - prev.get("reference_time", -1e18)) >= half
 
-    async def _handle_hpo_request(self, now: float) -> bool:
-        req = self.bus.get("nn_optimization_request")
-        if not req or "symbol" not in req or "interval" not in req:
-            return False
-        symbol, interval = req["symbol"], req["interval"]
-        self.bus.set("nn_optimization_request", None)
-        feats = self._features(symbol, interval)
-        if feats is None:
-            return False
+    def _run_hpo(self, symbol: str, interval: str, feats, now: float):
+        """HPO + adoption of the winner; returns the optimization record."""
         from ai_crypto_trader_tpu.models.hpo import optimize_hyperparameters
 
         self.key, k = jax.random.split(self.key)
@@ -142,10 +141,6 @@ class PredictionService:
             k, feats, n_trials=self.hpo_trials,
             rung_epochs=(2, max(2, self.epochs // 2)), seq_len=self.seq_len)
         best = hpo["best_params"]
-        self.bus.set(f"nn_last_optimization_{symbol}_{interval}",
-                     {"at": now, "best": best,
-                      "val_loss": float(hpo["best_val_loss"])})
-        # adopt the winning configuration for this pair
         self.key, k2 = jax.random.split(self.key)
         result = train_model(
             k2, feats, best["model_type"], seq_len=self.seq_len,
@@ -155,24 +150,43 @@ class PredictionService:
         self.models[(symbol, interval)] = result
         self.train_count += 1
         self._snapshot(symbol, interval, result)
-        return True
+        return {"at": now, "best": best,
+                "val_loss": float(hpo["best_val_loss"])}
 
-    async def run_once(self) -> dict:
-        now = self.now_fn()
-        out = {"predicted": 0, "trained": 0, "hpo": 0}
+    def _compute(self, now: float, hpo_req: dict | None) -> dict:
+        """ALL synchronous JAX work for one cadence step. Bus access is
+        limited to plain key reads (GIL-safe dict lookups); async bus
+        operations (publish, request clearing) stay on the event loop in
+        run_once, so this can run in a worker thread (see ``offload``)."""
+        out = {"predicted": 0, "trained": 0, "hpo": 0,
+               "kv": [], "events": [], "hpo_consumed": False}
 
-        if await self._handle_hpo_request(now):
-            out["hpo"] = 1
+        if hpo_req and "symbol" in hpo_req and "interval" in hpo_req:
+            symbol, interval = hpo_req["symbol"], hpo_req["interval"]
+            feats = self._features(symbol, interval)
+            if feats is None:
+                # data not there yet: leave the request pending for retry
+                # rather than dropping it silently
+                pass
+            else:
+                rec = self._run_hpo(symbol, interval, feats, now)
+                out["kv"].append(
+                    (f"nn_last_optimization_{symbol}_{interval}", rec))
+                out["hpo"] = 1
+                out["hpo_consumed"] = True
+        elif hpo_req:
+            out["hpo_consumed"] = True       # malformed: drop it
 
-        # periodic retrain (24 h cadence, :1406-1443)
-        if (self._last_training is None
-                or now - self._last_training >= self.retrain_interval_s):
-            for symbol in self.symbols:
-                for interval in self.intervals:
-                    if self._train_one(symbol, interval) is not None:
-                        out["trained"] += 1
-            if out["trained"]:
-                self._last_training = now
+        # periodic retrain, per (symbol × interval) so one pair's missing
+        # data can't starve another's 24 h cadence (:1406-1443)
+        for symbol in self.symbols:
+            for interval in self.intervals:
+                last = self._last_training.get((symbol, interval))
+                if last is not None and now - last < self.retrain_interval_s:
+                    continue
+                if self._train_one(symbol, interval) is not None:
+                    self._last_training[(symbol, interval)] = now
+                    out["trained"] += 1
 
         # staleness-gated predictions (:1366-1401)
         for symbol in self.symbols:
@@ -193,9 +207,25 @@ class PredictionService:
                     "confidence": pred["confidence"],
                     "reference_time": now,
                 }
-                self.bus.set(f"nn_prediction_{symbol}_{interval}", payload)
-                await self.bus.publish("neural_network_predictions",
-                                       {"type": "prediction", **payload})
+                out["kv"].append((f"nn_prediction_{symbol}_{interval}", payload))
+                out["events"].append({"type": "prediction", **payload})
                 self.predict_count += 1
                 out["predicted"] += 1
         return out
+
+    async def run_once(self) -> dict:
+        now = self.now_fn()
+        hpo_req = self.bus.get("nn_optimization_request")
+        if self.offload:
+            import asyncio
+
+            computed = await asyncio.to_thread(self._compute, now, hpo_req)
+        else:
+            computed = self._compute(now, hpo_req)
+        if computed.pop("hpo_consumed"):
+            self.bus.set("nn_optimization_request", None)
+        for key, value in computed.pop("kv"):
+            self.bus.set(key, value)
+        for event in computed.pop("events"):
+            await self.bus.publish("neural_network_predictions", event)
+        return computed

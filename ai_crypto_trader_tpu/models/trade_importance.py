@@ -12,8 +12,10 @@ Capability parity with FeatureImportanceAnalyzer / FeatureImportanceService
     social) with per-group aggregation;
   * pruning features below a relative-importance threshold (25 %) into an
     "optimized model" retrained on the surviving features;
-  * `predict_trade_outcome` with the pruned model;
-  * strategy-weight adjustment hook (`model_integration.py:288`).
+  * `predict_trade_outcome` with the pruned model.
+The consumer side — strategy-weight adjustment from recommendations and
+selection's feature-alignment feed (`model_integration.py:288`) — lives in
+`strategy/integration.py` (FeatureImportanceIntegrator).
 
 The forest itself is an offline, low-rate host-side component (SURVEY §7.4
 "RandomForest/SHAP: keep on host") — sklearn is the documented boundary.
@@ -24,6 +26,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 import numpy as np
+
+# The reference integrator's no-model response (`model_integration.py:230`),
+# shared with strategy.integration so the two paths cannot drift.
+NO_MODEL_PREDICTION = {
+    "success_probability": 0.5, "win_probability": 0.5,
+    "confidence": 0.0, "status": "no_model", "prediction": "unknown",
+}
 
 FEATURE_GROUPS = {
     "price_action": ("price_change_1m", "price_change_5m", "price_change_15m",
@@ -78,9 +87,20 @@ class TradeOutcomeAnalyzer:
         combined = {f: 0.5 * builtin[f] + 0.5 * perm[f]
                     for f in self.feature_names}
         top = max(combined.values()) or 1.0
+        groups = self._group_importance(combined)
+        # recommendations (`feature_importance_analyzer.py` output consumed
+        # by `model_integration.py:288`): groups well above/below a uniform
+        # share are flagged to prioritize/reconsider
+        uniform = 1.0 / max(len(groups), 1)
         self.importances = {
             "builtin": builtin, "permutation": perm, "combined": combined,
-            "groups": self._group_importance(combined),
+            "groups": groups,
+            "recommendations": {
+                "categories_to_prioritize":
+                    [g for g, v in groups.items() if v >= 1.5 * uniform],
+                "categories_to_reconsider":
+                    [g for g, v in groups.items() if v <= 0.5 * uniform],
+            },
         }
 
         self.kept_features = [f for f in self.feature_names
@@ -121,22 +141,20 @@ class TradeOutcomeAnalyzer:
         return {g: v / total for g, v in groups.items()}
 
     def predict_trade_outcome(self, features: dict) -> dict:
-        """`model_integration.py:220`: win probability from the pruned
-        model."""
+        """`model_integration.py:220-288`: win probability from the pruned
+        model, confidence = distance from coin-flip scaled to [0,1], neutral
+        defaults when nothing has been fit yet (the reference's no_model
+        path rather than an exception)."""
         if self.pruned_model is None:
-            raise RuntimeError("fit() first")
+            return dict(NO_MODEL_PREDICTION)
         x = np.asarray([[float(features.get(f, 0.0))
                          for f in self.kept_features]])
         p = self.pruned_model.predict_proba(x)[0]
         win_p = float(p[list(self.pruned_model.classes_).index(1)]) \
             if 1 in self.pruned_model.classes_ else 0.0
-        return {"win_probability": win_p,
+        return {"success_probability": win_p,
+                "win_probability": win_p,
+                "confidence": abs(win_p - 0.5) * 2.0,
+                "status": "success",
                 "prediction": "win" if win_p >= 0.5 else "loss"}
 
-    def adjust_strategy_weights(self, weights: dict) -> dict:
-        """`model_integration.py:288`: scale strategy feature weights by
-        group importance, renormalized."""
-        groups = self.importances.get("groups", {})
-        adjusted = {k: v * (0.5 + groups.get(k, 0.5)) for k, v in weights.items()}
-        total = sum(adjusted.values()) or 1.0
-        return {k: v / total for k, v in adjusted.items()}

@@ -16,12 +16,33 @@ from ai_crypto_trader_tpu.shell.bus import EventBus
 from ai_crypto_trader_tpu.shell.llm import LLMTrader
 
 
+def _flat_features(ctx: dict) -> dict:
+    """Flatten one level of nested context dicts (social/news/pattern) into
+    the flat numeric feature namespace the pruned outcome model was fitted
+    on (FEATURE_GROUPS names like social_sentiment) — nested dicts would
+    otherwise silently read as 0.0 in the gate."""
+    flat = {k: v for k, v in ctx.items() if isinstance(v, (int, float))}
+    for v in ctx.values():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                if isinstance(v2, (int, float)) and k2 not in flat:
+                    flat[k2] = v2
+    return flat
+
+
 @dataclass
 class SignalAnalyzer:
     bus: EventBus
     trader: LLMTrader = field(default_factory=LLMTrader)
     analysis_interval_s: float = 60.0
     now_fn: any = time.time
+    # Optional trade-outcome gate (strategy.integration
+    # FeatureImportanceIntegrator or models.trade_importance analyzer): BUY
+    # decisions whose pruned-model success probability falls below the
+    # threshold are downgraded to HOLD (the integrator consumption path,
+    # `services/model_integration.py:220-288`).
+    outcome_model: any = None
+    min_success_probability: float = 0.45
     _last_analysis: dict = field(default_factory=dict)
 
     def _build_context(self, update: dict) -> dict:
@@ -64,6 +85,18 @@ class SignalAnalyzer:
             "reasoning": analysis.get("reasoning", ""),
             "model_version": analysis.get("model_version"),
         }
+        if self.outcome_model is not None and signal["decision"] == "BUY":
+            outcome = self.outcome_model.predict_trade_outcome(
+                _flat_features(ctx))
+            signal["success_probability"] = outcome["success_probability"]
+            if (outcome["status"] == "success"
+                    and outcome["success_probability"]
+                    < self.min_success_probability):
+                signal["decision"] = "HOLD"
+                signal["reasoning"] = (
+                    f"{signal['reasoning']} [outcome gate: win probability "
+                    f"{outcome['success_probability']:.2f} < "
+                    f"{self.min_success_probability:.2f}]").strip()
         await self.bus.publish("trading_signals", signal)
         self.bus.set(f"latest_signal_{symbol}", signal)
         return signal

@@ -166,7 +166,8 @@ class TradeExecutor:
         for oid, reason, px_factor in self._protective_orders(trade):
             if oid is not None and not self.exchange.order_is_open(symbol, oid):
                 fill = getattr(self.exchange, "last_fill", lambda _o: None)(oid)
-                exit_price = fill["price"] if fill else trade.entry_price * px_factor
+                exit_price = (fill.get("price", trade.entry_price * px_factor)
+                              if fill else trade.entry_price * px_factor)
                 return (reason, exit_price)
         return None
 

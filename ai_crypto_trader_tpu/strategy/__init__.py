@@ -3,6 +3,9 @@ from ai_crypto_trader_tpu.strategy.evaluation import (  # noqa: F401
     cross_validate,
     trade_metrics,
 )
+from ai_crypto_trader_tpu.strategy.integration import (  # noqa: F401
+    FeatureImportanceIntegrator,
+)
 from ai_crypto_trader_tpu.strategy.selection import StrategySelector  # noqa: F401
 from ai_crypto_trader_tpu.strategy.evolution import StrategyEvolver  # noqa: F401
 from ai_crypto_trader_tpu.strategy.registry import ModelRegistry  # noqa: F401

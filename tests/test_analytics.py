@@ -206,11 +206,15 @@ class TestTradeImportance:
         high = an.predict_trade_outcome({"rsi": 85.0, "noise": 0.5, "volatility": 0.025})
         assert low["win_probability"] > high["win_probability"]
 
-    def test_adjust_weights_normalized(self, rng):
+    def test_adjust_weights_from_recommendations(self, rng):
+        from ai_crypto_trader_tpu.strategy import FeatureImportanceIntegrator
+
         an = TradeOutcomeAnalyzer(n_trees=20, n_permutation_repeats=3)
         an.fit(self._trades(rng, 150))
-        w = an.adjust_strategy_weights({"momentum": 0.5, "volatility": 0.5})
-        np.testing.assert_allclose(sum(w.values()), 1.0, rtol=1e-6)
+        integ = FeatureImportanceIntegrator()
+        integ.update_from_analyzer(an)
+        w = integ.adjust_strategy_weights({"momentum": 0.5, "volatility": 0.5})
+        assert w["momentum"] >= 0.5          # rsi-driven wins → prioritized
 
     def test_single_class_raises(self):
         an = TradeOutcomeAnalyzer()

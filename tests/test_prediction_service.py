@@ -101,12 +101,51 @@ class TestCadence:
         assert "model_type" in rec["best"]
         assert svc.bus.get("nn_optimization_request") is None
 
+    def test_per_pair_retrain_no_starvation(self, svc):
+        # ETH has no data on the tick BTC trains; when its data arrives on
+        # the next tick it must train immediately, not wait out the 24 h
+        # global cadence
+        svc.symbols = ["BTCUSDC", "ETHUSDC"]
+        out = asyncio.run(svc.run_once())
+        assert out["trained"] == 1          # only BTC has data
+        svc.clock.t += 60
+        svc.bus.set("historical_data_ETHUSDC_1m", make_klines(seed=1))
+        out = asyncio.run(svc.run_once())
+        assert out["trained"] == 1          # ETH trains now
+        assert ("ETHUSDC", "1m") in svc.models
+
+    def test_hpo_request_deferred_until_data(self, svc):
+        asyncio.run(svc.run_once())
+        svc.bus.set("nn_optimization_request",
+                    {"symbol": "NODATAUSDC", "interval": "1m"})
+        out = asyncio.run(svc.run_once())
+        assert out["hpo"] == 0
+        # request left pending for retry, not silently dropped
+        assert svc.bus.get("nn_optimization_request") is not None
+        svc.bus.set("historical_data_NODATAUSDC_1m", make_klines(seed=2))
+        svc.symbols = ["BTCUSDC", "NODATAUSDC"]
+        svc.clock.t += 60
+        out = asyncio.run(svc.run_once())
+        assert out["hpo"] == 1
+        assert svc.bus.get("nn_optimization_request") is None
+
+    def test_offload_mode_same_results(self, tmp_path):
+        bus = EventBus()
+        bus.set("historical_data_BTCUSDC_1m", make_klines())
+        clock = Clock()
+        svc = PredictionService(bus, ["BTCUSDC"], intervals=("1m",),
+                                now_fn=clock, seq_len=24, epochs=2, units=8,
+                                offload=True)
+        out = asyncio.run(svc.run_once())
+        assert out["trained"] == 1 and out["predicted"] == 1
+        assert bus.get("nn_prediction_BTCUSDC_1m") is not None
+
     def test_no_data_no_crash(self):
         bus = EventBus()
         svc = PredictionService(bus, ["ETHUSDC"], intervals=("1m",),
                                 now_fn=Clock(), seq_len=24, epochs=2)
         out = asyncio.run(svc.run_once())
-        assert out == {"predicted": 0, "trained": 0, "hpo": 0}
+        assert (out["predicted"], out["trained"], out["hpo"]) == (0, 0, 0)
 
 
 class TestLauncherWiring:
