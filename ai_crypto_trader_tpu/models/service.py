@@ -170,6 +170,10 @@ class PredictionService:
                 pass
             else:
                 rec = self._run_hpo(symbol, interval, feats, now)
+                # the adopted winner IS this pair's training for the cycle —
+                # without this the retrain loop below would immediately
+                # clobber it with a default-config model
+                self._last_training[(symbol, interval)] = now
                 out["kv"].append(
                     (f"nn_last_optimization_{symbol}_{interval}", rec))
                 out["hpo"] = 1
@@ -223,7 +227,10 @@ class PredictionService:
         else:
             computed = self._compute(now, hpo_req)
         if computed.pop("hpo_consumed"):
-            self.bus.set("nn_optimization_request", None)
+            # compare-and-clear: a NEW request posted while the offloaded
+            # compute ran must survive for the next cycle, not be dropped
+            if self.bus.get("nn_optimization_request") == hpo_req:
+                self.bus.set("nn_optimization_request", None)
         for key, value in computed.pop("kv"):
             self.bus.set(key, value)
         for event in computed.pop("events"):

@@ -11,3 +11,7 @@ from ai_crypto_trader_tpu.patterns.model import (  # noqa: F401
     preprocess_window,
     train_pattern_model,
 )
+from ai_crypto_trader_tpu.patterns.service import (  # noqa: F401
+    ChartPatternService,
+    pattern_trading_signals,
+)
