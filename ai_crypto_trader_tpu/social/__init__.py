@@ -16,3 +16,9 @@ from ai_crypto_trader_tpu.social.provider import (  # noqa: F401
     asof_indices,
     resample_ffill,
 )
+from ai_crypto_trader_tpu.social.strategy_integrator import (  # noqa: F401
+    SOCIAL_STRATEGY_TEMPLATES,
+    SocialStrategyIntegrator,
+    analyze_social_impact,
+    generate_social_strategy,
+)

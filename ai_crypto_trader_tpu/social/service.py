@@ -63,12 +63,20 @@ class SocialMonitorService:
     cache_ttl_s: float = 300.0
     history_len: int = 500
     now_fn: any = time.time
+    # enhanced-service cadences (`enhanced_social_monitor_service.py:365-452`)
+    accuracy_interval_s: float = 3600.0
+    lead_lag_interval_s: float = 6 * 3600.0
+    accuracy_horizon: int = 12
+    name: str = "social"
     _cache: dict = field(default_factory=dict)
     _history: dict = field(default_factory=dict)   # symbol -> list of rows
     _anomaly_models: dict = field(default_factory=dict)
     _samples_since_fit: dict = field(default_factory=dict)
+    _last_accuracy: float = field(default=-1e18)
+    _last_lead_lag: float = field(default=-1e18)
     source_weights: dict = field(default_factory=lambda: {
         s: w for s, w in zip(SOURCES, (0.35, 0.30, 0.25, 0.10))})
+    source_weights_by_symbol: dict = field(default_factory=dict)
 
     async def poll(self, force: bool = False) -> int:
         provider = self.provider or deterministic_provider
@@ -93,6 +101,9 @@ class SocialMonitorService:
 
             self.bus.set(f"social_metrics_{symbol}", enriched)
             self.bus.set(f"social_snapshot_{symbol}", self._snapshot(symbol, now))
+            # sentiment history series for the strategy integrator
+            self.bus.set(f"social_history_{symbol}",
+                         [r.get("overall_sentiment", 0.5) for r in hist])
             await self.bus.publish("social_updates", enriched)
             published += 1
         return published
@@ -148,5 +159,98 @@ class SocialMonitorService:
         floor = 0.05
         raw = {s: max(acc - 0.5, floor) for s, acc in report.items()}
         total = sum(raw.values())
-        self.source_weights = {s: v / total for s, v in raw.items()}
-        return {"accuracy": report, "weights": dict(self.source_weights)}
+        weights = {s: v / total for s, v in raw.items()}
+        # per-symbol weights; the service-level weights aggregate across
+        # symbols (a bare overwrite would be last-symbol-wins, order- and
+        # data-availability-dependent)
+        self.source_weights_by_symbol[symbol] = weights
+        per_sym = list(self.source_weights_by_symbol.values())
+        self.source_weights = {
+            s: float(np.mean([w[s] for w in per_sym])) for s in SOURCES}
+        return {"accuracy": report, "weights": weights}
+
+    def _closes(self, symbol: str) -> np.ndarray | None:
+        klines = self.bus.get(f"historical_data_{symbol}_1m")
+        if not klines:
+            return None
+        return np.asarray([row[4] for row in klines], np.float32)
+
+    def _sentiment_series(self, symbol: str) -> np.ndarray | None:
+        hist = self._history.get(symbol, [])
+        if len(hist) < 5:
+            return None
+        return np.asarray([r.get("overall_sentiment", 0.5) for r in hist],
+                          np.float32)
+
+    async def run_once(self) -> dict:
+        """Poll + the enhanced service's periodic analyses
+        (`enhanced_social_monitor_service.py:365-452`): a lead-lag report
+        every ``lead_lag_interval_s`` and a multi-symbol accuracy report
+        (driving adaptive weights) every ``accuracy_interval_s``. Report
+        slots are consumed only when a report is actually produced."""
+        from ai_crypto_trader_tpu.social.analyzer import lead_lag_correlation
+
+        now = self.now_fn()
+        published = await self.poll()
+        out = {"published": published, "lead_lag": False, "accuracy": False}
+
+        if now - self._last_lead_lag >= self.lead_lag_interval_s:
+            # closes are resampled to the POLL cadence so sentiment[i] and
+            # close[i] describe the same instant — index-aligning 1m candles
+            # with 300 s-cadence sentiment would scale every lag by the
+            # cadence ratio. Lags are therefore in poll intervals.
+            stride = max(1, int(round(self.cache_ttl_s / 60.0))) \
+                if self.cache_ttl_s > 0 else 1
+            results = {}
+            for symbol in self.symbols:
+                sent, close = self._sentiment_series(symbol), self._closes(symbol)
+                if sent is None or close is None:
+                    continue
+                close = close[::-1][::stride][::-1]
+                if len(close) < 10:
+                    continue
+                n = min(len(sent), len(close))
+                c = close[-n:]
+                returns = np.zeros(n, np.float32)
+                returns[1:] = np.diff(c) / c[:-1]
+                lags, corrs = lead_lag_correlation(
+                    jnp.asarray(sent[-n:]), jnp.asarray(returns))
+                best = int(np.argmax(np.abs(np.asarray(corrs))))
+                results[symbol] = {"optimal_lag": int(np.asarray(lags)[best]),
+                                   "correlation": float(np.asarray(corrs)[best]),
+                                   "lag_unit_s": self.cache_ttl_s or 60.0}
+            if results:
+                self._last_lead_lag = now
+                self.bus.set("social_lead_lag_report",
+                             {"timestamp": now, "symbols": results})
+                out["lead_lag"] = True
+
+        if now - self._last_accuracy >= self.accuracy_interval_s:
+            report = {"symbols": {}, "timestamp": now,
+                      "average_direction_accuracy": 0.0, "total_symbols": 0}
+            stride = max(1, int(round(self.cache_ttl_s / 60.0))) \
+                if self.cache_ttl_s > 0 else 1
+            for symbol in self.symbols:
+                close = self._closes(symbol)
+                if close is None:
+                    continue
+                # same poll-cadence alignment as the lead-lag block: the
+                # horizon is in sentiment observations, so closes must be too
+                res = self.assess_accuracy(symbol, close[::-1][::stride][::-1],
+                                           horizon=self.accuracy_horizon)
+                if "accuracy" not in res:
+                    continue
+                direction = res["accuracy"].get("overall_sentiment", 0.0)
+                report["symbols"][symbol] = {
+                    "direction_accuracy": direction,
+                    "per_source": res["accuracy"],
+                    "weights": res["weights"],
+                }
+                report["total_symbols"] += 1
+                report["average_direction_accuracy"] += direction
+            if report["total_symbols"]:
+                report["average_direction_accuracy"] /= report["total_symbols"]
+                self._last_accuracy = now
+                self.bus.set("social_accuracy_report", report)
+                out["accuracy"] = True
+        return out

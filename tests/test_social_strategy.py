@@ -1,0 +1,204 @@
+"""Social strategy integrator + enhanced monitor reporting cadence.
+
+Pins `services/social_strategy_integrator.py` (impact analysis, variant
+dispatch, parameter tuning, service cadence) and the enhanced monitor's
+periodic accuracy/lead-lag reports
+(`enhanced_social_monitor_service.py:365-452`).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.social import (
+    SOCIAL_STRATEGY_TEMPLATES,
+    SocialMonitorService,
+    SocialStrategyIntegrator,
+    analyze_social_impact,
+    generate_social_strategy,
+)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1_000_000.0
+
+    def __call__(self):
+        return self.t
+
+
+def correlated_series(rng, n=200, sign=1.0, lead=0):
+    """Sentiment that (anti-)predicts the next-candle return, optionally
+    leading by `lead` steps."""
+    sent = rng.uniform(-1, 1, n)
+    rets = np.zeros(n)
+    for t in range(n - 1):
+        src = sent[t - lead] if t - lead >= 0 else 0.0
+        rets[t + 1] = sign * 0.01 * src + rng.normal(0, 0.001)
+    close = 100 * np.cumprod(1 + rets)
+    return sent, close
+
+
+class TestImpactAnalysis:
+    def test_positive_correlation_detected(self, rng):
+        sent, close = correlated_series(rng, sign=1.0)
+        imp = analyze_social_impact(sent, close)
+        assert imp["correlations"]["1h"] > 0.3
+        assert imp["data_points"] == 200
+        assert "positive" in imp["returns_by_sentiment"] \
+            or "very_positive" in imp["returns_by_sentiment"]
+
+    def test_negative_correlation_detected(self, rng):
+        sent, close = correlated_series(rng, sign=-1.0)
+        imp = analyze_social_impact(sent, close)
+        assert imp["correlations"]["1h"] < -0.3
+
+    def test_all_buckets_partition(self, rng):
+        sent, close = correlated_series(rng)
+        imp = analyze_social_impact(sent, close)
+        total = sum(v["count"] for v in imp["returns_by_sentiment"].values())
+        assert total == len(sent)      # no sentiment value left unbucketed
+
+    def test_insufficient_data(self):
+        imp = analyze_social_impact(np.zeros(5), np.ones(5))
+        assert imp["error"] == "insufficient_data"
+
+
+class TestStrategyGeneration:
+    def test_positive_corr_dispatches_trend_following(self, rng):
+        sent, close = correlated_series(rng, sign=1.0)
+        strat = generate_social_strategy("BTCUSDC",
+                                         analyze_social_impact(sent, close))
+        assert strat["strategy_type"] == "trend_following"
+        # strong correlation raises the entry weight above the template
+        assert strat["parameters"]["entry_weight"] > \
+            SOCIAL_STRATEGY_TEMPLATES["trend_following"]["parameters"]["entry_weight"] - 0.2
+
+    def test_negative_corr_dispatches_contrarian(self, rng):
+        sent, close = correlated_series(rng, sign=-1.0)
+        imp = analyze_social_impact(sent, close)
+        if abs(imp["correlations"]["24h"]) <= 0.4:
+            imp["correlations"]["24h"] = -0.5      # pin the dispatch input
+        imp["optimal_lag"] = 0
+        strat = generate_social_strategy("BTCUSDC", imp)
+        assert strat["strategy_type"] == "contrarian"
+
+    def test_leading_sentiment_dispatches_news_reactive(self, rng):
+        sent, close = correlated_series(rng, sign=1.0)
+        imp = analyze_social_impact(sent, close)
+        imp["optimal_lag"], imp["optimal_lag_correlation"] = 6, 0.5
+        strat = generate_social_strategy("BTCUSDC", imp)
+        assert strat["strategy_type"] == "news_reactive"
+        assert strat["parameters"]["sentiment_lookback"] == 12   # 2×lag
+
+    def test_weak_correlation_damps_weights(self):
+        imp = {"correlations": {"1h": 0.05, "4h": 0.05, "24h": 0.05},
+               "strongest_timeframe": {"timeframe": "1h", "correlation": 0.05},
+               "returns_by_sentiment": {}, "optimal_lag": 0,
+               "optimal_lag_correlation": 0.0,
+               "lead_lag_relationship": "coincident", "data_points": 100}
+        strat = generate_social_strategy("X", imp)
+        assert strat["parameters"]["entry_weight"] == 0.3
+        assert strat["parameters"]["exit_weight"] == 0.2
+
+    def test_error_propagates(self):
+        assert "error" in generate_social_strategy(
+            "X", {"error": "insufficient_data"})
+
+
+def make_klines(n, rng):
+    close = 100 * np.cumprod(1 + rng.normal(0, 0.003, n))
+    return [[i, close[i], close[i] * 1.001, close[i] * 0.999, close[i],
+             1000.0] for i in range(n)]
+
+
+class TestIntegratorService:
+    def test_generates_and_caches(self, rng):
+        bus = EventBus()
+        clock = Clock()
+        bus.set("social_history_BTCUSDC",
+                list(rng.uniform(0, 1, 120)))
+        bus.set("historical_data_BTCUSDC_1h", make_klines(120, rng))
+        svc = SocialStrategyIntegrator(bus, ["BTCUSDC"], now_fn=clock)
+        out = asyncio.run(svc.run_once())
+        assert out["generated"] == 1
+        strat = bus.get("social_strategy_BTCUSDC")
+        assert strat["strategy_type"] in SOCIAL_STRATEGY_TEMPLATES
+        assert bus.get("social_impact_analysis_BTCUSDC")["data_points"] > 0
+        # fresh strategy + check interval → no regeneration
+        clock.t += 3601
+        out = asyncio.run(svc.run_once())
+        assert out["generated"] == 0
+        # stale strategy regenerates
+        clock.t += 6 * 3600
+        out = asyncio.run(svc.run_once())
+        assert out["generated"] == 1
+
+    def test_no_data_no_strategy(self):
+        bus = EventBus()
+        svc = SocialStrategyIntegrator(bus, ["X"], now_fn=Clock())
+        assert asyncio.run(svc.run_once())["generated"] == 0
+
+    def test_no_data_does_not_burn_check_slot(self, rng):
+        bus = EventBus()
+        clock = Clock()
+        svc = SocialStrategyIntegrator(bus, ["BTCUSDC"], now_fn=clock)
+        assert asyncio.run(svc.run_once())["generated"] == 0
+        # data arrives seconds later: the next tick generates immediately
+        # instead of waiting out check_interval_s
+        bus.set("social_history_BTCUSDC", list(rng.uniform(0, 1, 120)))
+        bus.set("historical_data_BTCUSDC_1h", make_klines(120, rng))
+        clock.t += 1
+        assert asyncio.run(svc.run_once())["generated"] == 1
+
+    def test_1m_fallback_resamples_to_hourly(self, rng):
+        bus = EventBus()
+        bus.set("social_history_BTCUSDC", list(rng.uniform(0, 1, 50)))
+        bus.set("historical_data_BTCUSDC_1m", make_klines(600, rng))
+        svc = SocialStrategyIntegrator(bus, ["BTCUSDC"], now_fn=Clock())
+        sent, close = svc._series("BTCUSDC")
+        assert len(close) == 10       # 600 minutes → 10 hourly closes
+        # most recent candle is retained
+        assert close[-1] == bus.get("historical_data_BTCUSDC_1m")[-1][4]
+
+
+class TestEnhancedMonitorReports:
+    def _service(self, rng, clock):
+        bus = EventBus()
+        bus.set("historical_data_BTCUSDC_1m", make_klines(300, rng))
+        svc = SocialMonitorService(bus, ["BTCUSDC"], now_fn=clock,
+                                   cache_ttl_s=0.0)
+        return bus, svc
+
+    def _accumulate(self, bus, svc, clock, rng, n=30):
+        """The deterministic provider derives sentiment from
+        market_data_{symbol}; vary it so sentiment leaves the neutral band."""
+        for _ in range(n):
+            bus.set("market_data_BTCUSDC",
+                    {"price_change_15m": float(rng.normal(0, 3))})
+            asyncio.run(svc.poll(force=True))
+            clock.t += 300
+
+    def test_reports_published_after_history(self, rng):
+        clock = Clock()
+        bus, svc = self._service(rng, clock)
+        self._accumulate(bus, svc, clock, rng)
+        out = asyncio.run(svc.run_once())
+        assert out["accuracy"] and out["lead_lag"]
+        rep = bus.get("social_accuracy_report")
+        assert rep["total_symbols"] == 1
+        assert 0.0 <= rep["average_direction_accuracy"] <= 1.0
+        assert "BTCUSDC" in bus.get("social_lead_lag_report")["symbols"]
+        assert bus.get("social_history_BTCUSDC")    # integrator feed exists
+
+    def test_report_slot_not_burned_without_history(self, rng):
+        clock = Clock()
+        bus, svc = self._service(rng, clock)
+        out = asyncio.run(svc.run_once())      # no history yet
+        assert not out["accuracy"]
+        # history arrives; the very next cycle reports without waiting a
+        # full accuracy interval
+        self._accumulate(bus, svc, clock, rng)
+        assert asyncio.run(svc.run_once())["accuracy"]
