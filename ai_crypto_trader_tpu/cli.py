@@ -256,7 +256,8 @@ def cmd_trade(args):
     ex.advance(args.symbol, steps=600)   # warm history so the monitor has a
     #                                      full fixed-shape indicator window
     system = TradingSystem(ex, [args.symbol], now_fn=lambda: clock["t"],
-                           dashboard_path=args.dashboard)
+                           dashboard_path=args.dashboard,
+                           log_path=os.environ.get("LOG_PATH"))
 
     server = None
     if args.serve is not None:
@@ -265,11 +266,27 @@ def cmd_trade(args):
         server = DashboardServer(system, port=args.serve).start()
         print(f"dashboard: http://127.0.0.1:{server.port}/", flush=True)
 
+    metrics_port = int(os.environ.get("METRICS_PORT", "0"))
+
     async def go():
-        for _ in range(args.ticks):
-            ex.advance(args.symbol)
-            clock["t"] += 60.0
-            await system.tick()
+        msrv = None
+        if metrics_port:
+            # Prometheus scrape target (compose: prometheus → trader:9091)
+            msrv = await system.metrics.serve("0.0.0.0", metrics_port)
+            print(f"metrics: http://127.0.0.1:{metrics_port}/metrics",
+                  flush=True)
+        try:
+            for _ in range(args.ticks):
+                ex.advance(args.symbol)
+                clock["t"] += 60.0
+                await system.tick()
+                # tick()'s awaits all complete synchronously (in-process
+                # bus), so without an explicit suspension the loop never
+                # schedules the metrics server's connection handlers
+                await asyncio.sleep(0)
+        finally:
+            if msrv is not None:
+                msrv.close()
         print(json.dumps(system.status(), indent=2, default=str))
 
     try:

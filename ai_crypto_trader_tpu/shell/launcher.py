@@ -44,9 +44,15 @@ class TradingSystem:
     # models.service.PredictionService): driven every tick, exchange-independent
     # — they read/write only the bus, so an exchange outage doesn't skip them.
     extra_services: list = field(default_factory=list)
+    # Structured JSON-lines log sink (utils/structlog.py); None → no file.
+    log_path: str | None = None
 
     def __post_init__(self):
+        from ai_crypto_trader_tpu.utils.structlog import StructuredLogger
+
         self.bus = EventBus(now_fn=self.now_fn)
+        self.log = StructuredLogger("launcher", path=self.log_path,
+                                    now_fn=self.now_fn)
         self.metrics = MetricsRegistry(now_fn=self.now_fn)
         self.alerts = AlertManager(now_fn=self.now_fn)
         self.heartbeats = HeartbeatRegistry(now_fn=self.now_fn)
@@ -63,6 +69,7 @@ class TradingSystem:
         self.analyzer._queue()
         self.executor._queue()
         self._last_market_update = self.now_fn()
+        self._logged_closures = 0
 
     async def tick(self) -> dict:
         """One full pass of the live signal path + observability.
@@ -75,6 +82,7 @@ class TradingSystem:
         from ai_crypto_trader_tpu.shell.exchange import ExchangeUnavailable
 
         published = analyzed = executed = 0
+        t0 = self.now_fn()
         try:
             published = await self.monitor.poll()
             self.heartbeats.beat("monitor")
@@ -91,6 +99,8 @@ class TradingSystem:
             balances = self.exchange.get_balances()
         except ExchangeUnavailable as exc:
             self.metrics.inc("errors_total", kind="exchange_unavailable")
+            self.log.warning("exchange unavailable; tick skipped",
+                             error=str(exc))
             await self.bus.publish("alerts", {
                 "name": "ExchangeUnavailable", "severity": "warning",
                 "message": str(exc), "at": self.now_fn()})
@@ -98,14 +108,7 @@ class TradingSystem:
             # Still evaluate the rule-based alerts: a sustained outage is
             # exactly when StaleMarketData / service-health alerts must
             # fire (and show on the dashboard, which renders alerts.active).
-            fired = self.alerts.evaluate({
-                "market_data_age_s": self.now_fn() - self._last_market_update,
-                "open_positions": len(self.executor.active_trades),
-                "max_positions": self.config.trading.max_positions,
-                "service_health": self.heartbeats.health(),
-            })
-            for alert in fired:
-                await self.bus.publish("alerts", alert)
+            fired = await self._fire_alerts()
             if self.dashboard_path:
                 self._render_dashboard()
             return {"published": published, "analyzed": analyzed,
@@ -123,24 +126,77 @@ class TradingSystem:
                 total += balances[base] * md["current_price"]
         self.metrics.set_gauge("portfolio_value_usd", total)
         self.metrics.set_gauge("open_positions", len(self.executor.active_trades))
+        # the series the Grafana system-overview dashboard panels query
+        # (monitoring/grafana/provisioning/dashboards/system_overview.json)
+        self.metrics.inc("market_updates_total", published)
+        self.metrics.inc("trading_signals_total", analyzed)
+        self.metrics.inc("signals_processed_total", executed)
+        self.metrics.set_gauge("closed_trades", len(self.executor.closed_trades))
+        self.metrics.observe("tick_duration_seconds", self.now_fn() - t0)
+        for service, healthy in self.heartbeats.health().items():
+            self.metrics.set_gauge("service_health", 1.0 if healthy else 0.0,
+                                   service=service)
+        for symbol in self.symbols:
+            sig = self.bus.get(f"latest_signal_{symbol}")
+            if sig:
+                self.metrics.set_gauge("ai_model_confidence",
+                                       sig.get("confidence", 0.0),
+                                       symbol=symbol)
+            soc = self.bus.get(f"social_metrics_{symbol}")
+            if soc:
+                self.metrics.set_gauge("social_sentiment",
+                                       soc.get("overall_sentiment", 0.5),
+                                       symbol=symbol)
         # Snapshot for out-of-loop readers (dashboard server handler
         # threads): they must never call the exchange themselves — that
         # would burn trading rate-limit tokens and, in paper mode, advance
         # the simulation's virtual clock from a foreign thread.
         self._status_cache = self._status_from(balances, total)
 
-        fired = self.alerts.evaluate({
-            "market_data_age_s": self.now_fn() - self._last_market_update,
-            "open_positions": len(self.executor.active_trades),
-            "max_positions": self.config.trading.max_positions,
-            "service_health": self.heartbeats.health(),
-        })
-        for alert in fired:
-            await self.bus.publish("alerts", alert)
+        # structured trade-closure records (the aggregation pipeline's most
+        # queried events; reference logs these per service)
+        n_closed = len(self.executor.closed_trades)
+        for rec in self.executor.closed_trades[self._logged_closures:n_closed]:
+            self.log.info("trade closed", **rec)
+        self._logged_closures = n_closed
+
+        fired = await self._fire_alerts()
         if self.dashboard_path:
             self._render_dashboard()
         return {"published": published, "analyzed": analyzed,
                 "executed": executed, "alerts": len(fired)}
+
+    def _alert_state(self) -> dict:
+        """State for the rule set in utils/alerts.py default_rules —
+        including the LowAIModelConfidence / ExtremeSocialSentiment inputs
+        (worst case across symbols)."""
+        state = {
+            "market_data_age_s": self.now_fn() - self._last_market_update,
+            "open_positions": len(self.executor.active_trades),
+            "max_positions": self.config.trading.max_positions,
+            "service_health": self.heartbeats.health(),
+        }
+        confidences = [
+            s.get("confidence", 0.0)
+            for s in (self.bus.get(f"latest_signal_{sym}")
+                      for sym in self.symbols) if s]
+        if any(c > 0 for c in confidences):
+            state["ai_confidence"] = min(c for c in confidences if c > 0)
+        sentiments = [
+            m.get("overall_sentiment", 0.5)
+            for m in (self.bus.get(f"social_metrics_{sym}")
+                      for sym in self.symbols) if m]
+        if sentiments:
+            state["social_sentiment"] = max(sentiments,
+                                            key=lambda v: abs(v - 0.5))
+        return state
+
+    async def _fire_alerts(self) -> list[dict]:
+        fired = self.alerts.evaluate(self._alert_state())
+        for alert in fired:
+            self.log.warning("alert fired", **alert)
+            await self.bus.publish("alerts", alert)
+        return fired
 
     async def _run_extra_services(self):
         for svc in self.extra_services:

@@ -35,6 +35,15 @@ SOURCES = ("twitter_sentiment", "reddit_sentiment", "news_sentiment",
            "overall_sentiment")
 
 
+def resample_tail(arr: np.ndarray, stride: int) -> np.ndarray:
+    """Every ``stride``-th element counted from the END (the most recent
+    sample is always retained) — the alignment idiom shared by every
+    sentiment↔close correlation site."""
+    if stride <= 1:
+        return arr
+    return arr[::-1][::stride][::-1]
+
+
 def deterministic_provider(bus: EventBus, symbol: str) -> dict | None:
     """Offline stand-in provider: derives social-shaped metrics from recent
     price action on the bus (momentum-chasing sentiment with noise-free
@@ -101,9 +110,12 @@ class SocialMonitorService:
 
             self.bus.set(f"social_metrics_{symbol}", enriched)
             self.bus.set(f"social_snapshot_{symbol}", self._snapshot(symbol, now))
-            # sentiment history series for the strategy integrator
+            # timestamped sentiment history for the strategy integrator —
+            # timestamps let the consumer resample to ITS analysis cadence
+            # instead of guessing this service's poll interval
             self.bus.set(f"social_history_{symbol}",
-                         [r.get("overall_sentiment", 0.5) for r in hist])
+                         [[r["ts"], r.get("overall_sentiment", 0.5)]
+                          for r in hist])
             await self.bus.publish("social_updates", enriched)
             published += 1
         return published
@@ -169,6 +181,12 @@ class SocialMonitorService:
             s: float(np.mean([w[s] for w in per_sym])) for s in SOURCES}
         return {"accuracy": report, "weights": weights}
 
+    @property
+    def poll_stride(self) -> int:
+        """Poll cadence expressed in 1m candles."""
+        return max(1, int(round(self.cache_ttl_s / 60.0))) \
+            if self.cache_ttl_s > 0 else 1
+
     def _closes(self, symbol: str) -> np.ndarray | None:
         klines = self.bus.get(f"historical_data_{symbol}_1m")
         if not klines:
@@ -198,15 +216,14 @@ class SocialMonitorService:
             # closes are resampled to the POLL cadence so sentiment[i] and
             # close[i] describe the same instant — index-aligning 1m candles
             # with 300 s-cadence sentiment would scale every lag by the
-            # cadence ratio. Lags are therefore in poll intervals.
-            stride = max(1, int(round(self.cache_ttl_s / 60.0))) \
-                if self.cache_ttl_s > 0 else 1
+            # cadence ratio. Lags are therefore in stride-minute units.
+            stride = self.poll_stride
             results = {}
             for symbol in self.symbols:
                 sent, close = self._sentiment_series(symbol), self._closes(symbol)
                 if sent is None or close is None:
                     continue
-                close = close[::-1][::stride][::-1]
+                close = resample_tail(close, stride)
                 if len(close) < 10:
                     continue
                 n = min(len(sent), len(close))
@@ -218,7 +235,7 @@ class SocialMonitorService:
                 best = int(np.argmax(np.abs(np.asarray(corrs))))
                 results[symbol] = {"optimal_lag": int(np.asarray(lags)[best]),
                                    "correlation": float(np.asarray(corrs)[best]),
-                                   "lag_unit_s": self.cache_ttl_s or 60.0}
+                                   "lag_unit_s": stride * 60.0}
             if results:
                 self._last_lead_lag = now
                 self.bus.set("social_lead_lag_report",
@@ -228,16 +245,15 @@ class SocialMonitorService:
         if now - self._last_accuracy >= self.accuracy_interval_s:
             report = {"symbols": {}, "timestamp": now,
                       "average_direction_accuracy": 0.0, "total_symbols": 0}
-            stride = max(1, int(round(self.cache_ttl_s / 60.0))) \
-                if self.cache_ttl_s > 0 else 1
             for symbol in self.symbols:
                 close = self._closes(symbol)
                 if close is None:
                     continue
                 # same poll-cadence alignment as the lead-lag block: the
                 # horizon is in sentiment observations, so closes must be too
-                res = self.assess_accuracy(symbol, close[::-1][::stride][::-1],
-                                           horizon=self.accuracy_horizon)
+                res = self.assess_accuracy(
+                    symbol, resample_tail(close, self.poll_stride),
+                    horizon=self.accuracy_horizon)
                 if "accuracy" not in res:
                     continue
                 direction = res["accuracy"].get("overall_sentiment", 0.0)

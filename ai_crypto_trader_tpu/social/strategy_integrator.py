@@ -222,19 +222,34 @@ class SocialStrategyIntegrator:
 
     def _series(self, symbol: str):
         """Hourly sentiment + close from the social monitor's history and
-        kline state on the bus. 1m klines are resampled to hourly so the
-        analysis' 1h/4h/24h step units hold (index-aligning 1m closes with
-        hourly-ish sentiment would scale every lag by the cadence ratio)."""
+        kline state on the bus.
+
+        Both sides are resampled to HOURLY so the analysis' 1h/4h/24h step
+        units hold: sentiment history arrives as timestamped [ts, value]
+        pairs at the monitor's poll cadence and is as-of-sampled onto an
+        hourly grid; 1m klines take every 60th close (index-aligning raw
+        poll-cadence sentiment with hourly closes would scale every lag by
+        the cadence ratio)."""
+        from ai_crypto_trader_tpu.social.provider import asof_indices
+        from ai_crypto_trader_tpu.social.service import resample_tail
+
         snap = self.bus.get(f"social_history_{symbol}")
         klines = self.bus.get(f"historical_data_{symbol}_1h")
-        step = 1
+        stride = 1
         if not klines:
             klines = self.bus.get(f"historical_data_{symbol}_1m")
-            step = 60
+            stride = 60
         if not snap or not klines:
             return None
-        sent = to_signed(np.asarray(snap, np.float64))
-        close = np.asarray([row[4] for row in klines], np.float64)[::-1][::step][::-1]
+        pairs = np.asarray(snap, np.float64)
+        if pairs.ndim != 2 or pairs.shape[1] != 2:
+            return None
+        ts, values = pairs[:, 0].astype(np.int64), pairs[:, 1]
+        grid = np.arange(ts[0], ts[-1] + 1, 3600, dtype=np.int64)
+        idx = asof_indices(grid, ts, "backward")
+        sent = to_signed(values[np.maximum(idx, 0)])
+        close = resample_tail(
+            np.asarray([row[4] for row in klines], np.float64), stride)
         return sent, close
 
     async def run_once(self) -> dict:

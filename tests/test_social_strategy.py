@@ -108,6 +108,12 @@ class TestStrategyGeneration:
             "X", {"error": "insufficient_data"})
 
 
+def hourly_history(rng, n, t0=1_000_000):
+    """Timestamped [ts, sentiment] pairs at hourly cadence."""
+    return [[t0 + i * 3600, float(v)] for i, v in
+            enumerate(rng.uniform(0, 1, n))]
+
+
 def make_klines(n, rng):
     close = 100 * np.cumprod(1 + rng.normal(0, 0.003, n))
     return [[i, close[i], close[i] * 1.001, close[i] * 0.999, close[i],
@@ -118,8 +124,7 @@ class TestIntegratorService:
     def test_generates_and_caches(self, rng):
         bus = EventBus()
         clock = Clock()
-        bus.set("social_history_BTCUSDC",
-                list(rng.uniform(0, 1, 120)))
+        bus.set("social_history_BTCUSDC", hourly_history(rng, 120))
         bus.set("historical_data_BTCUSDC_1h", make_klines(120, rng))
         svc = SocialStrategyIntegrator(bus, ["BTCUSDC"], now_fn=clock)
         out = asyncio.run(svc.run_once())
@@ -148,14 +153,14 @@ class TestIntegratorService:
         assert asyncio.run(svc.run_once())["generated"] == 0
         # data arrives seconds later: the next tick generates immediately
         # instead of waiting out check_interval_s
-        bus.set("social_history_BTCUSDC", list(rng.uniform(0, 1, 120)))
+        bus.set("social_history_BTCUSDC", hourly_history(rng, 120))
         bus.set("historical_data_BTCUSDC_1h", make_klines(120, rng))
         clock.t += 1
         assert asyncio.run(svc.run_once())["generated"] == 1
 
     def test_1m_fallback_resamples_to_hourly(self, rng):
         bus = EventBus()
-        bus.set("social_history_BTCUSDC", list(rng.uniform(0, 1, 50)))
+        bus.set("social_history_BTCUSDC", hourly_history(rng, 50))
         bus.set("historical_data_BTCUSDC_1m", make_klines(600, rng))
         svc = SocialStrategyIntegrator(bus, ["BTCUSDC"], now_fn=Clock())
         sent, close = svc._series("BTCUSDC")
