@@ -1,0 +1,276 @@
+"""Pallas TPU kernel for the replay-scan backtester.
+
+The engine's `lax.scan` (backtest/engine.py) compiles to an XLA while-loop
+whose per-step dispatch overhead dominates at T=525k candles × a [B]-wide
+carry that never saturates the VPU.  This kernel re-expresses the whole
+sweep as one Pallas program:
+
+    grid = (B / BLOCK_B, T / CHUNK_T)        # population-block × time-chunk
+    carry: (24, BLOCK_B) f32 VMEM scratch    # persists across time chunks
+    inputs: per-candle scalars streamed through SMEM chunk by chunk
+    params: per-strategy SL/TP rows in VMEM
+    body:  fori_loop over the chunk — branch-free jnp.where arithmetic
+           identical to engine.run_backtest's step (use_param_sl_tp mode)
+
+so the candle loop runs entirely out of VMEM/SMEM with no per-step XLA
+dispatch, and the population block rides the VPU lanes.  Semantics are
+pinned against `engine.sweep` by tests/test_pallas_backtest.py (same
+candles → same stats); the scan engine remains the reference path and the
+fallback on non-TPU backends.
+
+Reference lineage: the loop being accelerated is the TPU re-expression of
+`backtesting/strategy_tester.py:190-300` — see engine.py's parity notes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ai_crypto_trader_tpu.backtest.engine import BacktestInputs, BacktestStats
+from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
+
+BLOCK_B = 128          # population lanes per program (f32 lane width)
+CHUNK_T = 512          # candles streamed per grid step (9 × 2 KB of SMEM)
+
+# carry rows in the VMEM scratch
+(_BAL, _INPOS, _ENTRY, _QTY, _SL, _TP, _MAXEQ, _MAXDD, _MAXDDP, _TRADES,
+ _WINS, _PROFIT, _LOSS, _SUMR, _SUMR2, _SUMNR2, _NR, _CW, _CL, _MWS,
+ _MLS) = range(21)
+_NCARRY = 24           # padded to a multiple of the 8-sublane f32 tile
+
+_NSTAT = 16            # output rows (15 stats + padding row)
+
+
+def _position_size(balance, vol, volume):
+    """signals.position_size, inlined (binance_ml_strategy.py:251-291)."""
+    hi = vol > 0.02
+    mid = jnp.logical_and(jnp.logical_not(hi), vol > 0.01)
+    position_pct = jnp.where(hi, 0.25, jnp.where(mid, 0.20, 0.15))
+    sl = jnp.where(hi, 0.02, jnp.where(mid, 0.015, 0.01))
+    volume_factor = jnp.minimum(volume / 50_000.0, 1.0)
+    size = balance * position_pct * volume_factor
+    size = jnp.minimum(size, balance * 0.15 / sl)
+    size = jnp.minimum(size, balance * 0.20)
+    size = jnp.maximum(size, balance * 0.10)
+    size = jnp.maximum(size, 40.0)
+    return size
+
+
+def _book_close(c, price, do_close):
+    """engine._book_close on the carry rows dict."""
+    pnl = (price - c[_ENTRY]) * c[_QTY]
+    win = pnl > 0.0
+    closed = do_close.astype(jnp.float32)
+    won = jnp.logical_and(do_close, win).astype(jnp.float32)
+    c[_BAL] = c[_BAL] + jnp.where(do_close, pnl, 0.0)
+    cw = jnp.where(do_close, jnp.where(win, c[_CW] + 1.0, 0.0), c[_CW])
+    cl = jnp.where(do_close, jnp.where(win, 0.0, c[_CL] + 1.0), c[_CL])
+    c[_INPOS] = jnp.where(do_close, 0.0, c[_INPOS])
+    c[_TRADES] = c[_TRADES] + closed
+    c[_WINS] = c[_WINS] + won
+    c[_PROFIT] = c[_PROFIT] + jnp.where(jnp.logical_and(do_close, win), pnl, 0.0)
+    c[_LOSS] = c[_LOSS] + jnp.where(
+        jnp.logical_and(do_close, jnp.logical_not(win)), -pnl, 0.0)
+    c[_CW], c[_CL] = cw, cl
+    c[_MWS] = jnp.maximum(c[_MWS], cw)
+    c[_MLS] = jnp.maximum(c[_MLS], cl)
+    return c
+
+
+def _make_kernel(T_true, warmup, initial_balance, conf_thr, min_strength,
+                 n_tc):
+    def kernel(close_ref, signal_ref, strength_ref, vol_ref, volume_ref,
+               conf_ref, decision_ref, slov_ref, tpov_ref,
+               psl_ref, ptp_ref, out_ref, carry):
+        t_chunk = pl.program_id(1)
+
+        @pl.when(t_chunk == 0)
+        def _seed():
+            carry[...] = jnp.zeros((_NCARRY, BLOCK_B), jnp.float32)
+            carry[_BAL, :] = jnp.full((BLOCK_B,), initial_balance, jnp.float32)
+            carry[_MAXEQ, :] = jnp.full((BLOCK_B,), initial_balance, jnp.float32)
+            # n_r starts at 1 (engine._init_state: initial zero-return point)
+            carry[_NR, :] = jnp.ones((BLOCK_B,), jnp.float32)
+
+        psl = psl_ref[0, :]
+        ptp = ptp_ref[0, :]
+
+        def step(i, _):
+            t = t_chunk * CHUNK_T + i
+            c = {r: carry[r, :] for r in range(21)}
+            close = close_ref[i]
+            # pad candles (t >= T_true) are fully inert: no exits, no
+            # entries, and — crucially — no equity-point booking (they
+            # would inflate n_r and shift the Sharpe denominator)
+            active = jnp.logical_and(t >= warmup, t < T_true)
+            prev_balance = c[_BAL]
+            in_pos = c[_INPOS] > 0.0
+
+            # --- SL/TP scan on the open position ---
+            entry_safe = jnp.where(c[_ENTRY] == 0.0, 1.0, c[_ENTRY])
+            pnl_pct = (close - c[_ENTRY]) / entry_safe * 100.0
+            hit_sl = jnp.logical_and(jnp.logical_and(active, in_pos),
+                                     pnl_pct <= -c[_SL])
+            hit_tp = jnp.logical_and(
+                jnp.logical_and(jnp.logical_and(active, in_pos),
+                                jnp.logical_not(hit_sl)),
+                pnl_pct >= c[_TP])
+            do_close = jnp.logical_or(hit_sl, hit_tp)
+            survived = jnp.logical_and(in_pos, jnp.logical_not(do_close))
+            c = _book_close(c, close, do_close)
+            in_pos = c[_INPOS] > 0.0
+
+            # --- entry gate ---
+            gate = jnp.logical_and(
+                jnp.logical_and(
+                    jnp.logical_and(active, jnp.logical_not(in_pos)),
+                    jnp.logical_and(conf_ref[i] >= conf_thr,
+                                    strength_ref[i] >= min_strength)),
+                jnp.logical_and(signal_ref[i] == decision_ref[i],
+                                decision_ref[i] == 1.0))
+            size = _position_size(c[_BAL], vol_ref[i], volume_ref[i])
+            slov, tpov = slov_ref[i], tpov_ref[i]
+            sl_new = jnp.where(jnp.isnan(slov), psl, slov)
+            tp_new = jnp.where(jnp.isnan(tpov), ptp, tpov)
+            c[_INPOS] = jnp.where(gate, 1.0, c[_INPOS])
+            c[_ENTRY] = jnp.where(gate, close, c[_ENTRY])
+            c[_QTY] = jnp.where(gate, size / close, c[_QTY])
+            c[_SL] = jnp.where(gate, sl_new, c[_SL])
+            c[_TP] = jnp.where(gate, tp_new, c[_TP])
+
+            # --- equity point + drawdown ---
+            book = jnp.logical_and(active, jnp.logical_not(survived))
+            equity = c[_BAL]
+            max_eq = jnp.where(book, jnp.maximum(c[_MAXEQ], equity), c[_MAXEQ])
+            dd = max_eq - equity
+            dd_pct = dd / max_eq * 100.0
+            new_max = jnp.logical_and(book, dd > c[_MAXDD])
+            r = jnp.where(book, (equity - prev_balance) / prev_balance, 0.0)
+            c[_MAXEQ] = max_eq
+            c[_MAXDD] = jnp.where(new_max, dd, c[_MAXDD])
+            c[_MAXDDP] = jnp.where(new_max, dd_pct, c[_MAXDDP])
+            c[_SUMR] = c[_SUMR] + r
+            c[_SUMR2] = c[_SUMR2] + r * r
+            c[_SUMNR2] = c[_SUMNR2] + jnp.where(r < 0.0, r * r, 0.0)
+            c[_NR] = c[_NR] + book.astype(jnp.float32)
+
+            for row in range(21):
+                carry[row, :] = c[row]
+            return 0
+
+        jax.lax.fori_loop(0, CHUNK_T, step, 0)
+
+        @pl.when(t_chunk == n_tc - 1)
+        def _finish():
+            # close any remaining position at the last price ("End of Test")
+            c = {r: carry[r, :] for r in range(21)}
+            c = _book_close(c, close_ref[CHUNK_T - 1], c[_INPOS] > 0.0)
+            out = jnp.zeros((_NSTAT, BLOCK_B), jnp.float32)
+            out = out.at[0, :].set(jnp.full((BLOCK_B,), initial_balance))
+            out = out.at[1, :].set(c[_BAL])
+            out = out.at[2, :].set(c[_TRADES])
+            out = out.at[3, :].set(c[_WINS])
+            out = out.at[4, :].set(c[_TRADES] - c[_WINS])
+            out = out.at[5, :].set(c[_PROFIT])
+            out = out.at[6, :].set(c[_LOSS])
+            out = out.at[7, :].set(c[_MAXDD])
+            out = out.at[8, :].set(c[_MAXDDP])
+            out = out.at[9, :].set(c[_SUMR])
+            out = out.at[10, :].set(c[_SUMR2])
+            out = out.at[11, :].set(c[_SUMNR2])
+            out = out.at[12, :].set(c[_NR])
+            out = out.at[13, :].set(c[_MWS])
+            out = out.at[14, :].set(c[_MLS])
+            out_ref[...] = out
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("initial_balance", "ai_confidence_threshold",
+                     "min_signal_strength", "warmup", "interpret"),
+)
+def sweep_pallas(inputs: BacktestInputs, params: StrategyParams,
+                 initial_balance: float = 10_000.0,
+                 ai_confidence_threshold: float = 0.7,
+                 min_signal_strength: float = 70.0,
+                 warmup: int = 10,
+                 interpret: bool = False) -> BacktestStats:
+    """Drop-in for `engine.sweep(..., use_param_sl_tp=True)` stats.
+
+    Pads T to a CHUNK_T multiple (neutral candles: zero signal, never
+    traded, warmup-masked... the pad rides AFTER the data so the final
+    close must use the true last candle — handled by padding with the last
+    close and NEUTRAL signals, which cannot open or close positions and
+    book no equity points (signal 0 ≠ decision requirement uses decision
+    pad -2)). Pads B to a BLOCK_B multiple and slices back.
+    """
+    T = inputs.close.shape[-1]
+    B = jax.tree.leaves(params)[0].shape[0]
+    pad_t = (-T) % CHUNK_T
+    pad_b = (-B) % BLOCK_B
+
+    def pad_time(x, fill):
+        return jnp.concatenate([x, jnp.full((pad_t,), fill, x.dtype)]) \
+            if pad_t else x
+
+    close = pad_time(inputs.close, inputs.close[-1])
+    f32 = lambda x: x.astype(jnp.float32)
+    arrs = dict(
+        close=f32(close),
+        signal=f32(pad_time(inputs.signal.astype(jnp.float32), 0.0)),
+        strength=f32(pad_time(inputs.strength, 0.0)),
+        vol=f32(pad_time(inputs.volatility, 0.0)),
+        volume=f32(pad_time(inputs.volume, 0.0)),
+        conf=f32(pad_time(inputs.confidence, 0.0)),
+        # decision pad -2 can never equal signal pad 0 nor BUY=1
+        decision=f32(pad_time(inputs.decision.astype(jnp.float32), -2.0)),
+        slov=f32(pad_time(inputs.sl_pct, jnp.nan)),
+        tpov=f32(pad_time(inputs.tp_pct, jnp.nan)),
+    )
+    psl = params.stop_loss.astype(jnp.float32)
+    ptp = params.take_profit.astype(jnp.float32)
+    if pad_b:
+        psl = jnp.concatenate([psl, jnp.zeros((pad_b,), jnp.float32)])
+        ptp = jnp.concatenate([ptp, jnp.zeros((pad_b,), jnp.float32)])
+    psl = psl.reshape(1, -1)
+    ptp = ptp.reshape(1, -1)
+
+    Tp, Bp = T + pad_t, B + pad_b
+    n_tc = Tp // CHUNK_T
+    kernel = _make_kernel(T, warmup, float(initial_balance),
+                          float(ai_confidence_threshold),
+                          float(min_signal_strength), n_tc)
+
+    t_spec = pl.BlockSpec((CHUNK_T,), lambda b, t: (t,),
+                          memory_space=pltpu.SMEM)
+    p_spec = pl.BlockSpec((1, BLOCK_B), lambda b, t: (0, b))
+    out = pl.pallas_call(
+        kernel,
+        grid=(Bp // BLOCK_B, n_tc),
+        in_specs=[t_spec] * 9 + [p_spec, p_spec],
+        out_specs=pl.BlockSpec((_NSTAT, BLOCK_B), lambda b, t: (0, b)),
+        out_shape=jax.ShapeDtypeStruct((_NSTAT, Bp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_NCARRY, BLOCK_B), jnp.float32)],
+        interpret=interpret,
+    )(arrs["close"], arrs["signal"], arrs["strength"], arrs["vol"],
+      arrs["volume"], arrs["conf"], arrs["decision"], arrs["slov"],
+      arrs["tpov"], psl, ptp)
+
+    out = out[:, :B]
+    i32 = lambda row: out[row].astype(jnp.int32)
+    return BacktestStats(
+        initial_balance=jnp.asarray(initial_balance, jnp.float32),
+        final_balance=out[1],
+        total_trades=i32(2), winning_trades=i32(3), losing_trades=i32(4),
+        total_profit=out[5], total_loss=out[6],
+        max_drawdown=out[7], max_drawdown_pct=out[8],
+        sum_r=out[9], sum_r2=out[10], sum_neg_r2=out[11], n_r=i32(12),
+        max_win_streak=i32(13), max_loss_streak=i32(14),
+    )

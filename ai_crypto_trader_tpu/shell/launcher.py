@@ -82,7 +82,9 @@ class TradingSystem:
         from ai_crypto_trader_tpu.shell.exchange import ExchangeUnavailable
 
         published = analyzed = executed = 0
-        t0 = self.now_fn()
+        t0 = time.perf_counter()      # wall time: now_fn may be a virtual
+        #                               clock in paper mode, and the latency
+        #                               panel must show real compute time
         try:
             published = await self.monitor.poll()
             self.heartbeats.beat("monitor")
@@ -99,6 +101,13 @@ class TradingSystem:
             balances = self.exchange.get_balances()
         except ExchangeUnavailable as exc:
             self.metrics.inc("errors_total", kind="exchange_unavailable")
+            # work done before the outage hit still counts — the rate
+            # panels would otherwise under-report exactly during outages
+            self.metrics.inc("market_updates_total", published)
+            self.metrics.inc("trading_signals_total", analyzed)
+            self.metrics.inc("signals_processed_total", executed)
+            self.metrics.observe("tick_duration_seconds",
+                                 time.perf_counter() - t0)
             self.log.warning("exchange unavailable; tick skipped",
                              error=str(exc))
             await self.bus.publish("alerts", {
@@ -132,7 +141,8 @@ class TradingSystem:
         self.metrics.inc("trading_signals_total", analyzed)
         self.metrics.inc("signals_processed_total", executed)
         self.metrics.set_gauge("closed_trades", len(self.executor.closed_trades))
-        self.metrics.observe("tick_duration_seconds", self.now_fn() - t0)
+        self.metrics.observe("tick_duration_seconds",
+                             time.perf_counter() - t0)
         for service, healthy in self.heartbeats.health().items():
             self.metrics.set_gauge("service_health", 1.0 if healthy else 0.0,
                                    service=service)

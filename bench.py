@@ -156,6 +156,31 @@ def main():
     candles_per_sec = T * B / best_dt
     log(f"best: unroll={best_unroll}, {candles_per_sec:,.0f} candles/s/chip")
 
+    # Pallas replay kernel: VMEM-resident candle loop with no per-step XLA
+    # dispatch (ops/pallas_backtest.py). TPU-only candidate; the scan path
+    # remains the reference. Any failure falls back to the scan number.
+    if platform not in ("cpu",) and os.environ.get("BENCH_PALLAS", "1") == "1":
+        try:
+            from ai_crypto_trader_tpu.ops.pallas_backtest import sweep_pallas
+
+            t0 = time.perf_counter()
+            stats = sweep_pallas(inp, params)
+            jax.block_until_ready(stats.final_balance)
+            log(f"pallas sweep compile+first run: {time.perf_counter()-t0:.1f}s")
+            t0 = time.perf_counter()
+            stats = sweep_pallas(inp, params)
+            jax.block_until_ready(stats.final_balance)
+            dt = time.perf_counter() - t0
+            log(f"pallas steady-state sweep: {dt:.3f}s → "
+                f"{T*B/dt:,.0f} candles/s/chip")
+            if dt < best_dt:
+                best_dt = dt
+                candles_per_sec = T * B / dt
+                log("pallas kernel wins")
+        except Exception as e:           # noqa: BLE001 — bench must not die
+            log(f"pallas sweep unavailable ({type(e).__name__}: {e}); "
+                "keeping scan number")
+
     ref_cps = reference_cpu_candles_per_sec(inp)
     log(f"reference CPU loop: {ref_cps:,.0f} candles/s")
 
