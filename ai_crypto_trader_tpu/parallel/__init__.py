@@ -7,3 +7,8 @@ from ai_crypto_trader_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_leading_axis,
 )
+from ai_crypto_trader_tpu.parallel.time_shard import (  # noqa: F401
+    sharded_ema,
+    sharded_first_order_recursion,
+    sharded_rolling_mean,
+)

@@ -1,0 +1,120 @@
+"""Sequence parallelism: the candle axis sharded across the mesh.
+
+The only long axis in this domain is the backtest candle axis (a year of
+1m candles ≈ 525,600 steps — SURVEY §5.7); its "context parallelism" is
+not attention but the prefix-scan indicator family. This module shards
+that axis across devices the way ring attention shards sequence blocks:
+
+* `sharded_first_order_recursion` — the EMA-family recurrence
+  ``y[t] = a[t]·y[t-1] + b[t]`` computed blockwise: each device runs the
+  local associative scan, the per-block affine aggregates
+  ``(A_i, B_i) = (∏a, local final)`` are all-gathered over ICI, the
+  incoming carry for each block is the composition of its predecessors,
+  and a rank-1 fix-up ``y += carry · cumprod(a)`` makes the result exact.
+  One collective of 2·N scalars replaces any cross-device sequential
+  dependency.
+* `sharded_ema` — pandas-parity EMA (ops.indicators.ema semantics) on a
+  time-sharded series.
+* `sharded_rolling_mean` — windowed reductions via halo exchange: each
+  device `ppermute`s its tail (window-1 candles) to its right neighbour,
+  exactly the ring pattern of blockwise attention.
+
+Everything runs under `shard_map` over the mesh's data axis; on one device
+the math degenerates to the unsharded kernels (same ops, same order).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ai_crypto_trader_tpu.ops.indicators import first_order_recursion
+
+
+def _carry_for_my_block(A, B, axis: str):
+    """Incoming carry for this device's block: the composition of all
+    predecessor blocks' affine aggregates applied to y=0."""
+    idx = lax.axis_index(axis)
+    As = lax.all_gather(A, axis)            # [n]
+    Bs = lax.all_gather(B, axis)
+
+    def step(c, ab):
+        a, b = ab
+        return a * c + b, c
+
+    # scan over blocks is O(n_devices) scalar work — negligible
+    _, carries = lax.scan(step, 0.0, (As, Bs))
+    return carries[idx]
+
+
+def sharded_first_order_recursion(a, b, mesh, axis: str = "data"):
+    """Exact ``y[t] = a[t]·y[t-1] + b[t]`` over a time-sharded pair.
+
+    `a`/`b` are global [T] arrays (T divisible by the axis size); the
+    result carries the same sharding.
+    """
+    spec = P(axis)
+
+    def local(a_blk, b_blk):
+        prefix = jnp.cumprod(a_blk)
+        local_y = first_order_recursion(a_blk, b_blk)
+        carry = _carry_for_my_block(prefix[-1], local_y[-1], axis)
+        return local_y + carry * prefix
+
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
+                       out_specs=spec, check_vma=False)
+    sharding = NamedSharding(mesh, spec)
+    return fn(jax.device_put(a, sharding), jax.device_put(b, sharding))
+
+
+def sharded_ema(x, window: int, mesh, axis: str = "data"):
+    """ops.indicators.ema (pandas ewm adjust=False, min_periods=window) on a
+    time-sharded series — the global (a, b) recurrence coefficients feed
+    `sharded_first_order_recursion` (one carry-fixup implementation)."""
+    alpha = 2.0 / (window + 1.0)
+    t = jnp.arange(x.shape[-1])
+    xs = jnp.nan_to_num(x)
+    a = jnp.where(t == 0, 0.0, 1.0 - alpha)
+    b = jnp.where(t == 0, xs, alpha * xs)
+    y = sharded_first_order_recursion(a, b, mesh, axis)
+    # min_periods warmup: first window-1 positions NaN (_mask_warmup)
+    return jnp.where(t < window - 1, jnp.nan, y)
+
+
+def sharded_rolling_mean(x, window: int, mesh, axis: str = "data"):
+    """ops.indicators.rolling_mean on a time-sharded series via halo
+    exchange: each block receives the previous block's trailing window-1
+    candles over ICI (`ppermute`), so every output is computed from the
+    same window as the unsharded kernel.
+
+    The halo is one block deep: requires ``2 <= window`` and
+    ``window - 1 <= T // axis_size`` (enforced — a violated precondition
+    would silently return wrong-length output through shard_map)."""
+    if window == 1:
+        return jax.device_put(x, NamedSharding(mesh, P(axis)))
+    n_dev = mesh.shape[axis]
+    block = x.shape[-1] // n_dev
+    if window - 1 > block:
+        raise ValueError(
+            f"window {window} needs a halo of {window - 1} candles but the "
+            f"per-device block is only {block}; use fewer shards or the "
+            "unsharded kernel")
+    spec = P(axis)
+
+    def local(x_blk):
+        n = lax.psum(1, axis)
+        idx = lax.axis_index(axis)
+        halo = lax.ppermute(x_blk[-(window - 1):], axis,
+                            [(i, (i + 1) % n) for i in range(n)])
+        # block 0 has no predecessor: NaN halo reproduces the warmup
+        halo = jnp.where(idx == 0, jnp.nan, halo)
+        ext = jnp.concatenate([halo, x_blk])
+        kernel = jnp.ones((window,)) / window
+        means = jnp.convolve(ext, kernel, mode="valid")
+        return means
+
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                       check_vma=False)
+    return fn(jax.device_put(x, NamedSharding(mesh, spec)))

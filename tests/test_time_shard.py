@@ -1,0 +1,99 @@
+"""Sequence parallelism: time-sharded scans vs the unsharded kernels.
+
+The candle axis sharded over the virtual 8-device mesh must produce the
+SAME numbers as the single-device associative-scan kernels — carry fix-up
+collectives for the EMA family, halo exchange for windowed reductions
+(parallel/time_shard.py; SURVEY §5.7's honest analog of context
+parallelism)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu.ops import indicators as ind
+from ai_crypto_trader_tpu.parallel.time_shard import (
+    sharded_ema,
+    sharded_first_order_recursion,
+    sharded_rolling_mean,
+)
+
+T = 4096
+
+
+@pytest.fixture(scope="module")
+def series():
+    rng = np.random.default_rng(42)
+    return jnp.asarray(100.0 * np.cumprod(1 + rng.normal(0, 0.002, T)),
+                       jnp.float32)
+
+
+class TestFirstOrderRecursion:
+    def test_matches_unsharded(self, mesh8, series):
+        rng = np.random.default_rng(7)
+        a = jnp.asarray(rng.uniform(0.8, 0.99, T), jnp.float32)
+        b = jnp.asarray(rng.normal(0, 1, T), jnp.float32)
+        want = ind.first_order_recursion(a, b)
+        got = sharded_first_order_recursion(a, b, mesh8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=1e-5)
+
+    def test_result_is_time_sharded(self, mesh8, series):
+        a = jnp.full((T,), 0.9, jnp.float32)
+        b = series * 0.1
+        got = sharded_first_order_recursion(a, b, mesh8)
+        assert len(got.sharding.device_set) == 8
+
+
+class TestShardedEma:
+    @pytest.mark.parametrize("window", [12, 26, 200])
+    def test_matches_ops_ema(self, mesh8, series, window):
+        want = np.asarray(ind.ema(series, window))
+        got = np.asarray(sharded_ema(series, window, mesh8))
+        # identical warmup NaNs, matching values after
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+        m = ~np.isnan(want)
+        np.testing.assert_allclose(got[m], want[m], rtol=2e-5, atol=1e-4)
+
+    def test_block_boundaries_seamless(self, mesh8, series):
+        """The positions straddling device boundaries are where a wrong
+        carry would show: check them explicitly."""
+        window = 20
+        want = np.asarray(ind.ema(series, window))
+        got = np.asarray(sharded_ema(series, window, mesh8))
+        blk = T // 8
+        for edge in range(blk, T, blk):
+            np.testing.assert_allclose(got[edge - 1:edge + 2],
+                                       want[edge - 1:edge + 2],
+                                       rtol=2e-5, atol=1e-4)
+
+
+class TestShardedRollingMean:
+    @pytest.mark.parametrize("window", [5, 20, 50])
+    def test_matches_ops_rolling_mean(self, mesh8, series, window):
+        want = np.asarray(ind.rolling_mean(series, window))
+        got = np.asarray(sharded_rolling_mean(series, window, mesh8))
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+        m = ~np.isnan(want)
+        np.testing.assert_allclose(got[m], want[m], rtol=2e-5, atol=1e-3)
+
+    def test_window_too_large_for_block_raises(self, mesh8):
+        x = jnp.zeros((64,), jnp.float32)      # 8-candle blocks
+        with pytest.raises(ValueError, match="halo"):
+            sharded_rolling_mean(x, 10, mesh8)
+
+    def test_window_one_identity(self, mesh8, series):
+        got = np.asarray(sharded_rolling_mean(series, 1, mesh8))
+        np.testing.assert_allclose(got, np.asarray(series), rtol=1e-6)
+
+    def test_halo_spans_boundary(self, mesh8):
+        """A spike in the last candle of block 0 must appear in block 1's
+        first window means — proof the halo actually traveled."""
+        x = jnp.zeros((T,), jnp.float32)
+        blk = T // 8
+        x = x.at[blk - 1].set(100.0)
+        got = np.asarray(sharded_rolling_mean(x, 5, mesh8))
+        np.testing.assert_allclose(got[blk], 20.0, rtol=1e-6)      # 100/5
+        np.testing.assert_allclose(got[blk + 3], 20.0, rtol=1e-6)
+        assert got[blk + 4] == 0.0                                 # spike out
