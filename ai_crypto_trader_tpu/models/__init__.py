@@ -11,4 +11,8 @@ from ai_crypto_trader_tpu.models.train import (  # noqa: F401
     train_model,
 )
 from ai_crypto_trader_tpu.models.hpo import optimize_hyperparameters  # noqa: F401
+from ai_crypto_trader_tpu.models.long_context import (  # noqa: F401
+    LongContextTransformer,
+    long_context_loss,
+)
 from ai_crypto_trader_tpu.models.importance import feature_importance  # noqa: F401

@@ -1,0 +1,102 @@
+"""Long-context transformer: a price model over the FULL candle history.
+
+The reference's transformer sees exactly 60 candles
+(`services/neural_network_service.py:247-306`, config sequence_length: 60);
+anything older is invisible to it.  This model removes the window: it runs
+causal self-attention over an arbitrarily long candle sequence, and when
+given a mesh it shards the sequence axis across devices and computes the
+attention as ring attention (parallel/ring_attention.py) — K/V blocks
+rotating over ICI, activations never gathered.  Parameters (the Dense
+projections) are tiny and stay replicated; memory per device is O(T/n).
+
+Design notes (TPU-first, not a port):
+  * input is one [T, F] series (seq-to-seq), not a [B, 60, F] window batch —
+    the point of long context is that the batch axis IS the time axis;
+  * every position emits a next-step return prediction, so one forward pass
+    scores the whole history (the windowed zoo models need T passes);
+  * `mesh=None` degenerates to the same math on one device (the parity
+    tests hold the two paths equal).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ai_crypto_trader_tpu.models.zoo import sinusoidal_positions
+from ai_crypto_trader_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_self_attention,
+)
+
+
+class RingSelfAttention(nn.Module):
+    """Causal MHA whose score computation is ring-sharded when a mesh is
+    supplied.  QKV/out projections are plain replicated Dense layers."""
+
+    d_model: int
+    num_heads: int
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x):                       # x: [T, d_model]
+        T, _ = x.shape
+        Dh = self.d_model // self.num_heads
+        qkv = nn.Dense(3 * self.d_model, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (T, self.num_heads, Dh)
+        q, k, v = (a.reshape(shape) for a in (q, k, v))
+        if self.mesh is None:
+            o = reference_attention(q, k, v, causal=True)
+        else:
+            o = ring_self_attention(q, k, v, self.mesh, causal=True)
+        return nn.Dense(self.d_model, name="out")(o.reshape(T, self.d_model))
+
+
+class LongContextBlock(nn.Module):
+    d_model: int
+    num_heads: int
+    ff_dim: int
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x):
+        a = RingSelfAttention(self.d_model, self.num_heads, self.mesh)(x)
+        x = nn.LayerNorm()(x + a)
+        f = nn.Dense(self.ff_dim)(x)
+        f = nn.gelu(f)
+        f = nn.Dense(self.d_model)(f)
+        return nn.LayerNorm()(x + f)
+
+
+class LongContextTransformer(nn.Module):
+    """Causal seq-to-seq forecaster: [T, F] features → [T, 1] next-step
+    return prediction at every position."""
+
+    d_model: int = 64
+    num_heads: int = 4
+    num_blocks: int = 2
+    ff_dim: int = 128
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):   # noqa: ARG002 (zoo API)
+        T, _ = x.shape
+        h = nn.Dense(self.d_model)(x)
+        h = h + sinusoidal_positions(T, self.d_model)
+        for _ in range(self.num_blocks):
+            h = LongContextBlock(self.d_model, self.num_heads,
+                                 self.ff_dim, self.mesh)(h)
+        return {"mean": nn.Dense(1)(nn.gelu(nn.Dense(self.d_model // 2)(h)))}
+
+
+def long_context_loss(model, params, x, y):
+    """Per-position MSE against next-step targets ``y: [T, 1]``; positions
+    with NaN targets (warmup / final step) are masked out."""
+    pred = model.apply(params, x)["mean"]
+    ok = ~jnp.isnan(y)
+    err = jnp.where(ok, pred - jnp.nan_to_num(y), 0.0)
+    return (err ** 2).sum() / jnp.maximum(ok.sum(), 1)

@@ -7,6 +7,10 @@ from ai_crypto_trader_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_leading_axis,
 )
+from ai_crypto_trader_tpu.parallel.ring_attention import (  # noqa: F401
+    reference_attention,
+    ring_self_attention,
+)
 from ai_crypto_trader_tpu.parallel.time_shard import (  # noqa: F401
     sharded_ema,
     sharded_first_order_recursion,

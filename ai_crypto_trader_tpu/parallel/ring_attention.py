@@ -1,0 +1,121 @@
+"""Ring attention: exact self-attention over a sequence sharded across the mesh.
+
+The reference caps every attention model at 60-step windows
+(`services/neural_network_service.py:530-586`, config sequence_length: 60) —
+its transformer never sees a long context.  This module removes that ceiling
+the TPU way: the sequence axis is sharded over the mesh, each device holds
+one Q/K/V block, and K/V blocks rotate around the ring via `ppermute` while
+an online-softmax accumulator (running max / normalizer, flash-attention
+style) folds in one block per step.  After `n_devices` steps every Q block
+has attended over the full sequence without any device ever materializing
+the [T, T] score matrix — memory is O(T·d / n + Tb²) per device and the
+block transfers ride ICI, overlapping with compute in XLA's pipeline.
+
+This is the standard blockwise-ring formulation (Liu et al., "Ring
+Attention with Blockwise Transformers", arXiv:2310.01889 — see PAPERS.md);
+the implementation here is written against `shard_map` + collectives, not
+ported from any reference code (the reference has no distributed attention
+at all — SURVEY §5.7 "long-context: absent").
+
+Numerics: accumulation runs in float32 regardless of input dtype; masked
+positions are excluded by a hard zero on the post-exp weights (not a -1e30
+additive mask), so fully-masked blocks contribute exactly nothing and a
+causal first row stays finite.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_BIG = -1e30   # finite stand-in for -inf: never produces NaN under exp/sub
+
+
+def _block_update(o, m, l, q, k, v, kmask, *, scale):
+    """Fold one K/V block into the (o, m, l) online-softmax accumulator.
+
+    o: [Tq, H, D] f32 unnormalized output;  m, l: [H, Tq] running max and
+    normalizer;  kmask: [Tq, Tk] bool, True where the key is attendable.
+    """
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale          # [H, Tq, Tk]
+    s = jnp.where(kmask[None, :, :], s, NEG_BIG)
+    m_new = jnp.maximum(m, s.max(axis=-1))                 # [H, Tq]
+    # exp of masked lanes may be exp(0)=1 when the whole row is masked —
+    # the explicit mask multiply below zeroes them regardless.
+    p = jnp.exp(s - m_new[..., None]) * kmask[None, :, :]  # [H, Tq, Tk]
+    corr = jnp.exp(m - m_new)                              # [H, Tq]
+    l = l * corr + p.sum(axis=-1)
+    o = o * corr.T[..., None] + jnp.einsum(
+        "hqk,khd->qhd", p, v.astype(jnp.float32))
+    return o, m_new, l
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = "data",
+                        causal: bool = True):
+    """Exact (optionally causal) multi-head self-attention on a
+    sequence-sharded [T, H, D] q/k/v triple.
+
+    ``T`` must divide evenly over ``mesh.shape[axis]``; outputs carry the
+    same sequence sharding as the inputs.  One device degenerates to plain
+    flash-style attention (same ops, same order), so the unsharded path and
+    the ring path share numerics by construction.
+    """
+    T, H, D = q.shape
+    n_dev = mesh.shape[axis]
+    if T % n_dev:
+        raise ValueError(f"sequence length {T} not divisible by the "
+                         f"{n_dev}-way '{axis}' mesh axis")
+    scale = 1.0 / (D ** 0.5)
+    spec = P(axis, None, None)
+
+    def local(q_blk, k_blk, v_blk):
+        n = lax.psum(1, axis)
+        me = lax.axis_index(axis)
+        Tb = q_blk.shape[0]
+        q_pos = me * Tb + jnp.arange(Tb)                   # global positions
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        o = jnp.zeros((Tb, H, D), jnp.float32)
+        m = jnp.full((H, Tb), NEG_BIG, jnp.float32)
+        l = jnp.zeros((H, Tb), jnp.float32)
+
+        def step(carry, s):
+            k_c, v_c, o, m, l = carry
+            src = (me - s) % n                 # who originated this block
+            k_pos = src * Tb + jnp.arange(Tb)
+            if causal:
+                kmask = k_pos[None, :] <= q_pos[:, None]
+            else:
+                kmask = jnp.ones((Tb, Tb), bool)
+            o, m, l = _block_update(o, m, l, q_blk, k_c, v_c, kmask,
+                                    scale=scale)
+            # hand the block to the right neighbour for the next step
+            k_c = lax.ppermute(k_c, axis, perm)
+            v_c = lax.ppermute(v_c, axis, perm)
+            return (k_c, v_c, o, m, l), None
+
+        (_, _, o, m, l), _ = lax.scan(
+            step, (k_blk, v_blk, o, m, l), jnp.arange(n))
+        out = o / jnp.maximum(l, 1e-30).T[..., None]
+        return out.astype(q_blk.dtype)
+
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    sharding = NamedSharding(mesh, spec)
+    return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
+              jax.device_put(v, sharding))
+
+
+def reference_attention(q, k, v, *, causal: bool = True):
+    """Dense single-device oracle (materializes [H, T, T]) for parity tests."""
+    T, H, D = q.shape
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / (D ** 0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None], s, NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -39,6 +39,17 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def fetch(x) -> float:
+    """Force completion by pulling the result to the host.
+
+    `jax.block_until_ready` returns immediately on the experimental axon
+    plugin even while the computation is still in flight (observed: a
+    525k-step scan "completing" in 0.000s), so every timed region here ends
+    with a device→host transfer — a transfer cannot complete before the
+    buffer it reads does, on any backend."""
+    return float(np.asarray(x).ravel()[0])
+
+
 def reference_cpu_candles_per_sec(inputs, n=200_000) -> float:
     """Faithful scalar port of the reference replay loop (strategy_tester.py
     :190-300 semantics; see tests/test_backtest_parity.py oracle)."""
@@ -128,11 +139,11 @@ def main():
     # compile time grows superlinearly in the ~70 long associative scans).
     t0 = time.perf_counter()
     ind = ops.compute_indicators(arrays)
-    jax.block_until_ready(ind["rsi"])
+    fetch(ind["rsi"][-1])
     log(f"indicators (incl. compile): {time.perf_counter()-t0:.1f}s")
     t0 = time.perf_counter()
     inp = prepare_inputs(ind)
-    jax.block_until_ready(inp.strength)
+    fetch(inp.strength[-1])
     log(f"signal features (incl. compile): {time.perf_counter()-t0:.1f}s")
 
     params = sample_params(jax.random.PRNGKey(0), B)
@@ -141,12 +152,12 @@ def main():
     for unroll in unrolls:
         t0 = time.perf_counter()
         stats = sweep(inp, params, unroll=unroll)
-        jax.block_until_ready(stats.final_balance)
+        fetch(stats.final_balance)
         log(f"sweep compile+first run (unroll={unroll}): "
             f"{time.perf_counter()-t0:.1f}s")
         t0 = time.perf_counter()
         stats = sweep(inp, params, unroll=unroll)
-        jax.block_until_ready(stats.final_balance)
+        fetch(stats.final_balance)
         dt = time.perf_counter() - t0
         log(f"steady-state sweep (unroll={unroll}): {dt:.3f}s → "
             f"{T*B/dt:,.0f} candles/s/chip (pop {B} × {T} candles)")
@@ -165,11 +176,11 @@ def main():
 
             t0 = time.perf_counter()
             stats = sweep_pallas(inp, params)
-            jax.block_until_ready(stats.final_balance)
+            fetch(stats.final_balance)
             log(f"pallas sweep compile+first run: {time.perf_counter()-t0:.1f}s")
             t0 = time.perf_counter()
             stats = sweep_pallas(inp, params)
-            jax.block_until_ready(stats.final_balance)
+            fetch(stats.final_balance)
             dt = time.perf_counter() - t0
             log(f"pallas steady-state sweep: {dt:.3f}s → "
                 f"{T*B/dt:,.0f} candles/s/chip")
