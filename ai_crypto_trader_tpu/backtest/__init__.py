@@ -19,3 +19,8 @@ from ai_crypto_trader_tpu.backtest.engine import (  # noqa: F401
     sweep_sharded,
 )
 from ai_crypto_trader_tpu.backtest.metrics import compute_metrics  # noqa: F401
+from ai_crypto_trader_tpu.backtest.portfolio import (  # noqa: F401
+    portfolio_backtest,
+    shared_capital_backtest,
+    stack_symbol_inputs,
+)

@@ -11,13 +11,21 @@ the symbol axis on-device.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ai_crypto_trader_tpu import ops
-from ai_crypto_trader_tpu.backtest.engine import BacktestInputs, prepare_inputs, run_backtest
+from ai_crypto_trader_tpu.backtest import signals as sig
+from ai_crypto_trader_tpu.backtest.engine import (
+    BacktestInputs,
+    BacktestStats,
+    prepare_inputs,
+    run_backtest,
+)
 from ai_crypto_trader_tpu.backtest.metrics import compute_metrics
 from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
 
@@ -48,12 +56,253 @@ def stack_symbol_inputs(per_symbol: dict[str, dict]) -> tuple[BacktestInputs, li
     return batched, symbols
 
 
-@functools.partial(jax.jit, static_argnames=("use_param_sl_tp",))
+class SharedCarry(NamedTuple):
+    """Scan carry for the shared-capital portfolio replay: ONE balance, an
+    [S]-slot position table, portfolio-level stat accumulators, and the
+    per-symbol realized P&L / trade counts."""
+
+    balance: jnp.ndarray       # scalar f32 — the shared capital pool
+    n_open: jnp.ndarray        # scalar i32 — open slots used (global cap)
+    in_pos: jnp.ndarray        # [S] bool
+    entry: jnp.ndarray         # [S]
+    qty: jnp.ndarray           # [S]
+    sl: jnp.ndarray            # [S] percent units
+    tp: jnp.ndarray            # [S]
+    max_equity: jnp.ndarray
+    max_dd: jnp.ndarray
+    max_dd_pct: jnp.ndarray
+    trades: jnp.ndarray        # i32
+    wins: jnp.ndarray
+    total_profit: jnp.ndarray
+    total_loss: jnp.ndarray
+    sum_r: jnp.ndarray
+    sum_r2: jnp.ndarray
+    sum_neg_r2: jnp.ndarray
+    n_r: jnp.ndarray
+    cur_win_streak: jnp.ndarray
+    cur_loss_streak: jnp.ndarray
+    max_win_streak: jnp.ndarray
+    max_loss_streak: jnp.ndarray
+    sym_trades: jnp.ndarray    # [S] i32
+    sym_pnl: jnp.ndarray       # [S] realized P&L per symbol
+
+
+def _shared_close(c: SharedCarry, s: int, price, do_close) -> SharedCarry:
+    """Book a close of symbol slot ``s`` where ``do_close`` (traced bool):
+    realize P&L into the shared balance, free the slot, update streaks."""
+    pnl = (price - c.entry[s]) * c.qty[s]
+    win = pnl > 0.0
+    closed = do_close.astype(jnp.int32)
+    won = (do_close & win).astype(jnp.int32)
+    cw = jnp.where(do_close, jnp.where(win, c.cur_win_streak + 1, 0),
+                   c.cur_win_streak)
+    cl = jnp.where(do_close, jnp.where(win, 0, c.cur_loss_streak + 1),
+                   c.cur_loss_streak)
+    return c._replace(
+        balance=c.balance + jnp.where(do_close, pnl, 0.0),
+        n_open=c.n_open - closed,
+        in_pos=c.in_pos.at[s].set(c.in_pos[s] & ~do_close),
+        trades=c.trades + closed,
+        wins=c.wins + won,
+        total_profit=c.total_profit + jnp.where(do_close & win, pnl, 0.0),
+        total_loss=c.total_loss + jnp.where(do_close & ~win, -pnl, 0.0),
+        cur_win_streak=cw, cur_loss_streak=cl,
+        max_win_streak=jnp.maximum(c.max_win_streak, cw),
+        max_loss_streak=jnp.maximum(c.max_loss_streak, cl),
+        sym_trades=c.sym_trades.at[s].add(closed),
+        sym_pnl=c.sym_pnl.at[s].add(jnp.where(do_close, pnl, 0.0)),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_positions", "warmup", "use_param_sl_tp", "unroll"),
+)
+def shared_capital_backtest(
+    inputs: BacktestInputs,
+    params: StrategyParams | None = None,
+    initial_balance: float = 10_000.0,
+    max_positions: int = 5,
+    ai_confidence_threshold: float = 0.7,
+    min_signal_strength: float = 70.0,
+    warmup: int = 10,
+    use_param_sl_tp: bool = False,
+    unroll: int = 1,
+):
+    """Multi-symbol replay over ONE capital pool with a global position cap.
+
+    This is the semantics the per-symbol vmap cannot express: the reference
+    books every open/close against a single ``self.balance`` and refuses
+    entries once ``len(open_positions) >= max_positions``
+    (`backtesting/strategy_tester.py:225,314-369`; config.json
+    trading_params.max_positions = 5), so position sizing in one symbol
+    depends on capital realized — and slots consumed — by all the others.
+
+    Contract (pinned by tests/test_portfolio_shared.py's scalar oracle):
+      * ``inputs`` carries a leading symbol axis [S, T];
+      * within a candle, symbols are processed in ascending index order:
+        symbol 0's exit frees capital and a slot that symbol 1's entry sees
+        in the SAME candle (the deterministic analog of the reference's
+        update-arrival order);
+      * exits before entries per symbol; a closed slot may re-enter at the
+        same candle (matching the single-symbol engine);
+      * entries are sized by `sig.position_size` on the RUNNING shared
+        balance and admitted only while ``n_open < max_positions``;
+      * one equity point per active candle on the realized balance (the
+        single-symbol 'continue' short-circuit has no portfolio analog);
+      * at the end every open slot is liquidated at its last close, in
+        symbol order.
+
+    The symbol loop is a Python ``for`` (S is small and static), so XLA sees
+    straight-line code per scan step — exact sequential semantics with no
+    nested while-loop dispatch. vmap over ``params`` for population sweeps.
+    """
+    S, T = inputs.close.shape
+    f = lambda v: jnp.asarray(v, jnp.float32)
+    i = lambda v: jnp.asarray(v, jnp.int32)
+    init = SharedCarry(
+        balance=f(initial_balance), n_open=i(0),
+        in_pos=jnp.zeros((S,), bool), entry=jnp.zeros((S,), jnp.float32),
+        qty=jnp.zeros((S,), jnp.float32), sl=jnp.zeros((S,), jnp.float32),
+        tp=jnp.zeros((S,), jnp.float32),
+        max_equity=f(initial_balance), max_dd=f(0.0), max_dd_pct=f(0.0),
+        trades=i(0), wins=i(0), total_profit=f(0.0), total_loss=f(0.0),
+        sum_r=f(0.0), sum_r2=f(0.0), sum_neg_r2=f(0.0), n_r=i(1),
+        cur_win_streak=i(0), cur_loss_streak=i(0),
+        max_win_streak=i(0), max_loss_streak=i(0),
+        sym_trades=jnp.zeros((S,), jnp.int32),
+        sym_pnl=jnp.zeros((S,), jnp.float32),
+    )
+
+    steps = jnp.arange(T, dtype=jnp.int32)
+    xs = (steps,) + tuple(jnp.moveaxis(a, 0, 1) for a in inputs)  # [T, S]
+
+    def step(c: SharedCarry, x):
+        (t, close, signal, strength, vol, volume, conf, decision,
+         slov, tpov) = x
+        active = t >= warmup
+        prev_balance = c.balance
+        for s in range(S):
+            # --- exit scan on slot s ---
+            entry_safe = jnp.where(c.entry[s] == 0.0, 1.0, c.entry[s])
+            pnl_pct = (close[s] - c.entry[s]) / entry_safe * 100.0
+            hit_sl = active & c.in_pos[s] & (pnl_pct <= -c.sl[s])
+            hit_tp = active & c.in_pos[s] & ~hit_sl & (pnl_pct >= c.tp[s])
+            c = _shared_close(c, s, close[s], hit_sl | hit_tp)
+
+            # --- entry gate: shared balance + global slot cap ---
+            gate = (
+                active
+                & ~c.in_pos[s]
+                & (c.n_open < max_positions)
+                & (conf[s] >= ai_confidence_threshold)
+                & (strength[s] >= min_signal_strength)
+                & (signal[s] == decision[s])
+                & (decision[s] == sig.BUY)
+            )
+            plan = sig.position_size(c.balance, vol[s], volume[s])
+            if use_param_sl_tp:
+                assert params is not None
+                sl_new, tp_new = params.stop_loss, params.take_profit
+            else:
+                sl_new = plan.stop_loss_pct * 100.0
+                tp_new = plan.take_profit_pct * 100.0
+            sl_new = jnp.where(jnp.isnan(slov[s]), sl_new, slov[s])
+            tp_new = jnp.where(jnp.isnan(tpov[s]), tp_new, tpov[s])
+            c = c._replace(
+                n_open=c.n_open + gate.astype(jnp.int32),
+                in_pos=c.in_pos.at[s].set(c.in_pos[s] | gate),
+                entry=c.entry.at[s].set(jnp.where(gate, close[s], c.entry[s])),
+                qty=c.qty.at[s].set(
+                    jnp.where(gate, plan.size / close[s], c.qty[s])),
+                sl=c.sl.at[s].set(jnp.where(gate, sl_new, c.sl[s])),
+                tp=c.tp.at[s].set(jnp.where(gate, tp_new, c.tp[s])),
+            )
+
+        # --- one equity point per active candle, realized balance ---
+        equity = c.balance
+        max_eq = jnp.where(active, jnp.maximum(c.max_equity, equity),
+                           c.max_equity)
+        dd = max_eq - equity
+        dd_pct = dd / max_eq * 100.0
+        new_max = active & (dd > c.max_dd)
+        r = jnp.where(active, (equity - prev_balance) / prev_balance, 0.0)
+        c = c._replace(
+            max_equity=max_eq,
+            max_dd=jnp.where(new_max, dd, c.max_dd),
+            max_dd_pct=jnp.where(new_max, dd_pct, c.max_dd_pct),
+            sum_r=c.sum_r + r,
+            sum_r2=c.sum_r2 + r * r,
+            sum_neg_r2=c.sum_neg_r2 + jnp.where(r < 0, r * r, 0.0),
+            n_r=c.n_r + active.astype(jnp.int32),
+        )
+        return c, None
+
+    final, _ = lax.scan(step, init, xs, unroll=unroll)
+
+    # liquidate remaining slots at their last close ("End of Test")
+    for s in range(S):
+        final = _shared_close(final, s, inputs.close[s, -1], final.in_pos[s])
+
+    stats = BacktestStats(
+        initial_balance=jnp.asarray(initial_balance, jnp.float32),
+        final_balance=final.balance,
+        total_trades=final.trades,
+        winning_trades=final.wins,
+        losing_trades=final.trades - final.wins,
+        total_profit=final.total_profit,
+        total_loss=final.total_loss,
+        max_drawdown=final.max_dd,
+        max_drawdown_pct=final.max_dd_pct,
+        sum_r=final.sum_r,
+        sum_r2=final.sum_r2,
+        sum_neg_r2=final.sum_neg_r2,
+        n_r=final.n_r,
+        max_win_streak=final.max_win_streak,
+        max_loss_streak=final.max_loss_streak,
+    )
+    per_symbol = {"trades": final.sym_trades, "realized_pnl": final.sym_pnl}
+    return stats, per_symbol
+
+
+@functools.partial(jax.jit, static_argnames=("use_param_sl_tp", "shared_capital",
+                                             "max_positions"))
 def portfolio_backtest(inputs: BacktestInputs, params: StrategyParams | None = None,
                        initial_balance_per_symbol: float = 10_000.0,
-                       use_param_sl_tp: bool = False):
-    """Run every symbol (leading axis of `inputs`) under one strategy; the
-    per-symbol stats come back batched, plus portfolio aggregates."""
+                       use_param_sl_tp: bool = False,
+                       shared_capital: bool = False,
+                       max_positions: int = 5):
+    """Run every symbol (leading axis of `inputs`) under one strategy.
+
+    ``shared_capital=False`` (legacy): symbols run in independent capital
+    silos via vmap — per-symbol stats batched, plus portfolio aggregates.
+    ``shared_capital=True``: symbols compete for ONE pool of
+    ``initial_balance_per_symbol × n_symbols`` (total capitalization is the
+    same in both modes, so flipping the flag compares capital models, not
+    capital amounts) under ``max_positions`` global slots
+    (`shared_capital_backtest`), matching the reference's single-pool
+    booking; per-symbol stats reduce to trade counts and realized P&L
+    (positions are not independent, so per-symbol Sharpe is not defined,
+    and the drawdown key is portfolio-level: ``max_drawdown_pct``)."""
+    if shared_capital:
+        n_symbols = inputs.close.shape[0]
+        stats, per_symbol = shared_capital_backtest(
+            inputs, params,
+            initial_balance=initial_balance_per_symbol * n_symbols,
+            max_positions=max_positions, use_param_sl_tp=use_param_sl_tp)
+        m = compute_metrics(stats)
+        portfolio = {
+            "total_initial": stats.initial_balance,
+            "total_final": stats.final_balance,
+            "total_return_pct": (stats.final_balance - stats.initial_balance)
+            / stats.initial_balance * 100.0,
+            "total_trades": stats.total_trades,
+            "mean_sharpe": m["sharpe_ratio"],
+            "max_drawdown_pct": stats.max_drawdown_pct,
+            "per_symbol_trades": per_symbol["trades"],
+            "per_symbol_realized_pnl": per_symbol["realized_pnl"],
+        }
+        return stats, m, portfolio
     stats = jax.vmap(lambda inp: run_backtest(
         inp, params, initial_balance=initial_balance_per_symbol,
         use_param_sl_tp=use_param_sl_tp))(inputs)

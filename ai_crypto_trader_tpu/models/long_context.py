@@ -23,8 +23,9 @@ from __future__ import annotations
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ai_crypto_trader_tpu.models.zoo import sinusoidal_positions
 from ai_crypto_trader_tpu.parallel.ring_attention import (
@@ -95,7 +96,17 @@ class LongContextTransformer(nn.Module):
 
 def long_context_loss(model, params, x, y):
     """Per-position MSE against next-step targets ``y: [T, 1]``; positions
-    with NaN targets (warmup / final step) are masked out."""
+    with NaN targets (warmup / final step) are masked out.
+
+    When the model is mesh-sharded, params are replicated onto the mesh
+    first: the ring path commits activations to every mesh device, and
+    eager-mode autodiff refuses to add cotangents whose placements differ
+    (mesh vs single-device params).  Replicating here keeps `jax.grad`
+    usable both eagerly and under jit."""
+    mesh = getattr(model, "mesh", None)
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        params = jax.tree.map(lambda a: jax.device_put(a, rep), params)
     pred = model.apply(params, x)["mean"]
     ok = ~jnp.isnan(y)
     err = jnp.where(ok, pred - jnp.nan_to_num(y), 0.0)

@@ -35,7 +35,9 @@ from ai_crypto_trader_tpu.backtest.engine import BacktestInputs, BacktestStats
 from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
 
 BLOCK_B = 128          # population lanes per program (f32 lane width)
-CHUNK_T = 512          # candles streamed per grid step (9 × 2 KB of SMEM)
+CHUNK_T = 1024         # candles streamed per grid step (9 × 4 KB of SMEM);
+                       # must match XLA's {0:T(1024)} tiling of 1-D f32
+                       # arrays or Mosaic rejects the operand layout
 
 # carry rows in the VMEM scratch
 (_BAL, _INPOS, _ENTRY, _QTY, _SL, _TP, _MAXEQ, _MAXDD, _MAXDDP, _TRADES,
@@ -167,26 +169,29 @@ def _make_kernel(T_true, warmup, initial_balance, conf_thr, min_strength,
 
         @pl.when(t_chunk == n_tc - 1)
         def _finish():
-            # close any remaining position at the last price ("End of Test")
+            # close any remaining position at the last price ("End of Test").
+            # Stat rows are stored one ref-row at a time with static indices
+            # (like the carry writeback in `step`) — building the block as a
+            # jnp array via .at[].set() lowers as scatter, which the Mosaic
+            # TPU pipeline rejects.
             c = {r: carry[r, :] for r in range(21)}
             c = _book_close(c, close_ref[CHUNK_T - 1], c[_INPOS] > 0.0)
-            out = jnp.zeros((_NSTAT, BLOCK_B), jnp.float32)
-            out = out.at[0, :].set(jnp.full((BLOCK_B,), initial_balance))
-            out = out.at[1, :].set(c[_BAL])
-            out = out.at[2, :].set(c[_TRADES])
-            out = out.at[3, :].set(c[_WINS])
-            out = out.at[4, :].set(c[_TRADES] - c[_WINS])
-            out = out.at[5, :].set(c[_PROFIT])
-            out = out.at[6, :].set(c[_LOSS])
-            out = out.at[7, :].set(c[_MAXDD])
-            out = out.at[8, :].set(c[_MAXDDP])
-            out = out.at[9, :].set(c[_SUMR])
-            out = out.at[10, :].set(c[_SUMR2])
-            out = out.at[11, :].set(c[_SUMNR2])
-            out = out.at[12, :].set(c[_NR])
-            out = out.at[13, :].set(c[_MWS])
-            out = out.at[14, :].set(c[_MLS])
-            out_ref[...] = out
+            out_ref[0, :] = jnp.full((BLOCK_B,), initial_balance, jnp.float32)
+            out_ref[1, :] = c[_BAL]
+            out_ref[2, :] = c[_TRADES]
+            out_ref[3, :] = c[_WINS]
+            out_ref[4, :] = c[_TRADES] - c[_WINS]
+            out_ref[5, :] = c[_PROFIT]
+            out_ref[6, :] = c[_LOSS]
+            out_ref[7, :] = c[_MAXDD]
+            out_ref[8, :] = c[_MAXDDP]
+            out_ref[9, :] = c[_SUMR]
+            out_ref[10, :] = c[_SUMR2]
+            out_ref[11, :] = c[_SUMNR2]
+            out_ref[12, :] = c[_NR]
+            out_ref[13, :] = c[_MWS]
+            out_ref[14, :] = c[_MLS]
+            out_ref[15, :] = jnp.zeros((BLOCK_B,), jnp.float32)
 
     return kernel
 

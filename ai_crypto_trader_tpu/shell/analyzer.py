@@ -99,6 +99,16 @@ class SignalAnalyzer:
                     f"{self.min_success_probability:.2f}]").strip()
         await self.bus.publish("trading_signals", signal)
         self.bus.set(f"latest_signal_{symbol}", signal)
+        # structured explanation per signal (AIExplainabilityService consumes
+        # trading_signals, `services/ai_explainability_service.py:138-354`;
+        # the dashboard's drill-down panel renders this bounded history)
+        from ai_crypto_trader_tpu.strategy.explain import explain_signal
+
+        explanation = explain_signal(signal)
+        self.bus.set(f"explanation_{symbol}", explanation)
+        history = self.bus.get("explanations") or []
+        history.append(explanation)
+        self.bus.set("explanations", history[-50:])
         return signal
 
     def _queue(self):

@@ -36,6 +36,75 @@ def _svg_line(values, width=640, height=160, color="#2a7", label=""):
             f' [{lo:.2f} … {hi:.2f}]</text></svg>')
 
 
+def _svg_heatmap(matrix, labels, cell=34, pad=70):
+    """Correlation heatmap (the reference's dashboard.py:1712 panel):
+    blue −1 … dark 0 … green +1, labels on both axes."""
+    m = np.asarray(matrix, dtype=float)
+    n = m.shape[0]
+    if n == 0 or m.shape != (n, n):
+        return "<svg/>"
+    w = h = pad + n * cell + 4
+    cells = []
+    for i in range(n):
+        for j in range(n):
+            v = float(np.clip(np.nan_to_num(m[i, j]), -1.0, 1.0))
+            if v >= 0:
+                color = f"rgb({int(20 + 20 * v)},{int(40 + 150 * v)},{int(40 + 60 * v)})"
+            else:
+                color = f"rgb({int(40 - 20 * v)},{int(60 + 20 * v)},{int(60 - 150 * v)})"
+            x, y = pad + j * cell, pad + i * cell
+            cells.append(
+                f'<rect x="{x}" y="{y}" width="{cell - 1}" height="{cell - 1}"'
+                f' fill="{color}"><title>{html.escape(str(labels[i]))} / '
+                f'{html.escape(str(labels[j]))}: {v:+.2f}</title></rect>'
+                f'<text x="{x + cell / 2:.0f}" y="{y + cell / 2 + 3:.0f}" '
+                f'fill="#ddd" font-size="9" text-anchor="middle">{v:+.2f}</text>')
+    texts = []
+    for i, lab in enumerate(labels):
+        lab = html.escape(str(lab).replace("USDC", ""))
+        texts.append(f'<text x="{pad - 6}" y="{pad + i * cell + cell / 2 + 3:.0f}"'
+                     f' fill="#999" font-size="10" text-anchor="end">{lab}</text>')
+        texts.append(f'<text x="{pad + i * cell + cell / 2:.0f}" y="{pad - 8}"'
+                     f' fill="#999" font-size="10" text-anchor="middle" '
+                     f'transform="rotate(-45 {pad + i * cell + cell / 2:.0f} '
+                     f'{pad - 8})">{lab}</text>')
+    return (f'<svg width="{w}" height="{h}" '
+            f'style="background:#111;border-radius:6px">'
+            + "".join(texts) + "".join(cells) + "</svg>")
+
+
+def _explanations_html(explanations: list) -> str:
+    """Explanation drill-down (the reference's AI-explanation modal,
+    dashboard.py:1937): a <details> disclosure per signal with the factor
+    table inside — click to drill in."""
+    items = []
+    for e in explanations[-8:][::-1]:
+        head = (f"{e.get('symbol', '?')} {e.get('decision', '?')} "
+                f"(conf {float(e.get('confidence') or 0.0):.2f})")
+        factors = e.get("factors") or e.get("factor_weights") or {}
+
+        def cell(v):
+            if isinstance(v, dict):               # explain_signal factor row
+                return (f"{v.get('value', 0):,.2f} ({v.get('reading', '')}) "
+                        f"× {v.get('weight', 0):.2f}")
+            return _fmt(v)
+
+        rows = "".join(
+            f"<tr><td>{html.escape(str(k))}</td>"
+            f"<td style='text-align:right'>{html.escape(cell(v))}</td></tr>"
+            for k, v in (factors.items() if isinstance(factors, dict)
+                         else enumerate(factors)))
+        summary = html.escape(str(e.get("narrative", ""))[:300])
+        items.append(
+            f"<details><summary>{html.escape(head)}</summary>"
+            f"<p style='color:#999;font-size:12px'>{summary}</p>"
+            f"<table>{rows}</table></details>")
+    if not items:
+        return ""
+    return ("<div class='card'><h3>AI explanations</h3>"
+            + "".join(items) + "</div>")
+
+
 def _table(rows: dict, title: str) -> str:
     body = "".join(
         f"<tr><td>{html.escape(str(k))}</td>"
@@ -79,6 +148,22 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
         if trades:
             sections.append(_table({s: f"entry {t.get('entry_price', 0):,.2f}"
                                     for s, t in trades.items()}, "Active trades"))
+        # --- reference dashboard.py parity panels ---
+        risk = bus.get("risk_metrics")
+        if risk:
+            sections.append(_table(risk, "Portfolio risk"))
+        var_hist = bus.get("var_history")
+        if var_hist and len(var_hist) >= 2:       # dashboard.py:1485
+            sections.append(_svg_line([p["var_95"] for p in var_hist],
+                                      label="VaR 95% history", color="#e66"))
+        corr = bus.get("correlation_matrix")
+        if corr and corr.get("symbols"):          # dashboard.py:1712
+            sections.append(
+                "<div class='card'><h3>Asset correlation</h3>"
+                + _svg_heatmap(corr["matrix"], corr["symbols"]) + "</div>")
+        expl = bus.get("explanations")
+        if expl:                                  # dashboard.py:1937
+            sections.append(_explanations_html(expl))
     if signals:
         rows = {f"{s.get('symbol')} @ {s.get('timestamp', 0):.0f}":
                 f"{s.get('decision')} ({s.get('confidence', 0):.2f})"

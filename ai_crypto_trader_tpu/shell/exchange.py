@@ -54,6 +54,13 @@ class ExchangeInterface(ABC):
         don't track state."""
         return True
 
+    def list_symbols(self, quote: str | None = None) -> list[str]:
+        """All tradable symbols, optionally filtered to one quote asset —
+        the discovery surface `CryptoScanner.scan_market` builds from
+        exchange info (`binance_ml_strategy.py:293-340`). Default empty for
+        adapters without discovery."""
+        return []
+
 
 class FakeExchange(ExchangeInterface):
     """Deterministic candle-replay exchange with a virtual clock.
@@ -106,6 +113,12 @@ class FakeExchange(ExchangeInterface):
         asks = [[mid + spread * i, float(s)] for i, s in zip(levels, sizes)]
         return {"symbol": symbol, "bids": bids, "asks": asks,
                 "timestamp": c["timestamp"]}
+
+    def list_symbols(self, quote: str | None = None) -> list[str]:
+        syms = sorted(self.series)
+        if quote:
+            syms = [s for s in syms if s.endswith(quote)]
+        return syms
 
     def get_klines(self, symbol: str, interval: str = "1m",
                    limit: int = 100) -> list:
@@ -242,6 +255,14 @@ class BinanceExchange(ExchangeInterface):
     def get_balances(self):
         acct = self.client.get_account()
         return {b["asset"]: float(b["free"]) for b in acct["balances"]}
+
+    def list_symbols(self, quote=None):
+        info = self.client.get_exchange_info()
+        syms = [s["symbol"] for s in info.get("symbols", [])
+                if s.get("status", "TRADING") == "TRADING"]
+        if quote:
+            syms = [s for s in syms if s.endswith(quote)]
+        return sorted(syms)
 
 
 class ExchangeUnavailable(RuntimeError):

@@ -47,6 +47,25 @@ class TradingSystem:
     # Structured JSON-lines log sink (utils/structlog.py); None → no file.
     log_path: str | None = None
 
+    @classmethod
+    def with_discovery(cls, exchange, scanner=None, **kw):
+        """Build the system on a scanner-discovered symbol universe instead
+        of a configured list — the reference's CryptoScanner feeding
+        AutoTrader (`binance_ml_strategy.py:293-468` → `auto_trader.py:601`),
+        as a construction mode: discovery runs once up front and the chosen
+        universe drives monitor/analyzer/executor."""
+        from ai_crypto_trader_tpu.shell.scanner import MarketScanner
+
+        scanner = scanner or MarketScanner(exchange)
+        symbols = scanner.top_symbols()
+        if not symbols:
+            raise ValueError(
+                "scanner found no eligible symbols (volume/volatility "
+                "filters rejected the whole universe)")
+        system = cls(exchange, symbols, **kw)
+        system.scanner = scanner
+        return system
+
     def __post_init__(self):
         from ai_crypto_trader_tpu.utils.structlog import StructuredLogger
 
@@ -170,11 +189,54 @@ class TradingSystem:
             self.log.info("trade closed", **rec)
         self._logged_closures = n_closed
 
+        self._update_risk()
         fired = await self._fire_alerts()
         if self.dashboard_path:
             self._render_dashboard()
         return {"published": published, "analyzed": analyzed,
                 "executed": executed, "alerts": len(fired)}
+
+    def _update_risk(self):
+        """Portfolio risk from live bus data (PortfolioRiskService parity,
+        `services/portfolio_risk_service.py:217-328`): equal-weight VaR /
+        CVaR over the symbols' kline returns, the cross-asset correlation
+        matrix, and a bounded VaR history — the state behind the
+        dashboard's risk, heatmap and VaR-history panels."""
+        import numpy as np
+
+        from ai_crypto_trader_tpu.risk import (
+            correlation_matrix, cvar, historical_var, parametric_var)
+
+        rets, syms = [], []
+        interval = self.monitor.intervals[0]
+        for s in self.symbols:
+            kl = self.bus.get(f"historical_data_{s}_{interval}")
+            if not kl or len(kl) < 32:
+                continue
+            close = np.asarray([row[4] for row in kl], np.float32)
+            rets.append(np.diff(close) / close[:-1])
+            syms.append(s)
+        if not rets:
+            return
+        n = min(len(r) for r in rets)
+        matrix = np.stack([r[-n:] for r in rets])
+        port = matrix.mean(axis=0)
+        risk = {
+            "var_95_pct": float(historical_var(port)) * 100.0,
+            "var_99_pct": float(historical_var(port, 0.99)) * 100.0,
+            "parametric_var_95_pct": float(parametric_var(port)) * 100.0,
+            "cvar_95_pct": float(cvar(port)) * 100.0,
+            "n_assets": len(syms),
+        }
+        self.bus.set("risk_metrics", risk)
+        self.metrics.set_gauge("portfolio_var_pct", risk["var_95_pct"])
+        if len(syms) >= 2:
+            corr = np.asarray(correlation_matrix(matrix)).tolist()
+            self.bus.set("correlation_matrix",
+                         {"symbols": syms, "matrix": corr})
+        history = self.bus.get("var_history") or []
+        history.append({"t": self.now_fn(), "var_95": risk["var_95_pct"]})
+        self.bus.set("var_history", history[-500:])
 
     def _alert_state(self) -> dict:
         """State for the rule set in utils/alerts.py default_rules —

@@ -123,8 +123,13 @@ class MarketMonitor:
                        + list(chunk[-1][6:]))
         return out
 
-    async def poll(self, force: bool = False) -> int:
-        """One monitoring pass over all symbols; returns #updates published.
+    async def poll(self, force: bool = False,
+                   symbols: list[str] | None = None) -> int:
+        """One monitoring pass; returns #updates published.
+
+        ``symbols`` narrows the pass to a subset (the push-feed path:
+        shell/stream.py marks symbols dirty and refreshes just those);
+        None = the full configured universe (the polling path).
 
         Multi-timeframe: features are computed per interval and the trend
         strength published is the reference's 0.6·primary + 0.4·secondary
@@ -132,7 +137,7 @@ class MarketMonitor:
         published = 0
         now = self.now_fn()
         base_min = self._interval_minutes(self.intervals[0])
-        for symbol in self.symbols:
+        for symbol in (symbols if symbols is not None else self.symbols):
             if not force and now - self._last_pub.get(symbol, -1e18) < self.throttle_s:
                 continue
             # fetch enough base candles to fill the secondary timeframe too

@@ -1,0 +1,150 @@
+"""Market-wide opportunity scanner: discover and rank tradable pairs.
+
+Capability parity with `CryptoScanner.scan_market`
+(`binance_ml_strategy.py:293-468`): the reference walks every exchange pair
+in a ThreadPoolExecutor(10), fetching klines and computing volatility /
+volume / signal strength per pair in Python, then ranks.  Here discovery
+stays host-side (one `list_symbols` + one klines fetch per pair through the
+injectable adapter), and ALL the per-pair math collapses into a single
+jitted pass over a dense ``[n_pairs, T]`` tensor — the indicator kernels
+broadcast over leading axes, so scanning 500 pairs costs one device
+program, not 500 thread-pool tasks.
+
+Ranking (the reference's criteria, made explicit): volatility in a tradable
+band (too-flat pairs can't clear fees, too-wild ones blow through stops —
+`scan_market` filters on `min_volatility`/`max_volatility`), quote volume
+above a floor (`min_volume`), and the technical signal strength of the last
+candle as the opportunity score tiebreaker.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.backtest import compute_signal_features, reference_signal
+from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
+
+
+@functools.partial(jax.jit, static_argnames=())
+def score_pairs(ohlcv: dict, min_quote_volume: float = 50_000.0,
+                min_volatility: float = 0.001, max_volatility: float = 0.05):
+    """One device pass over [P, T] OHLCV: per-pair volatility, quote volume,
+    last-candle signal/strength, and a composite opportunity score.
+
+    Score = strength/100 (signal quality) + volatility-band bonus + volume
+    factor, zeroed for pairs failing the hard filters — the vectorized
+    re-expression of scan_market's filter+rank."""
+    ind = ops.compute_indicators(ohlcv)
+    feats = compute_signal_features(ind)
+    signal, strength = reference_signal(feats)
+
+    vol = feats.volatility[..., -1]                    # ATR/close, last candle
+    quote_vol = jnp.mean(ohlcv["volume"] * ohlcv["close"], axis=-1)
+    strength_last = strength[..., -1]
+    signal_last = signal[..., -1]
+    ret_24h = (ohlcv["close"][..., -1] / ohlcv["close"][..., 0] - 1.0) * 100.0
+
+    in_band = (vol >= min_volatility) & (vol <= max_volatility)
+    liquid = quote_vol >= min_quote_volume
+    volume_factor = jnp.minimum(quote_vol / (10.0 * min_quote_volume), 1.0)
+    # center-of-band volatility scores highest
+    band_mid = (min_volatility + max_volatility) / 2.0
+    band_half = (max_volatility - min_volatility) / 2.0
+    vol_score = 1.0 - jnp.abs(vol - band_mid) / band_half
+
+    score = (strength_last / 100.0 + vol_score + volume_factor)
+    score = jnp.where(in_band & liquid, score, 0.0)
+    return {
+        "score": score,
+        "volatility": vol,
+        "quote_volume": quote_vol,
+        "strength": strength_last,
+        "signal": signal_last,
+        "change_pct": ret_24h,
+        "eligible": in_band & liquid,
+    }
+
+
+@dataclass
+class MarketScanner:
+    """Host-side discovery + device-side ranking.
+
+    The symbol universe stops being a config constant: `scan()` discovers
+    all pairs for the quote asset, scores them in one jitted pass, and
+    returns the top-k as opportunity dicts the monitor/launcher can adopt
+    as their trading universe."""
+
+    exchange: ExchangeInterface
+    quote: str = "USDC"
+    interval: str = "1m"
+    lookback: int = 256
+    min_quote_volume: float = 50_000.0
+    min_volatility: float = 0.001
+    max_volatility: float = 0.05
+    top_k: int = 10
+    last_scan: list = field(default_factory=list)
+
+    def discover(self) -> list[str]:
+        return self.exchange.list_symbols(quote=self.quote)
+
+    def scan(self, symbols: list[str] | None = None) -> list[dict]:
+        symbols = symbols if symbols is not None else self.discover()
+        if not symbols:
+            self.last_scan = []
+            return []
+
+        cols = {k: [] for k in ("open", "high", "low", "close", "volume")}
+        kept = []
+        for sym in symbols:
+            # one klines call per pair is the whole per-pair I/O budget
+            # (the reference's scan_market makes several calls per pair)
+            try:
+                rows = self.exchange.get_klines(sym, interval=self.interval,
+                                                limit=self.lookback)
+            except Exception:
+                continue
+            if len(rows) < 2:
+                continue
+            arr = np.asarray(rows, np.float64)[:, 1:6].astype(np.float32)
+            if len(arr) < self.lookback:      # left-pad flat (no fake moves)
+                pad = np.repeat(arr[:1], self.lookback - len(arr), axis=0)
+                arr = np.concatenate([pad, arr])
+            for j, k in enumerate(("open", "high", "low", "close", "volume")):
+                cols[k].append(arr[:, j])
+            kept.append(sym)
+        if not kept:
+            self.last_scan = []
+            return []
+
+        batch = {k: jnp.asarray(np.stack(v)) for k, v in cols.items()}
+        out = score_pairs(batch, min_quote_volume=self.min_quote_volume,
+                          min_volatility=self.min_volatility,
+                          max_volatility=self.max_volatility)
+        out = {k: np.asarray(v) for k, v in out.items()}
+        order = np.argsort(-out["score"])
+        ranked = []
+        for i in order[: self.top_k]:
+            if not out["eligible"][i]:
+                continue
+            ranked.append({
+                "symbol": kept[i],
+                "score": float(out["score"][i]),
+                "volatility": float(out["volatility"][i]),
+                "quote_volume": float(out["quote_volume"][i]),
+                "strength": float(out["strength"][i]),
+                "signal": int(out["signal"][i]),
+                "change_pct": float(out["change_pct"][i]),
+            })
+        self.last_scan = ranked
+        return ranked
+
+    def top_symbols(self, symbols: list[str] | None = None) -> list[str]:
+        """The discovered trading universe — what the launcher/monitor use
+        instead of a configured symbol list."""
+        return [o["symbol"] for o in self.scan(symbols)]

@@ -1,0 +1,145 @@
+"""Push-fed market ingestion: the WebSocket seam the live loop rides.
+
+Capability parity with the reference's push path — the Binance
+`!miniTicker@arr` stream handled by `services/market_monitor_service.py:615`
+(per-symbol 5 s throttle → pending set → batches of 5) and
+`auto_trader.py:33-123` (ThreadedWebsocketManager miniTicker → volume
+filter → opportunity queue).  The polling monitor stays the fallback; this
+module makes the live loop latency-bound on the exchange's push feed, not
+on a poll interval (<100 ms update target, `trading_strategy.md`).
+
+Design: a *frame source* is any async iterator yielding raw frame strings —
+the transport seam, exactly like data/fetchers.py's injectable transport.
+`MarketStream` consumes frames, applies the throttle/filter, marks symbols
+dirty, and drains them in batches through `MarketMonitor.poll(symbols=…)`
+(klines + indicators + publication ride the existing, tested path; the
+stream only decides WHICH symbols refresh and WHEN — the same division of
+labor as the reference's handler).  Tests inject recorded miniTicker
+frames; zero egress.  `BinanceStreamSource` is the real-network source,
+gated on an installed websocket client library.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator
+
+BINANCE_WS = "wss://stream.binance.com:9443/ws/!miniTicker@arr"
+
+
+@dataclass
+class MarketStream:
+    """miniTicker frames → throttled dirty-set → batched monitor refresh."""
+
+    monitor: "MarketMonitor"                     # noqa: F821 (shell.monitor)
+    min_quote_volume: float = 0.0                # auto_trader.py:78-88 filter
+    throttle_s: float = 5.0                      # market_monitor_service.py:374
+    batch_size: int = 5                          # :403 batch cadence
+    now_fn: any = time.time
+    restrict_to_universe: bool = True            # ignore unconfigured symbols
+    _last_seen: dict = field(default_factory=dict)
+    _pending: list = field(default_factory=list)
+    frames_in: int = 0
+    ticks_in: int = 0
+
+    def ingest_frame(self, frame: str) -> list[str]:
+        """Parse one raw frame; returns the symbols newly marked dirty.
+
+        A miniTicker-array frame is a JSON list of per-symbol dicts
+        (`s` symbol, `c` close, `q` 24 h quote volume …). Malformed frames
+        are dropped (the reference's handler logs and continues)."""
+        self.frames_in += 1
+        try:
+            tickers = json.loads(frame)
+        except (json.JSONDecodeError, TypeError):
+            return []
+        if isinstance(tickers, dict):            # combined-stream envelope
+            tickers = tickers.get("data", [])
+        if not isinstance(tickers, list):
+            return []
+        now = self.now_fn()
+        universe = set(self.monitor.symbols) if self.restrict_to_universe \
+            else None
+        marked = []
+        for t in tickers:
+            try:
+                symbol = t["s"]
+                price = float(t["c"])
+                quote_vol = float(t.get("q", 0.0))
+            except (KeyError, TypeError, ValueError):
+                continue
+            self.ticks_in += 1
+            if universe is not None and symbol not in universe:
+                continue
+            if quote_vol < self.min_quote_volume:
+                continue
+            # push the raw tick immediately (executor SL/TP checks ride
+            # sub-candle prices, auto_trader.py:288-316)
+            self.monitor.bus.set(f"ticker_{symbol}", {
+                "symbol": symbol, "price": price, "quote_volume": quote_vol,
+                "timestamp": now,
+            })
+            if now - self._last_seen.get(symbol, -1e18) < self.throttle_s:
+                continue
+            self._last_seen[symbol] = now
+            if symbol not in self._pending:
+                self._pending.append(symbol)
+                marked.append(symbol)
+        return marked
+
+    async def drain(self) -> int:
+        """Refresh up to ``batch_size`` dirty symbols through the monitor
+        (klines fetch + indicators + market_updates publication)."""
+        if not self._pending:
+            return 0
+        batch, self._pending = (self._pending[: self.batch_size],
+                                self._pending[self.batch_size:])
+        return await self.monitor.poll(force=True, symbols=batch)
+
+    async def run(self, frames: AsyncIterator[str]) -> int:
+        """Consume a frame source to exhaustion (or cancellation); returns
+        the number of updates published."""
+        published = 0
+        async for frame in frames:
+            self.ingest_frame(frame)
+            published += await self.drain()
+        while self._pending:
+            published += await self.drain()
+        return published
+
+
+async def replay_frames(frames: list[str], *,
+                        delay_s: float = 0.0) -> AsyncIterator[str]:
+    """Recorded-frame source for tests/paper mode (zero egress)."""
+    for f in frames:
+        if delay_s:
+            await asyncio.sleep(delay_s)
+        yield f
+
+
+class BinanceStreamSource:
+    """Real-network frame source (used live, not in tests).
+
+    Requires a websocket client library; this environment ships none, so
+    construction degrades with a clear message — the seam mirrors
+    BinanceExchange's injected-client gate."""
+
+    def __init__(self, url: str = BINANCE_WS):
+        try:
+            import websockets  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "BinanceStreamSource needs the 'websockets' package (not "
+                "installed here). Inject recorded frames via replay_frames "
+                "or any async iterator of frame strings instead.") from e
+        self.url = url
+
+    async def __aiter__(self):
+        import websockets
+
+        async with websockets.connect(self.url) as ws:
+            async for frame in ws:
+                yield frame

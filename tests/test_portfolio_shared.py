@@ -1,0 +1,202 @@
+"""Golden parity: the shared-capital portfolio replay vs a scalar Python
+oracle of its contract — one balance, a global max_positions cap, symbols
+processed in ascending index order within each candle (the semantics the
+reference books through `backtesting/strategy_tester.py:225,314-369` and
+config.json trading_params.max_positions)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.backtest import (
+    compute_metrics,
+    default_params,
+    portfolio_backtest,
+    prepare_inputs,
+    shared_capital_backtest,
+)
+from test_backtest_parity import python_position_size
+
+
+# ---------------------------------------------------------------------------
+# Scalar oracle (the contract in shared_capital_backtest's docstring)
+# ---------------------------------------------------------------------------
+
+def python_shared_backtest(close, signal, strength, vol, volume, conf,
+                           decision, sl_series, tp_series,
+                           initial=10_000.0, max_positions=5, warmup=10,
+                           thresh=0.7, min_strength=70.0,
+                           param_sl=None, param_tp=None):
+    S, T = close.shape
+    balance = initial
+    in_pos = [False] * S
+    entry = [0.0] * S
+    qty = [0.0] * S
+    sl = [0.0] * S
+    tp = [0.0] * S
+    max_eq, max_dd, max_dd_pct = initial, 0.0, 0.0
+    trades = wins = 0
+    tot_p = tot_l = 0.0
+    returns = [0.0]
+    cw = cl = mw = ml = 0
+    sym_trades = [0] * S
+    sym_pnl = [0.0] * S
+
+    def close_pos(s, price):
+        nonlocal balance, trades, wins, tot_p, tot_l, cw, cl, mw, ml
+        pnl = (price - entry[s]) * qty[s]
+        balance += pnl
+        trades += 1
+        sym_trades[s] += 1
+        sym_pnl[s] += pnl
+        if pnl > 0:
+            wins += 1
+            tot_p += pnl
+            cw += 1; cl = 0
+        else:
+            tot_l -= pnl
+            cl += 1; cw = 0
+        mw, ml = max(mw, cw), max(ml, cl)
+        in_pos[s] = False
+
+    for t in range(T):
+        if t < warmup:
+            continue
+        prev = balance
+        for s in range(S):
+            price = float(close[s, t])
+            if in_pos[s]:
+                pnl_pct = (price - entry[s]) / entry[s] * 100.0
+                if pnl_pct <= -sl[s] or pnl_pct >= tp[s]:
+                    close_pos(s, price)
+            n_open = sum(in_pos)
+            if (not in_pos[s] and n_open < max_positions
+                    and conf[s, t] >= thresh and strength[s, t] >= min_strength
+                    and signal[s, t] == decision[s, t]
+                    and decision[s, t] == 1):
+                size, sl_frac, tp_frac = python_position_size(
+                    balance, float(vol[s, t]), float(volume[s, t]))
+                entry[s], qty[s] = price, size / price
+                if param_sl is not None:
+                    sl[s], tp[s] = param_sl, param_tp
+                else:
+                    sl[s], tp[s] = sl_frac * 100.0, tp_frac * 100.0
+                if not np.isnan(sl_series[s, t]):
+                    sl[s] = float(sl_series[s, t])
+                if not np.isnan(tp_series[s, t]):
+                    tp[s] = float(tp_series[s, t])
+                in_pos[s] = True
+        returns.append((balance - prev) / prev)
+        if balance > max_eq:
+            max_eq = balance
+        dd = max_eq - balance
+        if dd > max_dd:
+            max_dd, max_dd_pct = dd, dd / max_eq * 100.0
+    for s in range(S):
+        if in_pos[s]:
+            close_pos(s, float(close[s, -1]))
+
+    return dict(final_balance=balance, total_trades=trades,
+                winning_trades=wins, total_profit=tot_p, total_loss=tot_l,
+                max_drawdown=max_dd, max_drawdown_pct=max_dd_pct,
+                n_r=len(returns), max_win_streak=mw, max_loss_streak=ml,
+                sym_trades=sym_trades, sym_pnl=sym_pnl)
+
+
+def _multi_inputs(n_symbols=4, n=700):
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+
+    per = []
+    for s in range(n_symbols):
+        d = generate_ohlcv(n=n, seed=100 + s)
+        arrays = {k: jnp.asarray(v) for k, v in d.items() if k != "regime"}
+        per.append(prepare_inputs(ops.compute_indicators(arrays)))
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+
+
+@pytest.fixture(scope="module")
+def minputs():
+    return _multi_inputs()
+
+
+class TestSharedCapitalParity:
+    def test_vs_python_oracle(self, minputs):
+        args = [np.asarray(x) for x in minputs]
+        oracle = python_shared_backtest(*args)
+        assert oracle["total_trades"] > 0, "test vectors must actually trade"
+        stats, per_symbol = shared_capital_backtest(minputs)
+        assert int(stats.total_trades) == oracle["total_trades"]
+        assert int(stats.winning_trades) == oracle["winning_trades"]
+        assert int(stats.n_r) == oracle["n_r"]
+        np.testing.assert_allclose(float(stats.final_balance),
+                                   oracle["final_balance"], rtol=1e-4)
+        np.testing.assert_allclose(float(stats.total_profit),
+                                   oracle["total_profit"], rtol=1e-3, atol=1e-2)
+        np.testing.assert_allclose(float(stats.total_loss),
+                                   oracle["total_loss"], rtol=1e-3, atol=1e-2)
+        np.testing.assert_allclose(float(stats.max_drawdown),
+                                   oracle["max_drawdown"], rtol=1e-3, atol=1e-2)
+        assert int(stats.max_win_streak) == oracle["max_win_streak"]
+        assert int(stats.max_loss_streak) == oracle["max_loss_streak"]
+        np.testing.assert_array_equal(np.asarray(per_symbol["trades"]),
+                                      oracle["sym_trades"])
+        np.testing.assert_allclose(np.asarray(per_symbol["realized_pnl"]),
+                                   oracle["sym_pnl"], rtol=1e-3, atol=1e-2)
+
+    def test_param_sl_tp_mode(self, minputs):
+        p = default_params()
+        args = [np.asarray(x) for x in minputs]
+        oracle = python_shared_backtest(
+            *args, param_sl=float(p.stop_loss), param_tp=float(p.take_profit))
+        stats, _ = shared_capital_backtest(minputs, p, use_param_sl_tp=True)
+        assert int(stats.total_trades) == oracle["total_trades"]
+        np.testing.assert_allclose(float(stats.final_balance),
+                                   oracle["final_balance"], rtol=1e-4)
+
+    def test_position_cap_binds(self, minputs):
+        """max_positions=1 must strictly reduce (or equal) trade count and
+        change capital dynamics vs an uncapped run."""
+        capped, _ = shared_capital_backtest(minputs, max_positions=1)
+        S = minputs.close.shape[0]
+        open_cap, _ = shared_capital_backtest(minputs, max_positions=S)
+        assert int(capped.total_trades) <= int(open_cap.total_trades)
+        args = [np.asarray(x) for x in minputs]
+        oracle = python_shared_backtest(*args, max_positions=1)
+        assert int(capped.total_trades) == oracle["total_trades"]
+        np.testing.assert_allclose(float(capped.final_balance),
+                                   oracle["final_balance"], rtol=1e-4)
+
+    def test_capital_contention_differs_from_silos(self, minputs):
+        """Shared pool ≠ independent silos: same TOTAL capitalization
+        (portfolio_backtest scales the shared pool to per_symbol × S), but
+        the capital models differ so the final balances must too."""
+        silo_stats, _, _ = portfolio_backtest(
+            minputs, initial_balance_per_symbol=2_500.0)
+        shared, _, shared_port = portfolio_backtest(
+            minputs, initial_balance_per_symbol=2_500.0, shared_capital=True)
+        assert float(shared.initial_balance) == 10_000.0   # 2_500 × 4
+        silo_total = float(jnp.sum(silo_stats.final_balance))
+        assert abs(silo_total - float(shared.final_balance)) > 1e-3
+
+    def test_vmap_over_population(self, minputs):
+        from ai_crypto_trader_tpu.backtest import sample_params
+
+        pop = sample_params(jax.random.PRNGKey(7), 4)
+        fn = jax.vmap(lambda p: shared_capital_backtest(
+            minputs, p, use_param_sl_tp=True)[0].final_balance)
+        fb = fn(pop)
+        assert fb.shape == (4,)
+        single, _ = shared_capital_backtest(
+            minputs, jax.tree.map(lambda x: x[2], pop), use_param_sl_tp=True)
+        np.testing.assert_allclose(float(fb[2]), float(single.final_balance),
+                                   rtol=1e-5)
+
+    def test_metrics_pipeline(self, minputs):
+        stats, _, port = portfolio_backtest(minputs, shared_capital=True)
+        m = compute_metrics(stats)
+        assert np.isfinite(float(m["sharpe_ratio"]))
+        assert float(port["total_final"]) == pytest.approx(
+            float(stats.final_balance))

@@ -1,0 +1,111 @@
+"""Market scanner: discovery + one-pass vectorized ranking over a 50+ pair
+fake universe (CryptoScanner.scan_market parity,
+`binance_ml_strategy.py:293-468` — the reference walks pairs in a
+ThreadPoolExecutor; here the whole universe is one [P, T] device pass)."""
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.data.ingest import from_dict
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+from ai_crypto_trader_tpu.shell.scanner import MarketScanner
+
+N_PAIRS = 56
+LOOKBACK = 192
+
+
+def _universe(n_pairs=N_PAIRS, n_hist=LOOKBACK + 8):
+    series = {}
+    for i in range(n_pairs):
+        sym = f"A{i:03d}USDC"
+        d = generate_ohlcv(n=n_hist, seed=500 + i, s0=100.0 * (1 + i),
+                           base_vol=0.0004 * (1 + (i % 9)),
+                           base_volume=40.0 * (1 + (i % 13)))
+        series[sym] = from_dict(
+            {k: v for k, v in d.items() if k != "regime"}, symbol=sym)
+    # one illiquid dust pair that must be filtered out
+    d = generate_ohlcv(n=n_hist, seed=999, s0=0.001, base_volume=0.0001)
+    series["DUSTUSDC"] = from_dict(
+        {k: v for k, v in d.items() if k != "regime"}, symbol="DUSTUSDC")
+    # one pair on a different quote asset — excluded by discovery
+    d = generate_ohlcv(n=n_hist, seed=998)
+    series["ETHBTC"] = from_dict(
+        {k: v for k, v in d.items() if k != "regime"}, symbol="ETHBTC")
+    ex = FakeExchange(series)
+    ex.advance(steps=n_hist)
+    return ex
+
+
+@pytest.fixture(scope="module")
+def exchange():
+    return _universe()
+
+
+class TestDiscovery:
+    def test_quote_filter(self, exchange):
+        sc = MarketScanner(exchange, quote="USDC", lookback=LOOKBACK)
+        syms = sc.discover()
+        assert len(syms) == N_PAIRS + 1          # dust included, ETHBTC not
+        assert "ETHBTC" not in syms
+        assert all(s.endswith("USDC") for s in syms)
+
+    def test_list_symbols_unfiltered(self, exchange):
+        assert "ETHBTC" in exchange.list_symbols()
+
+
+class TestRanking:
+    def test_scan_ranks_and_filters(self, exchange):
+        sc = MarketScanner(exchange, lookback=LOOKBACK, top_k=10)
+        ranked = sc.scan()
+        assert 0 < len(ranked) <= 10
+        scores = [o["score"] for o in ranked]
+        assert scores == sorted(scores, reverse=True)
+        assert all(o["symbol"] != "DUSTUSDC" for o in ranked)
+        assert all(o["quote_volume"] >= sc.min_quote_volume for o in ranked)
+        assert all(sc.min_volatility <= o["volatility"] <= sc.max_volatility
+                   for o in ranked)
+
+    def test_top_symbols_feed_launcher(self, exchange):
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        system = TradingSystem.with_discovery(
+            exchange, scanner=MarketScanner(exchange, lookback=LOOKBACK,
+                                            top_k=3))
+        assert 0 < len(system.symbols) <= 3
+        assert all(s.endswith("USDC") for s in system.symbols)
+        assert system.scanner.last_scan  # discovery result retained
+
+    def test_explicit_symbol_subset(self, exchange):
+        sc = MarketScanner(exchange, lookback=LOOKBACK, top_k=50)
+        subset = ["A000USDC", "A001USDC", "A002USDC"]
+        ranked = sc.scan(subset)
+        assert set(o["symbol"] for o in ranked) <= set(subset)
+
+    def test_empty_universe(self):
+        ex = FakeExchange({})
+        sc = MarketScanner(ex)
+        assert sc.scan() == []
+        assert sc.top_symbols() == []
+
+
+class TestScoreSemantics:
+    def test_score_pairs_vectorized_matches_scalar(self, exchange):
+        """Scoring P pairs in one pass == scoring each pair alone."""
+        import jax.numpy as jnp
+
+        from ai_crypto_trader_tpu.shell.scanner import score_pairs
+
+        syms = ["A003USDC", "A007USDC", "A011USDC"]
+        cols = {k: [] for k in ("open", "high", "low", "close", "volume")}
+        for s in syms:
+            rows = np.asarray(exchange.get_klines(s, limit=LOOKBACK),
+                              np.float64)[:, 1:6].astype(np.float32)
+            for j, k in enumerate(cols):
+                cols[k].append(rows[:, j])
+        batch = {k: jnp.asarray(np.stack(v)) for k, v in cols.items()}
+        joint = score_pairs(batch)
+        for i in range(len(syms)):
+            solo = score_pairs({k: v[i] for k, v in batch.items()})
+            np.testing.assert_allclose(float(joint["score"][i]),
+                                       float(solo["score"]), rtol=1e-5)
