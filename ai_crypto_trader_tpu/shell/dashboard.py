@@ -149,6 +149,16 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
             sections.append(_table({s: f"entry {t.get('entry_price', 0):,.2f}"
                                     for s, t in trades.items()}, "Active trades"))
         # --- reference dashboard.py parity panels ---
+        pv = bus.get("portfolio_value_history")
+        if pv and len(pv) >= 2:                   # portfolio value chart
+            sections.append(_svg_line([p["value"] for p in pv],
+                                      label="portfolio value", color="#fa4"))
+        live_regime = bus.get("market_regime")
+        if (not regime and live_regime            # regime panel (skip when a
+                and isinstance(live_regime, dict)):  # snapshot was passed in)
+            sections.append(_table(
+                {k: v for k, v in live_regime.items()
+                 if isinstance(v, (int, float, str))}, "Market regime"))
         risk = bus.get("risk_metrics")
         if risk:
             sections.append(_table(risk, "Portfolio risk"))

@@ -153,6 +153,11 @@ class TradingSystem:
             if md and balances.get(base):
                 total += balances[base] * md["current_price"]
         self.metrics.set_gauge("portfolio_value_usd", total)
+        # bounded portfolio-value history: the dashboard's main time-series
+        # panel (reference dashboard.py portfolio chart)
+        pv = self.bus.get("portfolio_value_history") or []
+        pv.append({"t": self.now_fn(), "value": total})
+        self.bus.set("portfolio_value_history", pv[-500:])
         self.metrics.set_gauge("open_positions", len(self.executor.active_trades))
         # the series the Grafana system-overview dashboard panels query
         # (monitoring/grafana/provisioning/dashboards/system_overview.json)

@@ -68,6 +68,9 @@ def test_panels_render_in_live_page():
     assert "AI explanations" in page          # drill-down (:1937)
     assert "<details>" in page                # the modal analog
     assert "Portfolio risk" in page
+    assert "portfolio value" in page          # value time-series panel
+    hist = system.bus.get("portfolio_value_history")
+    assert len(hist) == 3 and all("value" in p for p in hist)
 
 
 def test_render_tolerates_missing_panels():
