@@ -8,7 +8,9 @@ from ai_crypto_trader_tpu.shell.exchange import (  # noqa: F401
 )
 from ai_crypto_trader_tpu.shell.llm import (  # noqa: F401
     LLMTrader,
+    OpenAIBackend,
     TechnicalPolicyBackend,
+    UrllibPostTransport,
 )
 from ai_crypto_trader_tpu.shell.monitor import MarketMonitor  # noqa: F401
 from ai_crypto_trader_tpu.shell.analyzer import SignalAnalyzer  # noqa: F401

@@ -157,12 +157,12 @@ class StrategyEvolver:
             "param_ranges": {k: r[:2] for k, r in PARAM_RANGES.items()},
             **market_summary,
         }
-        raw = self.llm.backend.complete(
-            "Propose improved strategy parameters as JSON under key "
-            "'params'.\nMARKET_DATA:" + json.dumps(prompt_ctx))
         try:
+            raw = await self.llm.complete(
+                "Propose improved strategy parameters as JSON under key "
+                "'params'.\nMARKET_DATA:" + json.dumps(prompt_ctx))
             proposed = json.loads(raw).get("params", {})
-        except (json.JSONDecodeError, AttributeError):
+        except Exception:                # noqa: BLE001 — degrade, never die
             proposed = {}
         d = current._asdict()
         for k, v in proposed.items():
