@@ -173,7 +173,7 @@ def _book_close(s: CarryState, price, do_close):
 @functools.partial(
     jax.jit,
     static_argnames=("warmup", "reference_quirks", "use_param_sl_tp",
-                     "return_curve", "unroll"),
+                     "return_curve", "unroll", "sell_exits"),
 )
 def run_backtest(
     inputs: BacktestInputs,
@@ -186,12 +186,15 @@ def run_backtest(
     use_param_sl_tp: bool = False,
     return_curve: bool = False,
     unroll: int = 8,
+    sell_exits: bool = False,
 ):
     """Run one full backtest as a single compiled scan.
 
     With ``use_param_sl_tp`` the evolvable StrategyParams stop_loss /
     take_profit (percent) override the PositionSizer's volatility ladder —
-    this is the mode GA evolution drives.  Batched axes broadcast: vmap this
+    this is the mode GA evolution drives.  ``sell_exits`` adds an explicit
+    SELL-signal close on top of SL/TP (off by default: the reference replay
+    is long-only with SL/TP-only exits).  Batched axes broadcast: vmap this
     function over params and/or inputs for population/symbol sweeps.
     """
     T = inputs.close.shape[-1]
@@ -208,12 +211,20 @@ def run_backtest(
         pnl_pct = (close - s.entry) / entry_safe * 100.0
         hit_sl = active & s.in_pos & (pnl_pct <= -s.sl)
         hit_tp = active & s.in_pos & ~hit_sl & (pnl_pct >= s.tp)
+        # Optional signal-exit: an explicit SELL closes the open position
+        # (the live executor's sell-condition close, not part of the
+        # reference backtester's SL/TP-only replay — off by default so the
+        # parity contract is untouched; structure-generated strategies turn
+        # it on so their sell thresholds are a live search dimension).
+        hit_sell = (active & s.in_pos & ~hit_sl & ~hit_tp
+                    & (signal == sig.SELL)) if sell_exits else jnp.asarray(False)
+        closing = hit_sl | hit_tp | hit_sell
         # A position that survives the candle short-circuits the rest of the
         # loop body (`if symbol in open_positions: continue`,
         # strategy_tester.py:221-222): no entry attempt, and — reference
         # semantics — no equity point / drawdown / return observation.
-        survived = s.in_pos & ~(hit_sl | hit_tp)
-        s = _book_close(s, close, hit_sl | hit_tp)
+        survived = s.in_pos & ~closing
+        s = _book_close(s, close, closing)
 
         # --- entry gate (strategy_tester.py:221-277, 371-401) ---
         gate = (

@@ -209,6 +209,37 @@ def cmd_evolve(args):
                                       best._asdict().items()}}, indent=2))
 
 
+def cmd_generate(args):
+    """Strategy-structure generation (`ai_strategy_evaluator.py:732`):
+    search rule compositions with real CV backtests, register improvements,
+    report the held-out comparison."""
+    import asyncio
+
+    from ai_crypto_trader_tpu.strategy.generator import StrategyGenerator
+    from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+    d = _load_or_generate(args.symbol, args.days * 1440, args.seed)
+    reg = ModelRegistry(path=args.registry)
+    gen = StrategyGenerator(registry=reg, cv_folds=args.folds,
+                            pool_size=args.pool, max_rounds=args.rounds,
+                            seed=args.seed)
+    out = asyncio.run(gen.generate(d))
+
+    def finite(x):
+        # -inf marks a never-trading structure (generator sentinel);
+        # json.dumps would print invalid `-Infinity` for it
+        return float(x) if np.isfinite(x) else None
+
+    print(json.dumps({
+        "best_structure": out["structure"].to_payload(),
+        "cv_sharpe": finite(out["cv_sharpe"]),
+        "seed_cv_sharpe": finite(out["seed_cv_sharpe"]),
+        "holdout_sharpe_seed": finite(out["holdout_sharpe_seed"]),
+        "holdout_sharpe_best": finite(out["holdout_sharpe_best"]),
+        "versions": out["versions"], "rounds": out["rounds"],
+    }, indent=2))
+
+
 def cmd_mc(args):
     import jax
 
@@ -395,6 +426,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--population", type=int, default=20)
     sp.add_argument("--generations", type=int, default=10)
     sp.set_defaults(fn=cmd_evolve)
+    sp = sub.add_parser("generate",
+                        help="generate strategy structures (real-CV search)")
+    sp.add_argument("--folds", type=int, default=3)
+    sp.add_argument("--pool", type=int, default=16)
+    sp.add_argument("--rounds", type=int, default=6)
+    sp.add_argument("--registry", default="registry.json")
+    common(sp); sp.set_defaults(fn=cmd_generate)
     sp = sub.add_parser("mc", help="Monte-Carlo risk simulation")
     common(sp)
     sp.add_argument("--paths", type=int, default=10_000)

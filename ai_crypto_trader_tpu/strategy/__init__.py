@@ -10,6 +10,10 @@ from ai_crypto_trader_tpu.strategy.selection import StrategySelector  # noqa: F4
 from ai_crypto_trader_tpu.strategy.evolution import StrategyEvolver  # noqa: F401
 from ai_crypto_trader_tpu.strategy.registry import ModelRegistry  # noqa: F401
 from ai_crypto_trader_tpu.strategy.explain import explain_signal  # noqa: F401
+from ai_crypto_trader_tpu.strategy.generator import (  # noqa: F401
+    StrategyGenerator,
+    StrategyStructure,
+)
 from ai_crypto_trader_tpu.strategy.grid import GridTrader  # noqa: F401
 from ai_crypto_trader_tpu.strategy.dca import DCAStrategy  # noqa: F401
 from ai_crypto_trader_tpu.strategy.arbitrage import (  # noqa: F401

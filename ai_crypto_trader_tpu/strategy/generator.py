@@ -1,0 +1,418 @@
+"""Strategy-STRUCTURE generation: rule compositions searched, scored by
+real backtests, registered, and iterated until improvement stalls.
+
+Capability parity with the reference's strategy-code generation loop:
+`services/ai_strategy_evaluator.py:732-1360` (GPT generate → evaluate code
+quality → CV performance → improvement suggestions → apply) and
+`services/strategy_evolution_service.py:1402-1569` (GPT codegen of
+Cloudflare-Worker JS strategies + simulated deploy).  The reference asks an
+LLM for executable JS and "deploys" it without ever running it against data;
+here a strategy structure is a declarative rule graph — WHICH of the 15
+combination indicators participate (`ops/combinations.py`), their weights,
+entry/exit thresholds, and exit levels — rendered to a compiled JAX program
+and scored by the REAL scan engine on time-ordered CV folds, with a
+held-out tail segment the search never sees.
+
+Two candidate sources feed one evaluation path:
+  * LLMStructureProposer — prompts the pluggable LLM backend (shell/llm.py)
+    with the rule vocabulary + current best + its CV record, parses JSON
+    structure proposals (invalid rules dropped, values clamped);
+  * deterministic structure mutation — add/drop/swap a rule, jitter
+    weights/thresholds/exits (the search that works with zero egress).
+
+All candidates in a round evaluate as ONE vmapped program per fold: a
+structure lowers to a dense weight vector over the 15-rule vocabulary
+(weight 0 ⇔ rule absent), so ragged rule sets become a static-shape batch
+— the TPU-first inversion of the reference's one-GPT-call-per-candidate
+sequential loop.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.backtest import signals as sig
+from ai_crypto_trader_tpu.backtest.engine import (
+    BacktestInputs, run_backtest)
+from ai_crypto_trader_tpu.backtest.metrics import compute_metrics
+from ai_crypto_trader_tpu.ops.combinations import combined_indicators
+
+# The rule vocabulary — the 15 combination-score families
+# (`services/utils/indicator_combinations.py`, re-expressed in
+# ops/combinations.py). Directional scores ∈ [-1, 1]; probability-style
+# scores are centered before blending (see _SCORE_CENTER).
+RULE_NAMES = (
+    "trend_confirmation", "momentum_trend_alignment",
+    "triple_moving_average", "volatility_adjusted_momentum",
+    "volatility_trend_score", "oscillator_consensus", "stoch_rsi",
+    "double_rsi", "volume_weighted_price_momentum",
+    "volume_price_confirmation", "trend_strength_index",
+    "market_regime_indicator", "reversal_probability",
+    "breakout_confirmation", "divergence_detector",
+)
+# [0,1] probability-style scores get centered to [-0.5, 0.5] so a weight on
+# them biases toward mean-reversion strength rather than a constant offset.
+_CENTERED = {"trend_strength_index", "reversal_probability"}
+
+_BOUNDS = {
+    "weight": (-2.0, 2.0),
+    "buy_threshold": (0.05, 0.9),
+    "sell_threshold": (0.05, 0.9),
+    "stop_loss": (0.5, 10.0),
+    "take_profit": (0.5, 20.0),
+}
+
+
+@dataclass(frozen=True)
+class StrategyStructure:
+    """A declarative strategy: active rules + weights + thresholds + exits.
+
+    The structure IS the genome the reference's codegen loop mutates as JS
+    source; keeping it declarative makes every candidate compilable,
+    versionable (registry payload), and batchable."""
+
+    rules: tuple[tuple[str, float], ...]
+    buy_threshold: float = 0.3
+    sell_threshold: float = 0.3
+    stop_loss: float = 2.0
+    take_profit: float = 4.0
+    name: str = "generated"
+
+    def to_payload(self) -> dict:
+        # the payload is the IDENTITY of the structure (registry dedup
+        # compares payloads, registry.py:60-66) — the generated name is
+        # provenance, carried in registry metadata instead, so two runs
+        # producing the same structure dedup to one version
+        return {"rules": {n: round(float(w), 4) for n, w in self.rules},
+                "buy_threshold": round(float(self.buy_threshold), 4),
+                "sell_threshold": round(float(self.sell_threshold), 4),
+                "stop_loss": round(float(self.stop_loss), 4),
+                "take_profit": round(float(self.take_profit), 4)}
+
+    @classmethod
+    def from_payload(cls, payload: dict, name: str = "generated"
+                     ) -> "StrategyStructure | None":
+        """Validation mirroring the reference's code-quality gate
+        (`ai_strategy_evaluator.py`'s evaluate-before-accept): unknown rules
+        are dropped, numerics clamped, an empty rule set is rejected."""
+        raw = payload.get("rules", {})
+        if isinstance(raw, list):      # tolerate [{"name":…,"weight":…}]
+            raw = {r.get("name"): r.get("weight", 1.0)
+                   for r in raw if isinstance(r, dict)}
+        if not isinstance(raw, dict):
+            return None
+        rules = []
+        for n, w in raw.items():
+            if n in RULE_NAMES and isinstance(w, (int, float)) and w == w:
+                lo, hi = _BOUNDS["weight"]
+                rules.append((n, float(np.clip(w, lo, hi))))
+        if not rules:
+            return None
+
+        def num(key, default):
+            v = payload.get(key, default)
+            if not isinstance(v, (int, float)) or v != v:
+                v = default
+            lo, hi = _BOUNDS[key]
+            return float(np.clip(v, lo, hi))
+
+        return cls(rules=tuple(sorted(rules)),
+                   buy_threshold=num("buy_threshold", 0.3),
+                   sell_threshold=num("sell_threshold", 0.3),
+                   stop_loss=num("stop_loss", 2.0),
+                   take_profit=num("take_profit", 4.0),
+                   name=str(payload.get("name", name))[:64])
+
+    def weight_vector(self) -> np.ndarray:
+        w = np.zeros(len(RULE_NAMES), np.float32)
+        for n, v in self.rules:
+            w[RULE_NAMES.index(n)] = v
+        return w
+
+
+def default_seed() -> StrategyStructure:
+    """A sane trend+oscillator confluence seed (the reference seeds its
+    evaluator with the live strategy's current form)."""
+    return StrategyStructure(
+        rules=(("oscillator_consensus", 1.0), ("trend_confirmation", 1.0)),
+        name="seed")
+
+
+# --------------------------------------------------------------------------
+# Compiled evaluation: one vmapped program per fold
+# --------------------------------------------------------------------------
+
+def fold_features(ohlcv: dict) -> dict:
+    """Indicators + combination scores + engine inputs for one fold."""
+    arrays = {k: jnp.asarray(v) for k, v in ohlcv.items() if k != "regime"}
+    ind = ops.compute_indicators(arrays)
+    combos = combined_indicators(ind)
+    stack = jnp.stack([
+        combos[n] - 0.5 if n in _CENTERED else combos[n]
+        for n in RULE_NAMES])                       # [15, T]
+    return {
+        "stack": jnp.nan_to_num(stack),
+        "close": arrays["close"],
+        "volatility": jnp.nan_to_num(ind["atr"] / arrays["close"], nan=0.01),
+        "avg_volume": jnp.mean(arrays["volume"]) * jnp.mean(arrays["close"]),
+    }
+
+
+@jax.jit
+def _eval_batch(stack, close, volatility, avg_volume,
+                weights, buy_thr, sell_thr, sl, tp):
+    """Sharpe for a batch of structures on one fold, one compiled program.
+
+    weights [N,15], thresholds/exits [N] → sharpe [N]."""
+    T = close.shape[-1]
+
+    def one(w, b_thr, s_thr, sl_i, tp_i):
+        blend = (w @ stack) / jnp.maximum(jnp.sum(jnp.abs(w)), 1e-9)
+        signal = jnp.where(blend >= b_thr, sig.BUY,
+                           jnp.where(blend <= -s_thr, sig.SELL,
+                                     sig.NEUTRAL)).astype(jnp.int32)
+        strength = jnp.clip(jnp.abs(blend) * 100.0, 0.0, 100.0)
+        inputs = BacktestInputs(
+            close=close, signal=signal, strength=strength,
+            volatility=volatility,
+            volume=jnp.full((T,), avg_volume, jnp.float32),
+            confidence=jnp.ones((T,), jnp.float32),
+            decision=signal,
+            sl_pct=jnp.full((T,), sl_i, jnp.float32),
+            tp_pct=jnp.full((T,), tp_i, jnp.float32))
+        # sell_exits makes the SELL side of the blend a real exit rule, so
+        # sell_threshold is a live search dimension (the default engine is
+        # SL/TP-only per reference parity)
+        stats = run_backtest(inputs, min_signal_strength=0.0, warmup=50,
+                             sell_exits=True)
+        m = compute_metrics(stats)
+        return m["sharpe_ratio"], m["total_trades"]
+
+    return jax.vmap(one)(weights, buy_thr, sell_thr, sl, tp)
+
+
+def evaluate_structures(folds: list[dict],
+                        structures: list[StrategyStructure]) -> np.ndarray:
+    """Mean across-fold Sharpe per structure (CV evaluation —
+    `ai_strategy_evaluator.py:1360` batch evaluation, as one device batch
+    per fold instead of one call per candidate). Structures that never
+    trade score -inf: an empty backtest's Sharpe 0.0 must not outrank a
+    trading seed."""
+    W = jnp.asarray(np.stack([s.weight_vector() for s in structures]))
+    buy = jnp.asarray([s.buy_threshold for s in structures], jnp.float32)
+    sell = jnp.asarray([s.sell_threshold for s in structures], jnp.float32)
+    sl = jnp.asarray([s.stop_loss for s in structures], jnp.float32)
+    tp = jnp.asarray([s.take_profit for s in structures], jnp.float32)
+    sharpes, trades = [], []
+    for f in folds:
+        s, t = _eval_batch(f["stack"], f["close"], f["volatility"],
+                           f["avg_volume"], W, buy, sell, sl, tp)
+        sharpes.append(np.asarray(s))
+        trades.append(np.asarray(t))
+    mean_sharpe = np.mean(sharpes, axis=0)
+    total_trades = np.sum(trades, axis=0)
+    return np.where(total_trades > 0, mean_sharpe, -np.inf)
+
+
+# --------------------------------------------------------------------------
+# Candidate sources
+# --------------------------------------------------------------------------
+
+def mutate(rng: np.random.Generator, base: StrategyStructure,
+           round_idx: int = 0) -> StrategyStructure:
+    """Structure mutation: add / drop / swap a rule, or jitter numerics —
+    the always-available search operator (the reference's 'improvement
+    suggestions → apply' step, made deterministic)."""
+    rules = dict(base.rules)
+    op = rng.choice(["add", "drop", "swap", "jitter"])
+    absent = [n for n in RULE_NAMES if n not in rules]
+    if op == "add" and absent:
+        rules[rng.choice(absent)] = float(rng.uniform(-1.5, 1.5))
+    elif op == "drop" and len(rules) > 1:
+        rules.pop(rng.choice(list(rules)))
+    elif op == "swap" and absent:
+        rules.pop(rng.choice(list(rules)))
+        rules[rng.choice(absent)] = float(rng.uniform(-1.5, 1.5))
+    else:
+        for n in list(rules):
+            rules[n] = float(np.clip(rules[n] + rng.normal(0, 0.3),
+                                     *_BOUNDS["weight"]))
+    out = replace(
+        base, rules=tuple(sorted(rules.items())),
+        buy_threshold=float(np.clip(
+            base.buy_threshold + rng.normal(0, 0.05),
+            *_BOUNDS["buy_threshold"])),
+        sell_threshold=float(np.clip(
+            base.sell_threshold + rng.normal(0, 0.05),
+            *_BOUNDS["sell_threshold"])),
+        stop_loss=float(np.clip(base.stop_loss * rng.lognormal(0, 0.15),
+                                *_BOUNDS["stop_loss"])),
+        take_profit=float(np.clip(base.take_profit * rng.lognormal(0, 0.15),
+                                  *_BOUNDS["take_profit"])),
+        name=f"mut_r{round_idx}")
+    return out
+
+
+@dataclass
+class LLMStructureProposer:
+    """Asks the pluggable LLM backend for structure proposals
+    (`ai_strategy_evaluator.py:732`'s generation prompt, re-targeted at the
+    declarative genome instead of raw JS source)."""
+
+    llm: object                       # shell.llm.LLMTrader
+    n_proposals: int = 4
+
+    async def propose(self, best: StrategyStructure, cv_record: dict,
+                      round_idx: int) -> list[StrategyStructure]:
+        prompt = (
+            "You design trading strategies as rule compositions. Available "
+            f"rules (each scores each candle in [-1,1]): {list(RULE_NAMES)}.\n"
+            f"Current best structure: {json.dumps(best.to_payload())}\n"
+            f"Its cross-validated record: {json.dumps(cv_record)}\n"
+            f"Propose up to {self.n_proposals} IMPROVED structures. Reply "
+            "with ONLY a JSON object {\"structures\": [{\"rules\": "
+            "{rule_name: weight, ...}, \"buy_threshold\": x, "
+            "\"sell_threshold\": x, \"stop_loss\": pct, \"take_profit\": "
+            "pct}, ...]}.\nMARKET_DATA:" + json.dumps(
+                {"best": best.to_payload(), "cv": cv_record}))
+        try:
+            raw = await self.llm.complete(prompt)
+            items = json.loads(raw).get("structures", [])
+        except Exception:              # noqa: BLE001 — degrade to mutation
+            return []
+        if not isinstance(items, list):   # {"structures": null / {...}}
+            return []
+        out = []
+        for i, item in enumerate(items[:self.n_proposals]):
+            s = StrategyStructure.from_payload(
+                item if isinstance(item, dict) else {},
+                name=f"llm_r{round_idx}_{i}")
+            if s is not None:
+                out.append(replace(s, name=f"llm_r{round_idx}_{i}"))
+        return out
+
+
+# --------------------------------------------------------------------------
+# The generation loop
+# --------------------------------------------------------------------------
+
+@dataclass
+class StrategyGenerator:
+    """generate → evaluate (real CV) → register → iterate-until-stall
+    (`systematic_evaluate_and_improve`, ai_strategy_evaluator.py:732).
+
+    The candle axis splits into a search segment (CV folds the search
+    optimizes on) and a held-out tail the search never scores — the final
+    report compares seed vs best on that tail, which is the honest version
+    of the reference's train-and-report-on-the-same-data loop."""
+
+    registry: object | None = None    # strategy.registry.ModelRegistry
+    llm: object | None = None         # shell.llm.LLMTrader
+    cv_folds: int = 3
+    holdout_frac: float = 0.3
+    pool_size: int = 16
+    max_rounds: int = 6
+    patience: int = 2
+    min_improvement: float = 0.02
+    seed: int = 0
+    history: list = field(default_factory=list)
+
+    async def generate(self, ohlcv: dict,
+                       seed_structure: StrategyStructure | None = None) -> dict:
+        rng = np.random.default_rng(self.seed)
+        T = len(np.asarray(ohlcv["close"]))
+        split = int(T * (1.0 - self.holdout_frac))
+        arrays = {k: np.asarray(v) for k, v in ohlcv.items() if k != "regime"}
+        search = {k: v[:split] for k, v in arrays.items()}
+        holdout = {k: v[split:] for k, v in arrays.items()}
+
+        fold_len = split // self.cv_folds
+        folds = [fold_features({k: v[i * fold_len:(i + 1) * fold_len]
+                                for k, v in search.items()})
+                 for i in range(self.cv_folds)]
+        holdout_fold = [fold_features(holdout)]
+
+        best = seed_structure or default_seed()
+        best_score = float(evaluate_structures(folds, [best])[0])
+        self.history = [{"round": 0, "structure": best.to_payload(),
+                         "cv_sharpe": best_score, "source": "seed",
+                         "adopted": True}]
+        versions = []
+
+        def _register(structure, score, meta):
+            v = self.registry.register("generated_strategy",
+                                       structure.to_payload(), meta)
+            # -inf (never trades) must not be persisted as JSON -Infinity
+            if np.isfinite(score):
+                self.registry.update_performance(v, {"sharpe_ratio": score})
+            versions.append(v)
+
+        if self.registry is not None:
+            _register(best, best_score, {"source": "seed"})
+
+        proposer = (LLMStructureProposer(self.llm) if self.llm is not None
+                    else None)
+        stall = 0
+        for rnd in range(1, self.max_rounds + 1):
+            if stall >= self.patience:
+                break
+            candidates: list[StrategyStructure] = []
+            if proposer is not None:
+                cv_record = {"cv_sharpe": round(best_score, 4),
+                             "rounds_without_improvement": stall}
+                candidates += await proposer.propose(best, cv_record, rnd)
+            while len(candidates) < self.pool_size:
+                candidates.append(mutate(rng, best, rnd))
+            scores = evaluate_structures(folds, candidates)
+            top = int(np.argmax(scores))
+            top_score = float(scores[top])
+            adopted = top_score > best_score + self.min_improvement
+            self.history.append({
+                "round": rnd, "pool": len(candidates),
+                "pool_sources": [c.name for c in candidates],
+                "best_candidate": candidates[top].to_payload(),
+                "cv_sharpe": top_score,
+                "source": candidates[top].name,
+                "adopted": adopted})
+            if adopted:
+                best, best_score = candidates[top], top_score
+                stall = 0
+                if self.registry is not None:
+                    _register(best, best_score,
+                              {"source": best.name, "round": rnd})
+            else:
+                stall += 1
+
+        seed_s = seed_structure or default_seed()
+        held = evaluate_structures(holdout_fold, [seed_s, best])
+        return {
+            "structure": best,
+            "cv_sharpe": best_score,
+            "seed_cv_sharpe": self.history[0]["cv_sharpe"],
+            "holdout_sharpe_seed": float(held[0]),
+            "holdout_sharpe_best": float(held[1]),
+            "rounds": len(self.history) - 1,
+            "versions": versions,
+            "history": self.history,
+        }
+
+    def report(self) -> dict:
+        """(:910) — generation trajectory summary. Only ADOPTED candidates
+        count: a round's top score that failed the min_improvement gate was
+        rejected and must not be reported as an achieved improvement."""
+        if not self.history:
+            return {"status": "no_runs"}
+        adopted = [h["cv_sharpe"] for h in self.history if h.get("adopted")]
+        seed = self.history[0]["cv_sharpe"]
+        best = max(adopted) if adopted else seed
+        return {"rounds": len(self.history) - 1,
+                "seed_sharpe": seed,
+                "best_sharpe": best,
+                "improvement": best - seed,
+                "sources": sorted({h["source"] for h in self.history})}

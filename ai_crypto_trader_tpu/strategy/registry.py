@@ -52,7 +52,13 @@ class ModelRegistry:
             return 0.0
         sims = []
         for k in keys:
-            va, vb = float(a[k]), float(b[k])
+            try:
+                va, vb = float(a[k]), float(b[k])
+            except (TypeError, ValueError):
+                # non-numeric payload fields (structure rule dicts, names):
+                # exact match counts as identical, anything else distinct
+                sims.append(1.0 if a[k] == b[k] else 0.0)
+                continue
             scale = max(abs(va), abs(vb), 1e-12)
             sims.append(1.0 - min(abs(va - vb) / scale, 1.0))
         return float(np.mean(sims))

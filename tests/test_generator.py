@@ -1,0 +1,199 @@
+"""Strategy-structure generation tests (VERDICT r3 missing #3).
+
+The loop must generate candidate STRUCTURES (not just parameters), score
+them with the real scan engine on CV folds, register improved versions,
+and beat the seed on a held-out segment the search never saw — the done
+criterion from the round-3 verdict, matching
+`services/ai_strategy_evaluator.py:732-1360`.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.data import generate_ohlcv
+from ai_crypto_trader_tpu.strategy.generator import (
+    RULE_NAMES, LLMStructureProposer, StrategyGenerator, StrategyStructure,
+    default_seed, evaluate_structures, fold_features, mutate)
+
+
+@pytest.fixture(scope="module")
+def ohlcv():
+    return generate_ohlcv(n=6_000, seed=11)
+
+
+@pytest.fixture(scope="module")
+def folds(ohlcv):
+    arrays = {k: np.asarray(v)[:4_000] for k, v in ohlcv.items()
+              if k != "regime"}
+    half = 2_000
+    return [fold_features({k: v[:half] for k, v in arrays.items()}),
+            fold_features({k: v[half:] for k, v in arrays.items()})]
+
+
+class TestStructure:
+    def test_payload_roundtrip(self):
+        s = StrategyStructure(rules=(("oscillator_consensus", 1.5),
+                                     ("trend_confirmation", -0.5)),
+                              buy_threshold=0.25, stop_loss=3.0)
+        back = StrategyStructure.from_payload(s.to_payload())
+        assert back.rules == s.rules
+        assert back.buy_threshold == 0.25
+        assert back.stop_loss == 3.0
+
+    def test_from_payload_validation(self):
+        """Unknown rules dropped, numerics clamped, empty set rejected —
+        the code-quality gate before any candidate is evaluated."""
+        s = StrategyStructure.from_payload({
+            "rules": {"no_such_rule": 1.0, "stoch_rsi": 99.0},
+            "buy_threshold": 5.0, "stop_loss": -3.0})
+        assert s.rules == (("stoch_rsi", 2.0),)       # clamped to bound
+        assert s.buy_threshold == 0.9
+        assert s.stop_loss == 0.5
+        assert StrategyStructure.from_payload({"rules": {"bogus": 1.0}}) is None
+        assert StrategyStructure.from_payload({"rules": "garbage"}) is None
+
+    def test_list_form_rules_accepted(self):
+        s = StrategyStructure.from_payload({
+            "rules": [{"name": "double_rsi", "weight": 0.7}]})
+        assert s.rules == (("double_rsi", 0.7),)
+
+    def test_weight_vector_dense_lowering(self):
+        s = StrategyStructure(rules=(("trend_confirmation", 1.0),))
+        w = s.weight_vector()
+        assert w.shape == (len(RULE_NAMES),)
+        assert w[RULE_NAMES.index("trend_confirmation")] == 1.0
+        assert w.sum() == 1.0                          # everything else 0
+
+
+class TestEvaluation:
+    def test_batch_scores_finite_and_distinct(self, folds):
+        structures = [
+            default_seed(),
+            StrategyStructure(rules=(("divergence_detector", 1.0),),
+                              buy_threshold=0.5),
+            StrategyStructure(rules=(("triple_moving_average", -1.0),),
+                              buy_threshold=0.1, sell_threshold=0.1),
+        ]
+        scores = evaluate_structures(folds, structures)
+        assert scores.shape == (3,)
+        assert np.isfinite(scores).any()
+        # different structures must produce different trading outcomes
+        finite = scores[np.isfinite(scores)]
+        assert len(set(np.round(finite, 6))) > 1 or len(finite) <= 1
+
+    def test_never_trading_structure_scores_neg_inf(self, folds):
+        # direct construction skips from_payload clamping; a blend in
+        # [-1, 1] can never reach a 2.0 threshold, so zero trades happen
+        s = StrategyStructure(rules=(("trend_confirmation", 1.0),),
+                              buy_threshold=2.0, sell_threshold=2.0)
+        scores = evaluate_structures(folds, [s])
+        assert scores[0] == -np.inf
+
+    def test_mutation_changes_structure(self):
+        rng = np.random.default_rng(0)
+        base = default_seed()
+        muts = [mutate(rng, base, 1) for _ in range(20)]
+        assert any(m.rules != base.rules for m in muts)
+        for m in muts:
+            assert len(m.rules) >= 1
+            for n, w in m.rules:
+                assert n in RULE_NAMES
+                assert -2.0 <= w <= 2.0
+
+
+class TestLLMProposer:
+    def test_parses_llm_structures(self):
+        class Canned:
+            def complete(self, prompt):
+                assert "oscillator_consensus" in prompt   # vocabulary shown
+                return json.dumps({"structures": [
+                    {"rules": {"stoch_rsi": 1.2, "bogus_rule": 3.0},
+                     "buy_threshold": 0.2, "stop_loss": 1.5},
+                    {"rules": {}},                        # rejected: empty
+                ]})
+
+        from ai_crypto_trader_tpu.shell.llm import LLMTrader
+
+        p = LLMStructureProposer(llm=LLMTrader(backend=Canned()))
+        out = asyncio.run(p.propose(default_seed(), {"cv_sharpe": 0.1}, 1))
+        assert len(out) == 1
+        assert out[0].rules == (("stoch_rsi", 1.2),)
+        assert out[0].name == "llm_r1_0"
+
+    def test_backend_failure_degrades_to_empty(self):
+        class Boom:
+            def complete(self, prompt):
+                raise RuntimeError("down")
+
+        from ai_crypto_trader_tpu.shell.llm import LLMTrader
+
+        p = LLMStructureProposer(llm=LLMTrader(backend=Boom()))
+        out = asyncio.run(p.propose(default_seed(), {}, 1))
+        assert out == []
+
+
+class TestGenerationLoop:
+    def test_beats_seed_on_holdout_and_registers(self, ohlcv, tmp_path):
+        """The round-3 done criterion: a deliberately weak seed, real CV
+        search, registered versions, holdout comparison."""
+        from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+        weak_seed = StrategyStructure(
+            rules=(("divergence_detector", 0.2),),
+            buy_threshold=0.6, sell_threshold=0.6, name="weak_seed")
+        reg = ModelRegistry(path=str(tmp_path / "registry.json"))
+        gen = StrategyGenerator(registry=reg, cv_folds=2, pool_size=8,
+                                max_rounds=4, patience=2, seed=1)
+        out = asyncio.run(gen.generate(ohlcv, seed_structure=weak_seed))
+
+        assert out["cv_sharpe"] >= out["seed_cv_sharpe"]
+        # the generated structure must beat the seed on the held-out tail
+        assert out["holdout_sharpe_best"] > out["holdout_sharpe_seed"]
+        # every improvement was registered with its performance
+        assert len(out["versions"]) >= 2               # seed + ≥1 improvement
+        best = reg.best("generated_strategy")
+        assert best is not None
+        assert best["performance"]["sharpe_ratio"] == pytest.approx(
+            out["cv_sharpe"], abs=1e-6)
+        # structure actually changed, not just numerics of the seed rule set
+        assert out["structure"].to_payload()["rules"] != \
+            weak_seed.to_payload()["rules"]
+
+    def test_llm_candidates_flow_through_loop(self, ohlcv):
+        """An LLM that proposes a strong known structure should have its
+        proposal adopted (source name llm_r*)."""
+
+        class ProposeStrong:
+            def complete(self, prompt):
+                if "structures" in prompt:
+                    return json.dumps({"structures": [
+                        {"rules": {"oscillator_consensus": 1.0,
+                                   "trend_confirmation": 1.0,
+                                   "volume_weighted_price_momentum": 0.5},
+                         "buy_threshold": 0.15, "sell_threshold": 0.2,
+                         "stop_loss": 2.0, "take_profit": 5.0}]})
+                return "{}"
+
+        from ai_crypto_trader_tpu.shell.llm import LLMTrader
+
+        weak_seed = StrategyStructure(
+            rules=(("divergence_detector", 0.2),),
+            buy_threshold=0.6, sell_threshold=0.6)
+        gen = StrategyGenerator(llm=LLMTrader(backend=ProposeStrong()),
+                                cv_folds=2, pool_size=4, max_rounds=2,
+                                patience=1, seed=0)
+        out = asyncio.run(gen.generate(ohlcv, seed_structure=weak_seed))
+        pooled = {s for h in gen.history[1:] for s in h["pool_sources"]}
+        assert any(s.startswith("llm_") for s in pooled)   # proposals evaluated
+
+    def test_report(self, ohlcv):
+        gen = StrategyGenerator(cv_folds=2, pool_size=4, max_rounds=1,
+                                patience=1, seed=0)
+        asyncio.run(gen.generate(ohlcv))
+        r = gen.report()
+        assert r["rounds"] >= 1
+        assert r["best_sharpe"] >= r["seed_sharpe"] or \
+            np.isinf(r["seed_sharpe"])
