@@ -70,13 +70,18 @@ class FakeExchange(ExchangeInterface):
     engine at candle granularity."""
 
     def __init__(self, series: dict[str, OHLCV], quote_balance: float = 10_000.0,
-                 fee_rate: float = 0.001):
+                 fee_rate: float = 0.001, max_fill_base: float | None = None):
         self.series = series
         self.cursor = {s: 0 for s in series}
         self.balances: dict[str, float] = {"USDC": quote_balance}
         self.fee_rate = fee_rate
+        # Per-candle liquidity cap (base units): a resting limit order fills
+        # at most this much per candle, the remainder stays OPEN — the
+        # partial-fill reality grid/DCA reconciliation must survive.
+        self.max_fill_base = max_fill_base
         self.open_orders: dict[int, dict] = {}
         self.fills: list[dict] = []
+        self._fills_by_oid: dict[int, list] = {}
         self._order_ids = itertools.count(1)
 
     # --- clock -------------------------------------------------------------
@@ -156,6 +161,7 @@ class FakeExchange(ExchangeInterface):
             self.balances[quote] = self.balances.get(quote, 0.0) + cost - fee
         filled = {**order, "status": "FILLED", "price": price, "fee": fee}
         self.fills.append(filled)
+        self._fills_by_oid.setdefault(order.get("order_id"), []).append(filled)
         return filled
 
     def place_order(self, symbol: str, side: str, order_type: str,
@@ -189,9 +195,15 @@ class FakeExchange(ExchangeInterface):
                 elif side == "BUY" and c["high"] >= o["stop_price"]:
                     fill_price = o["limit_price"] or o["stop_price"]
             if fill_price is not None:
-                result = self._fill(o, fill_price)
+                qty = o["quantity"]
+                fill_qty = (min(qty, self.max_fill_base)
+                            if self.max_fill_base else qty)
+                result = self._fill({**o, "quantity": fill_qty}, fill_price)
                 if result["status"] == "FILLED":
-                    del self.open_orders[oid]
+                    if fill_qty < qty:
+                        o["quantity"] = qty - fill_qty   # partial: stays open
+                    else:
+                        del self.open_orders[oid]
 
     def cancel_order(self, symbol: str, order_id: int) -> dict:
         o = self.open_orders.pop(order_id, None)
@@ -207,6 +219,12 @@ class FakeExchange(ExchangeInterface):
             if f.get("order_id") == order_id:
                 return f
         return None
+
+    def fills_for(self, order_id: int) -> list[dict]:
+        """All (possibly partial) fills booked against one order — the
+        executed-quantity ledger reconciliation reads (indexed: long paper
+        runs reconcile every tracked order every tick)."""
+        return list(self._fills_by_oid.get(order_id, ()))
 
     def get_balances(self) -> dict:
         return dict(self.balances)

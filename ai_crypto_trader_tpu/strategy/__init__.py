@@ -15,6 +15,10 @@ from ai_crypto_trader_tpu.strategy.generator import (  # noqa: F401
     StrategyStructure,
 )
 from ai_crypto_trader_tpu.strategy.grid import GridTrader  # noqa: F401
+from ai_crypto_trader_tpu.strategy.grid_live import (  # noqa: F401
+    DCAService,
+    GridTraderService,
+)
 from ai_crypto_trader_tpu.strategy.dca import DCAStrategy  # noqa: F401
 from ai_crypto_trader_tpu.strategy.arbitrage import (  # noqa: F401
     find_triangle_arbitrage,

@@ -1,0 +1,304 @@
+"""Grid/DCA live order lifecycle tests (VERDICT r3 missing #5).
+
+The grid service must PLACE the ladder through ExchangeInterface, reconcile
+fills on tick (including partial fills), pair fills with the opposite
+order, book profit, re-anchor on band escape, and run as a launcher
+cadence service — all driven by the FakeExchange matching engine.
+Match: `services/grid_trading_strategy.py:517-678`.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.data.ingest import OHLCV
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+from ai_crypto_trader_tpu.strategy.dca import DCAStrategy
+from ai_crypto_trader_tpu.strategy.grid_live import (
+    DCAService, GridTraderService)
+
+
+def flat_series(n=600, price=100.0, amp=0.0, symbol="BTCUSDC"):
+    """Deterministic price path: flat, or a triangle wave of ±amp."""
+    t = np.arange(n)
+    close = price + amp * np.sin(t / 25.0)
+    high = close + 0.2
+    low = close - 0.2
+    return OHLCV(timestamp=t.astype(np.int64) * 60_000,
+                 open=close.astype(np.float32), high=high.astype(np.float32),
+                 low=low.astype(np.float32), close=close.astype(np.float32),
+                 volume=np.full(n, 1e6, np.float32), symbol=symbol)
+
+
+def make_service(series, bus=None, **kw):
+    ex = FakeExchange({"BTCUSDC": series}, quote_balance=100_000.0,
+                      fee_rate=0.0, **{k: v for k, v in kw.items()
+                                       if k == "max_fill_base"})
+    ex.advance("BTCUSDC", steps=520)       # enough history for auto bounds
+    svc = GridTraderService(
+        exchange=ex, symbol="BTCUSDC", bus=bus,
+        **{k: v for k, v in kw.items() if k != "max_fill_base"})
+    return ex, svc
+
+
+class TestLadderPlacement:
+    def test_start_places_buy_ladder_below_price(self):
+        ex, svc = make_service(flat_series(amp=5.0))
+        placed = svc.start()
+        assert placed >= 1
+        price = ex.get_ticker("BTCUSDC")["price"]
+        open_orders = list(ex.open_orders.values())
+        assert len(open_orders) == placed
+        for o in open_orders:
+            assert o["side"] == "BUY" and o["type"] == "LIMIT"
+            assert o["limit_price"] < price
+        # tracked mirror matches the exchange's book
+        assert set(svc.orders) == set(ex.open_orders)
+
+
+class TestFillReconciliation:
+    def test_buy_fill_places_paired_sell(self):
+        series = flat_series(n=800, amp=5.0)
+        ex, svc = make_service(series)
+        svc.start()
+
+        async def go():
+            out = None
+            for _ in range(120):
+                ex.advance("BTCUSDC")
+                out = await svc.run_once()
+                if out.get("buy"):
+                    return out
+            return out
+
+        out = asyncio.run(go())
+        assert out["buy"] >= 1
+        sells = [o for o in ex.open_orders.values() if o["side"] == "SELL"]
+        assert sells, "paired SELL must rest after a BUY fill"
+        # the SELL price is one grid level above its buy level
+        recs = [r for r in svc.orders.values() if r["side"] == "SELL"]
+        for r in recs:
+            assert r["price"] == pytest.approx(
+                float(svc.levels[r["level_i"] + 1]))
+
+    def test_round_trip_books_profit_and_rearms_buy(self):
+        series = flat_series(n=1200, amp=6.0)
+        ex, svc = make_service(series)
+        bus = EventBus()
+        svc.bus = bus
+        notes = bus.subscribe("grid_trade_notifications")
+        svc.start()
+
+        async def go():
+            for _ in range(600):
+                ex.advance("BTCUSDC")
+                await svc.run_once()
+                if svc.total_trades >= 1:
+                    return True
+            return False
+
+        assert asyncio.run(go())
+        assert svc.total_profit > 0            # sell level > buy level, no fees
+        assert svc.profitable_trades >= 1
+        assert not notes.empty()               # notification published
+        st = bus.get("grid_profit_BTCUSDC")
+        assert st["total_trades"] == svc.total_trades
+
+    def test_partial_fills_reconciled_incrementally(self):
+        """A liquidity-capped exchange fills the resting BUY across several
+        candles; each reconciled slice gets its paired SELL immediately."""
+        series = flat_series(n=1000, amp=5.0)
+        ex, svc = make_service(series, order_size=400.0, max_fill_base=1.0)
+        svc.start()
+        # order_size 400 at price ~95-100 → qty ≈ 4.2 → ≥4 partial fills
+        async def go():
+            paired = 0
+            for _ in range(400):
+                ex.advance("BTCUSDC")
+                await svc.run_once()
+                recs = [r for r in svc.orders.values()
+                        if r["side"] == "BUY" and 0 < r["filled"] < r["qty"]]
+                if recs:
+                    paired += 1
+                    # SELL quantity so far matches the filled portion
+                    sell_qty = sum(r["qty"] for r in svc.orders.values()
+                                   if r["side"] == "SELL")
+                    buy_filled = sum(r["filled"]
+                                     for r in svc.orders.values()
+                                     if r["side"] == "BUY")
+                    assert sell_qty == pytest.approx(buy_filled, rel=1e-6)
+                if paired >= 3:
+                    return True
+            return False
+
+        assert asyncio.run(go())
+
+
+class TestPairingRetry:
+    def test_failed_paired_placement_is_retried(self):
+        """A fill whose paired order placement fails (outage) must NOT be
+        orphaned: the unpaired slice is retried on later ticks."""
+        series = flat_series(n=900, amp=5.0)
+        ex, svc = make_service(series)
+        svc.start()
+        real_place = ex.place_order
+        outage = {"on": False, "blocked": 0}
+
+        def flaky(symbol, side, order_type, quantity, price=None, **kw):
+            if outage["on"] and order_type == "LIMIT":
+                outage["blocked"] += 1
+                raise RuntimeError("exchange down")
+            return real_place(symbol, side, order_type, quantity,
+                              price=price, **kw)
+
+        ex.place_order = flaky
+
+        async def go():
+            # run until a BUY fill happens while placement is down
+            outage["on"] = True
+            for _ in range(200):
+                ex.advance("BTCUSDC")
+                out = await svc.run_once()
+                if out.get("buy"):
+                    break
+            assert outage["blocked"] >= 1
+            unpaired = [r for r in svc.orders.values()
+                        if r["side"] == "BUY"
+                        and r["filled"] - r["paired"] > 1e-12]
+            assert unpaired, "fill slice must stay marked unpaired"
+            # outage ends → the next tick pairs the orphaned slice
+            outage["on"] = False
+            await svc.run_once()
+            still = [r for r in svc.orders.values()
+                     if r["side"] == "BUY"
+                     and r["filled"] - r["paired"] > 1e-12]
+            assert not still
+            assert any(r["side"] == "SELL" for r in svc.orders.values())
+
+        asyncio.run(go())
+
+
+class TestReanchor:
+    def test_band_escape_rebuilds_ladder_with_inventory_sell(self):
+        """Price breaks above the band → cancel-all, new boundaries, carry
+        unsold inventory as a SELL at the nearest level above."""
+        n = 1200
+        t = np.arange(n)
+        # flat around 100 for 600 candles, then a 40% ramp
+        close = np.where(t < 600, 100 + 2 * np.sin(t / 20.0),
+                         100 + (t - 600) * 0.07)
+        series = OHLCV(timestamp=t.astype(np.int64) * 60_000,
+                       open=close.astype(np.float32),
+                       high=(close + 0.2).astype(np.float32),
+                       low=(close - 0.2).astype(np.float32),
+                       close=close.astype(np.float32),
+                       volume=np.full(n, 1e6, np.float32), symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=100_000.0,
+                          fee_rate=0.0)
+        ex.advance("BTCUSDC", steps=520)
+        bus = EventBus()
+        svc = GridTraderService(exchange=ex, symbol="BTCUSDC", bus=bus,
+                                reanchor_margin_pct=1.0)
+        svc.start()
+        old_levels = svc.levels.copy()
+        old_ids = set(svc.orders)
+
+        async def go():
+            for _ in range(680):
+                ex.advance("BTCUSDC")
+                out = await svc.run_once()
+                if out.get("reanchored"):
+                    return True
+            return False
+
+        assert asyncio.run(go())
+        # the ladder was rebuilt around the new range
+        assert svc.levels[-1] > old_levels[-1]
+        # none of the old orders survive on the exchange
+        assert not (old_ids & set(ex.open_orders))
+        # new ladder is resting
+        assert svc.orders
+
+    def test_escape_detection(self):
+        ex, svc = make_service(flat_series(amp=5.0))
+        svc.start()
+        assert not svc._escaped(float(svc.levels[len(svc.levels) // 2]))
+        assert svc._escaped(float(svc.levels[-1]) * 1.05)
+        assert svc._escaped(float(svc.levels[0]) * 0.95)
+
+
+class TestLauncherIntegration:
+    def test_runs_as_extra_service(self):
+        """Both services ride the launcher tick with heartbeats."""
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+        from tests.test_shell import _series
+
+        series = _series(n=700)
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=100_000.0)
+        ex.advance("BTCUSDC", steps=520)
+        grid = GridTraderService(exchange=ex, symbol="BTCUSDC")
+        dca = DCAService(exchange=ex,
+                         dca=DCAStrategy(symbol="BTCUSDC", base_amount=50.0,
+                                         interval_s=60.0))
+        sys_ = TradingSystem(ex, ["BTCUSDC"], extra_services=[grid, dca])
+
+        async def go():
+            for _ in range(3):
+                ex.advance("BTCUSDC")
+                await sys_.tick()
+
+        asyncio.run(go())
+        assert "grid" in sys_.heartbeats.beats
+        assert "dca" in sys_.heartbeats.beats
+        assert grid._started
+
+
+class TestDCAService:
+    def test_purchase_cadence_and_publication(self):
+        series = flat_series(n=700, amp=2.0)
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000.0,
+                          fee_rate=0.0)
+        ex.advance("BTCUSDC", steps=520)
+        bus = EventBus()
+        clock = {"t": 0.0}
+        dca = DCAStrategy(symbol="BTCUSDC", base_amount=100.0,
+                          interval_s=3600.0)
+        svc = DCAService(exchange=ex, dca=dca, bus=bus,
+                         now_fn=lambda: clock["t"])
+        buys = bus.subscribe("dca_purchases")
+
+        async def go():
+            r1 = await svc.run_once()           # first buy immediate
+            clock["t"] += 60.0
+            r2 = await svc.run_once()           # gated
+            clock["t"] += 3600.0
+            r3 = await svc.run_once()           # second buy
+            return r1, r2, r3
+
+        r1, r2, r3 = asyncio.run(go())
+        assert r1["purchased"] and not r2["purchased"] and r3["purchased"]
+        assert len(dca.purchases) == 2
+        assert not buys.empty()
+        assert ex.get_balances()["BTC"] > 0
+
+    def test_rebalance_executes_market_orders(self):
+        series = flat_series(n=700, price=100.0, amp=0.0)
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000.0,
+                          fee_rate=0.0)
+        ex.advance("BTCUSDC", steps=520)
+        # start 100% BTC; target 50/50 vs USDC → SELL BTC drift order
+        ex.balances["BTC"] = 50.0
+        clock = {"t": 0.0}
+        svc = DCAService(
+            exchange=ex, dca=DCAStrategy(symbol="BTCUSDC",
+                                         interval_s=1e12),
+            now_fn=lambda: clock["t"],
+            rebalance_targets={"BTC": 0.5, "USDC": 0.5},
+            rebalance_interval_s=0.0)
+        out = asyncio.run(svc.run_once())
+        assert out["rebalanced"] == 1
+        b = ex.get_balances()
+        total = b["USDC"] + b["BTC"] * 100.0
+        assert b["BTC"] * 100.0 / total == pytest.approx(0.5, abs=0.05)
