@@ -73,6 +73,192 @@ def _svg_heatmap(matrix, labels, cell=34, pad=70):
             + "".join(texts) + "".join(cells) + "</svg>")
 
 
+def _np_ema(v: np.ndarray, n: int) -> np.ndarray:
+    out = np.empty_like(v)
+    alpha = 2.0 / (n + 1.0)
+    acc = v[0]
+    for i, x in enumerate(v):
+        acc = alpha * x + (1 - alpha) * acc
+        out[i] = acc
+    return out
+
+
+def chart_overlays(closes) -> dict:
+    """Display-only indicator overlays for the candlestick panel (the
+    reference pulls bb_upper/middle/lower + RSI/MACD per candle from Redis,
+    `dashboard.py:536-640`; here they're derived from the close series at
+    render time — tiny numpy, no jit round-trip from a serving thread)."""
+    c = np.asarray(closes, dtype=float)
+    if c.size < 3:
+        return {}
+    n = min(20, c.size)
+    kernel = np.ones(n) / n
+    sma = np.convolve(c, kernel, mode="full")[:c.size]
+    sma[:n - 1] = c[:n - 1]                    # warmup: track price
+    dev = np.array([c[max(0, i - n + 1):i + 1].std() for i in range(c.size)])
+    delta = np.diff(c, prepend=c[0])
+    up = _np_ema(np.maximum(delta, 0.0), 14)
+    dn = _np_ema(np.maximum(-delta, 0.0), 14)
+    rsi = 100.0 - 100.0 / (1.0 + up / np.where(dn == 0, 1e-9, dn))
+    macd = _np_ema(c, 12) - _np_ema(c, 26)
+    return {"bb_upper": sma + 2 * dev, "bb_middle": sma,
+            "bb_lower": sma - 2 * dev, "rsi": rsi, "macd": macd}
+
+
+def _svg_candlestick(klines, overlays: dict | None = None,
+                     trades: list | None = None, width=920, height=300,
+                     label="") -> str:
+    """Candlestick chart with indicator overlays, trade markers, and a
+    volume strip (the reference's main price panel, `dashboard.py:509-740`:
+    go.Candlestick + BB traces + volume subplot; markers mirror its trade
+    annotations). `klines` rows are the bus format [ts,o,h,l,c,vol,...]."""
+    rows = list(klines or [])
+    if len(rows) < 2:
+        return "<svg/>"
+    ts = np.asarray([r[0] for r in rows], dtype=float)
+    o = np.asarray([r[1] for r in rows], dtype=float)
+    h = np.asarray([r[2] for r in rows], dtype=float)
+    l = np.asarray([r[3] for r in rows], dtype=float)
+    c = np.asarray([r[4] for r in rows], dtype=float)
+    vol = np.asarray([r[5] for r in rows], dtype=float) if len(rows[0]) > 5 \
+        else np.zeros_like(c)
+    n = len(rows)
+    vol_h = 40
+    price_h = height - vol_h - 8
+    lo = float(np.nanmin([l.min()] + [np.nanmin(s) for k, s in (overlays or {}).items()
+                                      if k.startswith("bb") and len(s) == n]))
+    hi = float(np.nanmax([h.max()] + [np.nanmax(s) for k, s in (overlays or {}).items()
+                                      if k.startswith("bb") and len(s) == n]))
+    rng = hi - lo or 1.0
+
+    def y(p):
+        return 4 + (hi - p) / rng * (price_h - 8)
+
+    step = (width - 8) / n
+    cw = max(step * 0.6, 1.0)
+    parts = []
+    vmax = vol.max() or 1.0
+    for i in range(n):
+        x = 4 + i * step + step / 2
+        up = c[i] >= o[i]
+        color = "#2d5" if up else "#e55"
+        parts.append(f'<line x1="{x:.1f}" y1="{y(h[i]):.1f}" x2="{x:.1f}" '
+                     f'y2="{y(l[i]):.1f}" stroke="{color}" stroke-width="1"/>')
+        top, bot = (c[i], o[i]) if up else (o[i], c[i])
+        parts.append(
+            f'<rect x="{x - cw / 2:.1f}" y="{y(top):.1f}" width="{cw:.1f}" '
+            f'height="{max(y(bot) - y(top), 1.0):.1f}" fill="{color}"/>')
+        vh = vol[i] / vmax * (vol_h - 4)
+        parts.append(f'<rect x="{x - cw / 2:.1f}" y="{height - vh:.1f}" '
+                     f'width="{cw:.1f}" height="{vh:.1f}" fill="#345" '
+                     f'opacity="0.8"/>')
+    overlay_colors = {"bb_upper": "#9cf", "bb_middle": "#ccc",
+                      "bb_lower": "#9cf", "sma_20": "#fc6", "sma_50": "#c6f"}
+    for name, series in (overlays or {}).items():
+        s = np.asarray(series, dtype=float)
+        if name in ("rsi", "macd") or len(s) != n:
+            continue
+        pts = " ".join(f"{4 + i * step + step / 2:.1f},{y(v):.1f}"
+                       for i, v in enumerate(s) if np.isfinite(v))
+        parts.append(f'<polyline fill="none" stroke='
+                     f'"{overlay_colors.get(name, "#888")}" stroke-width="1" '
+                     f'opacity="0.8" points="{pts}"/>')
+    # trade markers: ▲ entry below the low, ▼ exit above the high
+    # (time-matched into the visible window; clipped to the edge otherwise)
+    for t in trades or []:
+        for key, price_key, glyph, color in (
+                ("opened_at", "entry_price", "▲", "#2d5"),
+                ("closed_at", "exit_price", "▼", "#e55")):
+            when = t.get(key)
+            price = t.get(price_key)
+            if when is None or price is None:
+                continue
+            # side='right' so a trade time exactly on a candle open lands
+            # on THAT candle, not the one before
+            i = int(np.clip(
+                np.searchsorted(ts, float(when) * 1000.0, side="right") - 1,
+                0, n - 1))
+            x = 4 + i * step + step / 2
+            yy = y(float(price))
+            parts.append(
+                f'<text x="{x:.1f}" y="{yy:.1f}" fill="{color}" '
+                f'font-size="12" text-anchor="middle">{glyph}'
+                f'<title>{html.escape(t.get("symbol", ""))} '
+                f'{html.escape(key.split("_")[0])} @ {float(price):,.2f}'
+                f'{" pnl " + format(t.get("pnl"), ",.2f") if key == "closed_at" and t.get("pnl") is not None else ""}'
+                f'</title></text>')
+    parts.append(f'<text x="8" y="16" fill="#999" font-size="11">'
+                 f'{html.escape(label)} [{lo:.2f} … {hi:.2f}]</text>')
+    return (f'<svg width="{width}" height="{height}" '
+            f'style="background:#111;border-radius:6px">'
+            + "".join(parts) + "</svg>")
+
+
+def _svg_allocation(values: dict, width=420, height=26) -> str:
+    """Portfolio allocation as a stacked horizontal bar + weights table
+    (the reference's allocation panel, `dashboard.py:1131` family)."""
+    vals = {k: float(v) for k, v in values.items() if v and v > 0}
+    total = sum(vals.values())
+    if total <= 0:
+        return ""
+    palette = ["#4af", "#2a7", "#fa4", "#e66", "#c6f", "#9cf", "#fc6"]
+    x = 0.0
+    segs = []
+    rows = {}
+    for i, (asset, v) in enumerate(sorted(vals.items(), key=lambda t: -t[1])):
+        w = v / total * width
+        color = palette[i % len(palette)]
+        segs.append(f'<rect x="{x:.1f}" y="0" width="{w:.1f}" '
+                    f'height="{height}" fill="{color}">'
+                    f'<title>{html.escape(asset)}: {v:,.2f} '
+                    f'({v / total:.1%})</title></rect>')
+        rows[f"<span style='color:{color}'>■</span> {html.escape(asset)}"] = \
+            f"{v:,.2f} ({v / total:.1%})"
+        x += w
+    bar = (f'<svg width="{width}" height="{height}" '
+           f'style="border-radius:4px">' + "".join(segs) + "</svg>")
+    body = "".join(f"<tr><td>{k}</td><td style='text-align:right'>"
+                   f"{html.escape(v)}</td></tr>" for k, v in rows.items())
+    return (f"<div class='card'><h3>Portfolio allocation</h3>{bar}"
+            f"<table>{body}</table></div>")
+
+
+def _model_comparison_html(versions: list, width=420) -> str:
+    """Model-version comparison panel (the reference's AI-model performance
+    chart + registry comparison, `dashboard.py:1174-1260`,
+    `model_registry_service.py:355`): per-version bar of the ranking metric
+    + status table."""
+    rows = []
+    for e in versions:
+        perf = e.get("performance") or {}
+        sharpe = perf.get("sharpe_ratio")
+        rows.append((e.get("version", "?"), e.get("kind", "?"),
+                     e.get("status", "?"),
+                     float(sharpe) if sharpe is not None else None))
+    if not rows:
+        return ""
+    scored = [r for r in rows if r[3] is not None]
+    best = max((r[3] for r in scored), default=0.0)
+    worst = min((r[3] for r in scored), default=0.0)
+    rng = (best - worst) or 1.0
+    parts = []
+    for v, kind, status, sharpe in rows[-10:]:
+        if sharpe is None:
+            bar = "<td style='color:#666'>unscored</td>"
+        else:
+            w = max((sharpe - worst) / rng * 160, 2)
+            color = "#2a7" if sharpe == best else "#47a"
+            bar = (f"<td><svg width='170' height='12'>"
+                   f"<rect width='{w:.0f}' height='12' fill='{color}'/>"
+                   f"</svg> {sharpe:.3f}</td>")
+        parts.append(f"<tr><td>{html.escape(str(v))}</td>"
+                     f"<td>{html.escape(str(kind))}</td>"
+                     f"<td>{html.escape(str(status))}</td>{bar}</tr>")
+    return ("<div class='card'><h3>Model versions</h3>"
+            "<table><tr><th>version</th><th>kind</th><th>status</th>"
+            "<th>sharpe</th></tr>" + "".join(parts) + "</table></div>")
+
+
 def _explanations_html(explanations: list) -> str:
     """Explanation drill-down (the reference's AI-explanation modal,
     dashboard.py:1937): a <details> disclosure per signal with the factor
@@ -124,14 +310,45 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
                      metrics: dict | None = None, mc_stats: dict | None = None,
                      signals: list | None = None, alerts: list | None = None,
                      regime: dict | None = None, refresh_s: float | None = None,
+                     klines=None, trades: list | None = None,
+                     allocation: dict | None = None,
+                     model_versions: list | None = None,
+                     symbol: str | None = None,
+                     symbol_links: list | None = None,
                      now_fn=time.time) -> str:
     """Return the dashboard HTML. Every section is optional — sections
     render from whatever state exists (like the reference's per-callback
     panels tolerating missing Redis keys). `refresh_s` adds a meta-refresh
-    so a served page polls like the reference's 5 s Dash interval."""
+    so a served page polls like the reference's 5 s Dash interval.
+
+    `klines` (bus rows) renders the reference's main panel — candlestick
+    with BB overlays, RSI/MACD subpanels, volume strip, and trade markers
+    from `trades` records (`dashboard.py:509-740`); `allocation` the
+    portfolio-allocation card; `model_versions` the registry comparison."""
     sections = []
-    if price_series is not None:
+    if symbol_links:
+        links = " · ".join(
+            f'<a style="color:#8ac" href="/?symbol={html.escape(s)}">'
+            f'{html.escape(s)}</a>' for s in symbol_links)
+        sections.append(f"<p>{links} &nbsp; <span style='color:#777'>"
+                        "(window via ?window=N candles)</span></p>")
+    if klines:
+        closes = [row[4] for row in klines]
+        ov = chart_overlays(closes)
+        sections.append(_svg_candlestick(
+            klines, ov, trades, label=symbol or "price"))
+        if "rsi" in ov:
+            sections.append(_svg_line(ov["rsi"], height=80, label="RSI 14",
+                                      color="#fc6"))
+        if "macd" in ov:
+            sections.append(_svg_line(ov["macd"], height=80, label="MACD",
+                                      color="#c6f"))
+    elif price_series is not None:
         sections.append(_svg_line(price_series, label="price", color="#4af"))
+    if allocation:
+        sections.append(_svg_allocation(allocation))
+    if model_versions:
+        sections.append(_model_comparison_html(model_versions))
     if equity_curve is not None:
         sections.append(_svg_line(equity_curve, label="equity", color="#2a7"))
     if metrics:

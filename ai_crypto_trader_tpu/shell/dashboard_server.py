@@ -50,10 +50,24 @@ class DashboardServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                from urllib.parse import parse_qs, urlsplit
+
+                parts = urlsplit(self.path)
+                path = parts.path
+                q = parse_qs(parts.query)
                 try:
                     if path == "/":
-                        self._send(outer.render_html().encode(),
+                        # ?symbol=X&window=N — the reference's symbol
+                        # dropdown + historical window selection as query
+                        # params (`dashboard.py` dcc.Dropdown / time range)
+                        symbol = q.get("symbol", [None])[0]
+                        try:
+                            window = int(q.get("window", [0])[0])
+                            window = window if window > 0 else None
+                        except ValueError:
+                            window = None
+                        self._send(outer.render_html(
+                            symbol=symbol, window=window).encode(),
                                    "text/html; charset=utf-8")
                     elif path == "/state.json":
                         self._send(json.dumps(outer.state(),
@@ -80,20 +94,57 @@ class DashboardServer:
         return self._httpd.server_address[1]
 
     # --- view assembly ------------------------------------------------------
-    def render_html(self) -> str:
+    def render_html(self, symbol: str | None = None,
+                    window: int | None = None) -> str:
         # Handler threads read ONLY launcher/bus state (GIL-safe snapshot
         # reads) — never the exchange: that would burn trading rate-limit
         # tokens and perturb virtual clocks from a foreign thread.
         system = self.system
-        sym = system.symbols[0] if system.symbols else None
+        sym = (symbol if symbol in system.symbols else
+               (system.symbols[0] if system.symbols else None))
         klines = (system.bus.get(f"historical_data_{sym}_1m") or []) if sym else []
-        prices = [row[4] for row in klines] if klines else None
+        if window:
+            klines = klines[-window:]
         signals = [system.bus.get(f"latest_signal_{s}")
                    for s in system.symbols]
         status = system.status_cached()
+        # allocation: quote balances + base holdings marked at the latest
+        # price of whichever CONFIGURED symbol trades them (same marking
+        # rule as launcher.py:149-154 — no hardcoded quote)
+        from ai_crypto_trader_tpu.utils.symbols import (
+            QUOTE_ASSETS, base_asset)
+
+        balances = dict(status["balances"])
+        allocation = {a: v for a, v in balances.items()
+                      if a in QUOTE_ASSETS and v > 0}
+        for s in system.symbols:
+            base = base_asset(s)
+            qty = balances.get(base, 0.0)
+            md = system.bus.get(f"market_data_{s}")
+            if qty > 0 and md:
+                allocation[base] = (allocation.get(base, 0.0)
+                                    + qty * md["current_price"])
+        # trade markers: closed + open trades from the executor's books
+        # (atomic list() snapshots — the asyncio loop mutates these dicts
+        # while handler threads render)
+        trades = [t for t in list(system.executor.closed_trades)
+                  if t.get("symbol") == sym]
+        for s, t in list(system.executor.active_trades.items()):
+            if s == sym:
+                trades.append({"symbol": s, "entry_price": t.entry_price,
+                               "opened_at": t.opened_at})
+        registry = getattr(system, "registry", None)
+        versions = (list(registry.entries.values())
+                    if registry is not None else None)
         return render_dashboard(
             bus=system.bus,
-            price_series=prices,
+            klines=klines,
+            trades=trades,
+            symbol=sym,
+            symbol_links=(system.symbols
+                          if len(system.symbols) > 1 else None),
+            allocation=allocation,
+            model_versions=versions,
             metrics={"portfolio_value_usd": status.get(
                          "portfolio_value_usd",
                          status["balances"].get("USDC", 0.0)),

@@ -229,7 +229,8 @@ class TradeExecutor:
         pnl = (exit_price - trade.entry_price) * trade.quantity
         record = {"symbol": symbol, "entry_price": trade.entry_price,
                   "exit_price": exit_price, "quantity": trade.quantity,
-                  "pnl": pnl, "reason": reason, "closed_at": self.now_fn()}
+                  "pnl": pnl, "reason": reason, "opened_at": trade.opened_at,
+                  "closed_at": self.now_fn()}
         self.closed_trades.append(record)
         await self.bus.publish("trade_closures", record)
 
@@ -279,7 +280,8 @@ class TradeExecutor:
         pnl = (price - trade.entry_price) * trade.quantity
         record = {"symbol": symbol, "entry_price": trade.entry_price,
                   "exit_price": price, "quantity": trade.quantity,
-                  "pnl": pnl, "reason": reason, "closed_at": self.now_fn()}
+                  "pnl": pnl, "reason": reason, "opened_at": trade.opened_at,
+                  "closed_at": self.now_fn()}
         self.closed_trades.append(record)
         await self.bus.publish("trade_closures", record)
 

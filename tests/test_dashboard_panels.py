@@ -76,3 +76,83 @@ def test_panels_render_in_live_page():
 def test_render_tolerates_missing_panels():
     html = render_dashboard()
     assert "no data yet" in html
+
+# --- round-4 parity panels (VERDICT r3 missing #4): candlestick with
+# overlays + trade markers (dashboard.py:509-740), allocation (:1131),
+# model comparison (:1174-1260), window/symbol query params -----------------
+
+def test_candlestick_with_overlays_and_markers():
+    from ai_crypto_trader_tpu.shell.dashboard import (
+        _svg_candlestick, chart_overlays)
+
+    klines = [[i * 60_000, 100 + i, 101 + i, 99 + i, 100.5 + i, 1000.0]
+              for i in range(60)]
+    ov = chart_overlays([row[4] for row in klines])
+    assert set(ov) >= {"bb_upper", "bb_middle", "bb_lower", "rsi", "macd"}
+    trades = [{"symbol": "BTCUSDC", "entry_price": 110.5, "opened_at": 10 * 60,
+               "exit_price": 140.5, "closed_at": 40 * 60, "pnl": 30.0}]
+    svg = _svg_candlestick(klines, ov, trades, label="BTCUSDC")
+    assert svg.count("<rect") >= 120          # bodies + volume bars
+    assert "▲" in svg and "▼" in svg          # entry/exit markers
+    assert "polyline" in svg                  # BB overlays
+    assert "BTCUSDC" in svg
+
+
+def test_candlestick_degrades_on_empty():
+    from ai_crypto_trader_tpu.shell.dashboard import _svg_candlestick
+
+    assert _svg_candlestick([]) == "<svg/>"
+    assert _svg_candlestick([[0, 1, 1, 1, 1, 0]]) == "<svg/>"
+
+
+def test_allocation_and_model_panels_render():
+    from ai_crypto_trader_tpu.shell.dashboard import (
+        _model_comparison_html, _svg_allocation)
+
+    alloc = _svg_allocation({"USDC": 5000.0, "BTC": 3000.0, "ETH": 2000.0})
+    assert "Portfolio allocation" in alloc
+    assert "50.0%" in alloc and "30.0%" in alloc
+    versions = [
+        {"version": "a1", "kind": "strategy_params", "status": "registered",
+         "performance": {"sharpe_ratio": 1.2}},
+        {"version": "b2", "kind": "strategy_params", "status": "active",
+         "performance": {}},
+    ]
+    panel = _model_comparison_html(versions)
+    assert "Model versions" in panel
+    assert "a1" in panel and "b2" in panel
+    assert "1.200" in panel and "unscored" in panel
+
+
+def test_live_page_candlestick_allocation_and_query_params():
+    import json
+    import urllib.request
+
+    ex, clock, system = _system()
+    _run_ticks(ex, clock, system, 3)
+    # give the launcher a registry so the comparison panel has data
+    from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+    import tempfile, os
+    reg = ModelRegistry(path=os.path.join(tempfile.mkdtemp(), "r.json"))
+    v = reg.register("strategy_params", {"rsi_period": 14})
+    reg.update_performance(v, {"sharpe_ratio": 0.9})
+    system.registry = reg
+
+    server = DashboardServer(system, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        page = urllib.request.urlopen(f"{base}/").read().decode()
+        assert "<svg" in page
+        assert "Portfolio allocation" in page
+        assert "Model versions" in page
+        assert "RSI 14" in page               # indicator subpanel
+        # symbol + window query params select the series
+        page2 = urllib.request.urlopen(
+            f"{base}/?symbol=ETHUSDC&window=20").read().decode()
+        assert "ETHUSDC" in page2
+        # a 20-candle window draws far fewer candle bodies than the default
+        assert page2.count("<rect") < page.count("<rect")
+        # symbol nav links present (2-symbol system)
+        assert 'href="/?symbol=ETHUSDC"' in page
+    finally:
+        server.stop()

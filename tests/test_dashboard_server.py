@@ -40,7 +40,7 @@ def test_serves_live_state_and_updates_between_polls():
         assert code == 200
         assert "ai_crypto_trader_tpu dashboard" in page
         assert '<meta http-equiv="refresh" content="5">' in page
-        assert "price" in page                      # live price chart
+        assert "BTCUSDC" in page and "<svg" in page   # live candlestick panel
 
         code, raw = _fetch(server.port, "/state.json")
         state = json.loads(raw)
