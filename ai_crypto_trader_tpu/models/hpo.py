@@ -86,7 +86,13 @@ def suggest_tpe(history: list, rng: np.random.Generator, *,
     trials. Splits it into the best ceil(γ·n) ("good") and the rest
     ("bad"), draws candidates from the good distribution (perturbed good
     points / their categorical frequencies), and returns the candidate
-    maximizing Σ_dims [log l(x) − log g(x)]."""
+    maximizing Σ_dims [log l(x) − log g(x)].
+
+    An empty history has no good/bad split — fall back to a prior sample
+    (optimize_hyperparameters never hits this via n_startup ≥ 1, but the
+    public function must not assume its caller)."""
+    if not history:
+        return _sample_trial(rng)
     ranked = sorted(history, key=lambda r: r["val_loss"])
     n_good = max(int(np.ceil(len(ranked) * gamma)), 1)
     good = [r["trial"] for r in ranked[:n_good]]

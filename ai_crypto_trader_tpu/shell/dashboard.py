@@ -237,12 +237,13 @@ def _model_comparison_html(versions: list, width=420) -> str:
                      float(sharpe) if sharpe is not None else None))
     if not rows:
         return ""
+    rows = rows[-10:]                  # scale bars over the DISPLAYED rows
     scored = [r for r in rows if r[3] is not None]
     best = max((r[3] for r in scored), default=0.0)
     worst = min((r[3] for r in scored), default=0.0)
     rng = (best - worst) or 1.0
     parts = []
-    for v, kind, status, sharpe in rows[-10:]:
+    for v, kind, status, sharpe in rows:
         if sharpe is None:
             bar = "<td style='color:#666'>unscored</td>"
         else:

@@ -108,22 +108,13 @@ class DashboardServer:
         signals = [system.bus.get(f"latest_signal_{s}")
                    for s in system.symbols]
         status = system.status_cached()
-        # allocation: quote balances + base holdings marked at the latest
-        # price of whichever CONFIGURED symbol trades them (same marking
-        # rule as launcher.py:149-154 — no hardcoded quote)
-        from ai_crypto_trader_tpu.utils.symbols import (
-            QUOTE_ASSETS, base_asset)
+        # allocation: the same marking rule as the launcher's portfolio
+        # gauge (shared helper — dedup by base, no hardcoded quote)
+        from ai_crypto_trader_tpu.utils.symbols import mark_holdings
 
-        balances = dict(status["balances"])
-        allocation = {a: v for a, v in balances.items()
-                      if a in QUOTE_ASSETS and v > 0}
-        for s in system.symbols:
-            base = base_asset(s)
-            qty = balances.get(base, 0.0)
-            md = system.bus.get(f"market_data_{s}")
-            if qty > 0 and md:
-                allocation[base] = (allocation.get(base, 0.0)
-                                    + qty * md["current_price"])
+        allocation = mark_holdings(
+            dict(status["balances"]), system.symbols,
+            lambda s: system.bus.get(f"market_data_{s}"))
         # trade markers: closed + open trades from the executor's books
         # (atomic list() snapshots — the asyncio loop mutates these dicts
         # while handler threads render)

@@ -24,6 +24,24 @@ from ai_crypto_trader_tpu.data.ingest import OHLCV
 from ai_crypto_trader_tpu.utils import symbols as symbols_util
 
 
+def _interval_minutes(interval: str) -> int:
+    return int(interval[:-1]) * {"m": 1, "h": 60, "d": 1440}[interval[-1]]
+
+
+def resample_klines(rows: list, factor: int) -> list:
+    """Aggregate 1×-interval kline rows into factor×-interval bars (shared
+    by FakeExchange's interval support and the monitor's local fallback)."""
+    out = []
+    usable = len(rows) - len(rows) % factor
+    for i in range(0, usable, factor):
+        chunk = rows[i: i + factor]
+        out.append([chunk[0][0], chunk[0][1],
+                    max(r[2] for r in chunk), min(r[3] for r in chunk),
+                    chunk[-1][4], sum(r[5] for r in chunk)]
+                   + list(chunk[-1][6:]))
+    return out
+
+
 class ExchangeInterface(ABC):
     """`exchange_interface.py:10-60` surface."""
 
@@ -127,15 +145,22 @@ class FakeExchange(ExchangeInterface):
 
     def get_klines(self, symbol: str, interval: str = "1m",
                    limit: int = 100) -> list:
+        """Candles at the requested interval — a real venue serves native
+        3m/5m/15m bars capped at ~1000/request, so consumers fetch each
+        frame separately instead of one giant 1m window; the fake honors
+        the same contract by resampling its 1m series."""
+        factor = _interval_minutes(interval)
         s = self.series[symbol]
         end = self.cursor[symbol] + 1
-        start = max(end - limit, 0)
+        start = max(end - limit * factor, 0)
         rows = []
         for i in range(start, end):
             rows.append([int(s.timestamp[i]), float(s.open[i]), float(s.high[i]),
                          float(s.low[i]), float(s.close[i]), float(s.volume[i]),
                          0, 0.0, 0, 0.0, 0.0, 0])
-        return rows
+        if factor > 1:
+            rows = resample_klines(rows, factor)
+        return rows[-limit:]
 
     # --- trading -----------------------------------------------------------
     def _base_asset(self, symbol: str) -> str:

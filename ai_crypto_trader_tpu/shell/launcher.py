@@ -145,13 +145,12 @@ class TradingSystem:
         await self._run_extra_services()
         # total portfolio value: quote balances + base holdings marked at the
         # latest price (free USDC alone would show a phantom loss while a
-        # position is open)
-        total = sum(v for a, v in balances.items() if a in QUOTE_ASSETS)
-        for symbol in self.symbols:
-            md = self.bus.get(f"market_data_{symbol}")
-            base = base_asset(symbol)
-            if md and balances.get(base):
-                total += balances[base] * md["current_price"]
+        # position is open); dedup by base asset via the shared helper
+        from ai_crypto_trader_tpu.utils.symbols import mark_holdings
+
+        total = sum(mark_holdings(
+            balances, self.symbols,
+            lambda s: self.bus.get(f"market_data_{s}")).values())
         self.metrics.set_gauge("portfolio_value_usd", total)
         # bounded portfolio-value history: the dashboard's main time-series
         # panel (reference dashboard.py portfolio chart)

@@ -35,7 +35,10 @@ class MarketMonitor:
     bus: EventBus
     exchange: ExchangeInterface
     symbols: list[str] = field(default_factory=lambda: ["BTCUSDC"])
-    intervals: tuple = ("1m", "5m")
+    # The reference fetches 1m/3m/5m/15m every pass
+    # (`market_monitor_service.py:150-217`); trend blends 0.6·1m + 0.4·5m,
+    # the other frames publish their own rsi_/macd_/signal_ columns.
+    intervals: tuple = ("1m", "3m", "5m", "15m")
     throttle_s: float = 5.0
     kline_limit: int = 256
     now_fn: any = time.time
@@ -94,8 +97,8 @@ class MarketMonitor:
             "avg_volume": float(np.asarray(feats.volume)[i]),
             "signal": {1: "BUY", 0: "NEUTRAL", -1: "SELL"}[int(np.asarray(signal)[i])],
             "signal_strength": float(np.asarray(strength)[i]),
-            "price_change_1m": chg(1), "price_change_5m": chg(5),
-            "price_change_15m": chg(15),
+            "price_change_1m": chg(1), "price_change_3m": chg(3),
+            "price_change_5m": chg(5), "price_change_15m": chg(15),
             "volume_profile": {
                 "poc_price": float(np.asarray(vp["poc_price"])),
                 "value_area_low": float(np.asarray(vp["value_area_low"])),
@@ -104,24 +107,16 @@ class MarketMonitor:
             "confluence": float(np.asarray(confluence)[i]),
         }
 
-    @staticmethod
-    def _interval_minutes(interval: str) -> int:
-        unit = interval[-1]
-        n = int(interval[:-1])
-        return n * {"m": 1, "h": 60, "d": 1440}[unit]
-
-    @staticmethod
-    def _resample(klines: list, factor: int) -> list:
-        """Aggregate 1×-interval klines into factor×-interval bars."""
-        out = []
-        usable = len(klines) - len(klines) % factor
-        for i in range(0, usable, factor):
-            chunk = klines[i: i + factor]
-            out.append([chunk[0][0], chunk[0][1],
-                        max(r[2] for r in chunk), min(r[3] for r in chunk),
-                        chunk[-1][4], sum(r[5] for r in chunk)]
-                       + list(chunk[-1][6:]))
-        return out
+    def _fetch(self, symbol: str, interval: str):
+        """Breaker-guarded per-interval fetch. Each frame is requested at
+        its NATIVE interval with limit = kline_limit — the reference's
+        four separate get_klines calls (`market_monitor_service.py:150-217`)
+        and the only shape a real venue serves (Binance caps one request at
+        1000 candles; a 15×kline_limit 1m mega-window would exceed it)."""
+        if self.breaker is None:          # resilient seam (see __post_init__)
+            return self.exchange.get_klines(symbol, interval, self.kline_limit)
+        return self.breaker.call(self.exchange.get_klines, symbol, interval,
+                                 self.kline_limit)
 
     async def poll(self, force: bool = False,
                    symbols: list[str] | None = None) -> int:
@@ -136,20 +131,10 @@ class MarketMonitor:
         blend (`market_monitor_service.py:219-301`)."""
         published = 0
         now = self.now_fn()
-        base_min = self._interval_minutes(self.intervals[0])
         for symbol in (symbols if symbols is not None else self.symbols):
             if not force and now - self._last_pub.get(symbol, -1e18) < self.throttle_s:
                 continue
-            # fetch enough base candles to fill the secondary timeframe too
-            max_factor = max(self._interval_minutes(iv) // base_min
-                             for iv in self.intervals)
-            if self.breaker is None:      # resilient seam (see __post_init__)
-                klines = self.exchange.get_klines(
-                    symbol, self.intervals[0], self.kline_limit * max_factor)
-            else:
-                klines = self.breaker.call(self.exchange.get_klines, symbol,
-                                           self.intervals[0],
-                                           self.kline_limit * max_factor)
+            klines = self._fetch(symbol, self.intervals[0])
             if klines is None:
                 continue
             update = self._features_from_klines(klines[-self.kline_limit:])
@@ -157,16 +142,27 @@ class MarketMonitor:
                 continue
             self.bus.set(f"historical_data_{symbol}_{self.intervals[0]}",
                          klines[-self.kline_limit:])
+            # The 0.6/0.4 trend blend pairs the primary frame with 5m
+            # specifically (`market_monitor_service.py:273` strength_1m*0.6
+            # + strength_5m*0.4); other frames contribute their per-interval
+            # columns (rsi_3m, macd_5m, …, :285-298) without re-blending.
+            blend_iv = "5m" if "5m" in self.intervals[1:] else (
+                self.intervals[1] if len(self.intervals) > 1 else None)
             for iv in self.intervals[1:]:
-                factor = self._interval_minutes(iv) // base_min
-                res = self._resample(klines, factor)[-self.kline_limit:]
+                res = self._fetch(symbol, iv)
+                if not res:
+                    continue
+                res = res[-self.kline_limit:]
                 self.bus.set(f"historical_data_{symbol}_{iv}", res)
                 sec = self._features_from_klines(res)
                 if sec is not None:
-                    update["trend_strength"] = (0.6 * update["trend_strength"]
-                                                + 0.4 * sec["trend_strength"])
+                    if iv == blend_iv:
+                        update["trend_strength"] = (
+                            0.6 * update["trend_strength"]
+                            + 0.4 * sec["trend_strength"])
                     update[f"signal_{iv}"] = sec["signal"]
                     update[f"rsi_{iv}"] = sec["rsi"]
+                    update[f"macd_{iv}"] = sec["macd"]
             update["symbol"] = symbol
             update["timestamp"] = now
             self.bus.set(f"market_data_{symbol}", update)

@@ -55,7 +55,9 @@ def score_pairs(ohlcv: dict, min_quote_volume: float = 50_000.0,
     volume_factor = jnp.minimum(quote_vol / (10.0 * min_quote_volume), 1.0)
     # center-of-band volatility scores highest
     band_mid = (min_volatility + max_volatility) / 2.0
-    band_half = (max_volatility - min_volatility) / 2.0
+    # max() guards a degenerate min==max band: the division would emit NaN
+    # that survives the jnp.where eligibility zeroing below
+    band_half = jnp.maximum((max_volatility - min_volatility) / 2.0, 1e-9)
     vol_score = 1.0 - jnp.abs(vol - band_mid) / band_half
 
     score = (strength_last / 100.0 + vol_score + volume_factor)

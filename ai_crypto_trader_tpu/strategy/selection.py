@@ -3,8 +3,9 @@
 Capability parity with StrategySelectionService
 (`services/strategy_selection_service.py`): factor scores for market regime
 fit, historical performance, risk profile, social sentiment, market
-volatility, feature importance (:772-870), time-of-day adjustments (:689),
-and cooldown-guarded `should_switch_strategy` (:884).
+volatility, feature importance (:772-870), LEARNED per-hour performance
+profiles + time-window adjustments (:689-770), and cooldown-guarded
+`should_switch_strategy` (:884).
 """
 
 from __future__ import annotations
@@ -13,6 +14,28 @@ import time
 from dataclasses import dataclass, field
 
 import numpy as np
+
+# UTC time windows (`strategy_selection_service.py:90-93`).
+TIME_WINDOWS = {
+    "high_volatility": (14, 22),     # market opens
+    "low_activity": (0, 8),
+}
+
+
+def hourly_performance(trades: list[dict]) -> dict:
+    """Per-UTC-hour {win_rate, trade_count} profile from closed-trade
+    records ({'pnl', 'closed_at'} — executor/backtest shapes). This is the
+    learned profile the reference reads from each strategy's metrics
+    (`:725-735`), built here instead of assumed to exist in Redis."""
+    buckets: dict[int, list[bool]] = {}
+    for t in trades:
+        when = t.get("closed_at")
+        if when is None:
+            continue
+        hour = int(when // 3600) % 24
+        buckets.setdefault(hour, []).append(float(t.get("pnl", 0.0)) > 0)
+    return {str(h): {"win_rate": float(np.mean(w)), "trade_count": len(w)}
+            for h, w in buckets.items()}
 
 DEFAULT_WEIGHTS = {
     "market_regime": 0.25,
@@ -71,12 +94,35 @@ class StrategySelector:
             + vol_score * self.weights["market_volatility"]
             + fi_score * self.weights["feature_importance"]
         )
-        # time-of-day adjustment (:689): damp scores in historically thin
-        # liquidity hours (00-04 UTC)
-        if hour_of_day is not None and 0 <= hour_of_day < 4:
-            combined *= 0.9
+        # time-of-day adjustments (`apply_time_based_adjustments:689-770`):
+        # learned per-hour profile + volatility/activity windows, clamped
+        combined = float(np.clip(combined, 0.0, 1.0))
+        hour_detail = {}
+        if hour_of_day is not None:
+            hourly = strategy.get("hourly_performance")
+            if hourly is None:
+                # cache on the strategy dict: trades only change when one
+                # closes, and the selector re-scores every cycle
+                hourly = hourly_performance(strategy.get("trades", []))
+                strategy["hourly_performance"] = hourly
+            perf = hourly.get(str(int(hour_of_day)), {})
+            count = perf.get("trade_count", 0)
+            if count >= 10:              # enough data (:733)
+                hour_factor = (perf.get("win_rate", 0.5) - 0.5) * 2.0
+                combined += hour_factor * 0.1            # ±10% (:735)
+                hour_detail["hour_factor"] = hour_factor
+            lo, hi = TIME_WINDOWS["high_volatility"]
+            if lo <= hour_of_day < hi:                   # (:740-749)
+                atr_mult = strategy.get("params", {}).get("atr_multiplier", 1.0)
+                combined += min(atr_mult / 2.0, 1.0) * 0.05
+            lo, hi = TIME_WINDOWS["low_activity"]
+            if lo <= hour_of_day < hi:                   # (:752-758)
+                per_hour = strategy.get("avg_trades_per_hour", 10.0)
+                combined += max(0.0, 1.0 - per_hour / 20.0) * 0.05
+            combined = float(np.clip(combined, 0.0, 1.0))  # (:763-765)
         return {
             "combined": combined,
+            **hour_detail,
             "factors": {
                 "market_regime": regime_score,
                 "historical_performance": perf_score,
