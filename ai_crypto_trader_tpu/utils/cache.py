@@ -13,7 +13,16 @@ _DEFAULT_DIR = os.path.join(
 
 def enable_compilation_cache(cache_dir: str | None = None,
                              min_compile_secs: float = 1.0) -> bool:
-    """Best-effort enable; returns True when active."""
+    """Best-effort enable; returns True when active.
+
+    Refuses under pytest (unless TEST_XLA_CACHE=1): in-process CLI tests
+    would otherwise switch the persistent cache on mid-suite and every
+    later test in that worker writes/reads .jax_cache — concurrent access
+    corrupts entries and jax SEGFAULTS (not raises) touching one, which is
+    exactly the cumulative-state crash that killed full-suite runs."""
+    if (os.environ.get("PYTEST_CURRENT_TEST")
+            and os.environ.get("TEST_XLA_CACHE") != "1"):
+        return False
     try:
         jax.config.update("jax_compilation_cache_dir",
                           cache_dir or _DEFAULT_DIR)

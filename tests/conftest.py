@@ -47,6 +47,17 @@ if os.environ.get("TEST_XLA_CACHE") == "1":
     enable_compilation_cache()
 
 
+@pytest.fixture(autouse=True)
+def _no_persistent_cache_leak():
+    """Belt to cache.py's suspenders: if any test path switched the
+    persistent cache on (in-process CLI invocations), reset it before the
+    next test so one test's config can't segfault a later compile."""
+    if os.environ.get("TEST_XLA_CACHE") != "1":
+        if jax.config.jax_compilation_cache_dir is not None:
+            jax.config.update("jax_compilation_cache_dir", None)
+    yield
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from ai_crypto_trader_tpu.parallel import make_mesh

@@ -1,0 +1,50 @@
+"""initialize_distributed smoke test (VERDICT r3 weak #7).
+
+Exercises the single-process coordinator path in a SUBPROCESS:
+jax.distributed.initialize mutates process-global state (and would pin
+the suite's backend), so the probe runs isolated — exactly how a
+single-host deployment would call it.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+CODE = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ai_crypto_trader_tpu.parallel.mesh import initialize_distributed
+# single-process coordinator: this process is both coordinator and worker
+initialize_distributed(coordinator="127.0.0.1:{port}",
+                       num_processes=1, process_id=0)
+assert jax.process_count() == 1
+assert jax.process_index() == 0
+# collectives still work after distributed bring-up
+import jax.numpy as jnp
+out = jax.jit(lambda x: x * 2)(jnp.ones(4))
+assert float(out.sum()) == 8.0
+print("DIST_OK")
+"""
+
+
+@pytest.mark.slow
+def test_single_process_coordinator_smoke():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=(f"{repo_root}:{existing}" if existing
+                           else repo_root))
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never dial the TPU from a test
+    r = subprocess.run([sys.executable, "-c", CODE.format(port=port)],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DIST_OK" in r.stdout

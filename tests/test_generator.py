@@ -21,7 +21,10 @@ from ai_crypto_trader_tpu.strategy.generator import (
 
 @pytest.fixture(scope="module")
 def ohlcv():
-    return generate_ohlcv(n=6_000, seed=11)
+    # sized so search folds and the holdout tail stay in the hundreds of
+    # candles: the generation-loop tests compile a handful of scan shapes
+    # and this file was the suite's slowest at n=6000
+    return generate_ohlcv(n=4_000, seed=11)
 
 
 @pytest.fixture(scope="module")
@@ -145,8 +148,8 @@ class TestGenerationLoop:
             rules=(("divergence_detector", 0.2),),
             buy_threshold=0.6, sell_threshold=0.6, name="weak_seed")
         reg = ModelRegistry(path=str(tmp_path / "registry.json"))
-        gen = StrategyGenerator(registry=reg, cv_folds=2, pool_size=8,
-                                max_rounds=4, patience=2, seed=1)
+        gen = StrategyGenerator(registry=reg, cv_folds=2, pool_size=6,
+                                max_rounds=3, patience=2, seed=3)
         out = asyncio.run(gen.generate(ohlcv, seed_structure=weak_seed))
 
         assert out["cv_sharpe"] >= out["seed_cv_sharpe"]
