@@ -292,6 +292,80 @@ def _explanations_html(explanations: list) -> str:
             + "".join(items) + "</div>")
 
 
+def _news_html(aggregate: dict | None, recent: list | None) -> str:
+    """News panel (the reference's news feed + sentiment summary,
+    `dashboard.py:91-99` news channel subscription and its rendered feed):
+    impact-weighted aggregate header plus the recent-headline list with
+    per-article direction/impact."""
+    parts = []
+    colors = {"bullish": "#2d5", "bearish": "#e55", "neutral": "#999"}
+    if aggregate:
+        direction = str(aggregate.get("direction", "neutral"))
+        topics = ", ".join(aggregate.get("top_topics") or []) or "—"
+        parts.append(
+            f"<p><span style='color:{colors.get(direction, '#999')}'>"
+            f"{html.escape(direction)}</span> · sentiment "
+            f"{float(aggregate.get('sentiment') or 0.0):+.2f} · impact "
+            f"{float(aggregate.get('market_impact') or 0.0):.2f} · "
+            f"{int(aggregate.get('n_articles') or 0)} articles · topics: "
+            f"{html.escape(topics)}</p>")
+    for a in (recent or [])[-8:][::-1]:
+        direction = str(a.get("direction", "neutral"))
+        parts.append(
+            f"<p style='margin:3px 0;font-size:12px'>"
+            f"<span style='color:{colors.get(direction, '#999')}'>●</span> "
+            f"{html.escape(str(a.get('title', ''))[:120])} "
+            f"<span style='color:#777'>impact "
+            f"{float(a.get('market_impact') or 0.0):.2f}</span></p>")
+    if not parts:
+        return ""
+    return "<div class='card'><h3>News</h3>" + "".join(parts) + "</div>"
+
+
+def _patterns_html(signal: dict | None, report: dict | None) -> str:
+    """Pattern-signal panel (the reference subscribes `pattern_signals` and
+    renders the recognition feed, `dashboard.py:91-99` + pattern panels):
+    the symbol's latest actionable signal plus the combined report's
+    per-symbol feed and summary counts."""
+    parts = []
+    colors = {"buy": "#2d5", "sell": "#e55", "neutral": "#999"}
+    if signal and signal.get("signal", "neutral") != "neutral":
+        sig = str(signal.get("signal"))
+        parts.append(
+            f"<p><span style='color:{colors.get(sig, '#999')}'>"
+            f"{html.escape(sig.upper())}</span> "
+            f"{html.escape(str(signal.get('pattern', '?')))} "
+            f"({html.escape(str(signal.get('signal_strength', '')))}, "
+            f"strength {float(signal.get('strength') or 0.0):.2f}, "
+            f"completion {float(signal.get('completion') or 0.0):.0f}%)</p>")
+        confirmation = signal.get("confirmation")
+        if confirmation:
+            parts.append(f"<p style='color:#777;font-size:12px'>confirm: "
+                         f"{html.escape(str(confirmation))}</p>")
+    if report:
+        summary = report.get("summary") or {}
+        if summary:
+            parts.append(
+                f"<p style='font-size:12px'>bullish "
+                f"{summary.get('bullish_patterns', 0)} · bearish "
+                f"{summary.get('bearish_patterns', 0)} · neutral "
+                f"{summary.get('neutral_patterns', 0)}</p>")
+        rows = {}
+        for sym, s in (report.get("signals") or {}).items():
+            rows[sym] = (f"{s.get('signal', '?')} {s.get('pattern', '')} "
+                         f"({float(s.get('strength') or 0.0):.2f})")
+        if rows:
+            body = "".join(
+                f"<tr><td>{html.escape(str(k))}</td>"
+                f"<td style='text-align:right'>{html.escape(v)}</td></tr>"
+                for k, v in rows.items())
+            parts.append(f"<table>{body}</table>")
+    if not parts:
+        return ""
+    return ("<div class='card'><h3>Pattern signals</h3>"
+            + "".join(parts) + "</div>")
+
+
 def _table(rows: dict, title: str) -> str:
     body = "".join(
         f"<tr><td>{html.escape(str(k))}</td>"
@@ -392,6 +466,29 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
         expl = bus.get("explanations")
         if expl:                                  # dashboard.py:1937
             sections.append(_explanations_html(expl))
+        # --- social / news / pattern feeds (reference dashboard.py:91-99
+        # subscribes social_updates, news and pattern_signals channels) ---
+        if symbol:
+            sh = bus.get(f"social_history_{symbol}")
+            if sh and len(sh) >= 2:               # sentiment time series
+                sections.append(_svg_line(
+                    [row[1] for row in sh], height=80,
+                    label=f"social sentiment {symbol}", color="#4af"))
+            soc = bus.get(f"social_metrics_{symbol}")
+            if soc:                               # latest source breakdown
+                sections.append(_table(
+                    {k: v for k, v in soc.items()
+                     if isinstance(v, (int, float, str, bool))},
+                    "Social metrics"))
+            news_panel = _news_html(bus.get(f"news_analysis_{symbol}"),
+                                    bus.get(f"news_recent_{symbol}"))
+            if news_panel:
+                sections.append(news_panel)
+        pattern_panel = _patterns_html(
+            bus.get(f"pattern_signals_{symbol}") if symbol else None,
+            bus.get("pattern_analysis_report"))
+        if pattern_panel:
+            sections.append(pattern_panel)
     if signals:
         rows = {f"{s.get('symbol')} @ {s.get('timestamp', 0):.0f}":
                 f"{s.get('decision')} ({s.get('confidence', 0):.2f})"

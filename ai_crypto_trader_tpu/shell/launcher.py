@@ -295,7 +295,7 @@ class TradingSystem:
         klines = self.bus.get(f"historical_data_{sym}_1m") or []
         prices = [row[4] for row in klines] if klines else None
         write_dashboard(self.dashboard_path, bus=self.bus,
-                        price_series=prices,
+                        price_series=prices, symbol=sym,
                         alerts=list(self.alerts.active.values()),
                         now_fn=self.now_fn)
 

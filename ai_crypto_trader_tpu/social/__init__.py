@@ -8,6 +8,8 @@ from ai_crypto_trader_tpu.social.analyzer import (  # noqa: F401
 )
 from ai_crypto_trader_tpu.social.news import (  # noqa: F401
     NewsAnalyzer,
+    NewsService,
+    deterministic_news_provider,
     lexicon_sentiment,
 )
 from ai_crypto_trader_tpu.social.service import SocialMonitorService  # noqa: F401

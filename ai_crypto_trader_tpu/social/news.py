@@ -74,6 +74,13 @@ TOPIC_KEYWORDS = {
 _WORD = re.compile(r"[a-z$][a-z0-9$]*")
 
 
+def _direction(compound: float) -> str:
+    """Single source of truth for the ±0.05 direction thresholds (used for
+    both per-article and aggregate direction)."""
+    return ("bullish" if compound > 0.05 else
+            "bearish" if compound < -0.05 else "neutral")
+
+
 def lexicon_sentiment(text: str) -> dict:
     """Compound ∈ [-1,1] + pos/neg/neu fractions — VADER-shaped output
     (`news_analyzer.py:409-501`)."""
@@ -174,8 +181,7 @@ class NewsAnalyzer:
             "sentiment": sent, "entities": entities, "topics": topics,
             "summary": summarize(text), "relevance": relevance,
             "recency": recency, "market_impact": impact,
-            "direction": ("bullish" if sent["compound"] > 0.05 else
-                          "bearish" if sent["compound"] < -0.05 else "neutral"),
+            "direction": _direction(sent["compound"]),
         }
 
     def aggregate(self, articles: list[dict], symbol_asset: str | None = None) -> dict:
@@ -183,7 +189,7 @@ class NewsAnalyzer:
         analyzer service publishes per symbol."""
         if not articles:
             return {"sentiment": 0.0, "n_articles": 0, "top_topics": [],
-                    "market_impact": 0.0}
+                    "market_impact": 0.0, "direction": "neutral"}
         analyses = [self.analyze_article(a, symbol_asset) for a in articles]
         weights = [a["market_impact"] for a in analyses]
         total_w = sum(weights) or 1.0
@@ -199,5 +205,106 @@ class NewsAnalyzer:
             "top_topics": sorted(topic_counts, key=topic_counts.get,
                                  reverse=True)[:3],
             "market_impact": max(weights),
+            "direction": _direction(sentiment),
             "analyses": analyses,
         }
+
+
+# ---------------------------------------------------------------------------
+# Bus-facing service (NewsAnalysisService parity)
+# ---------------------------------------------------------------------------
+
+def deterministic_news_provider(bus, symbol: str) -> list[dict]:
+    """Offline stand-in source: synthesizes headline dicts from recent price
+    action on the bus, so the full analyze→publish pipeline runs without the
+    reference's CryptoPanic/RSS network fetchers
+    (`services/news_analysis_service.py:144-370` — source I/O is the
+    injected boundary, exactly like the social provider)."""
+    md = bus.get(f"market_data_{symbol}")
+    if not md:
+        return []
+    from ai_crypto_trader_tpu.utils.symbols import base_asset
+
+    asset = base_asset(symbol)
+    names: dict[str, str] = {}
+    for k, v in KNOWN_ASSETS.items():    # first alias is the full name
+        names.setdefault(v, k)
+    name = names.get(asset, asset).capitalize()
+    chg = float(md.get("price_change_15m", 0.0))
+    price = float(md.get("current_price", 0.0))
+    ts = float(md.get("timestamp", 0.0))
+    if chg >= 1.0:
+        title = f"{name} surges {chg:.1f}% as momentum builds"
+    elif chg >= 0.2:
+        title = f"{name} posts steady gains amid growing adoption"
+    elif chg <= -1.0:
+        title = f"{name} drops {abs(chg):.1f}% in sudden selloff"
+    elif chg <= -0.2:
+        title = f"{name} declines as traders book profit"
+    else:
+        title = f"{name} trades flat near {price:,.0f}"
+    return [{"title": title,
+             "body": f"{name} ({asset}) moved {chg:+.2f}% over the last 15 "
+                     f"minutes to {price:,.2f}.",
+             "published_at": ts, "source": "synthetic"}]
+
+
+@dataclass
+class NewsService:
+    """News analysis as a launcher cadence service.
+
+    Capability parity with NewsAnalysisService's polling loop
+    (`services/news_analysis_service.py:98-143`: fetch per symbol on an
+    interval, analyze, publish to Redis for the dashboard's news panel and
+    the AI analyzer's context): polls the injected article provider,
+    aggregates with NewsAnalyzer, and publishes
+
+      news_analysis_{symbol}   impact-weighted aggregate (the key
+                               shell/analyzer.py already consumes)
+      news_recent_{symbol}     bounded per-article feed for the dashboard
+      news_updates             pub/sub channel (reference dashboard.py:91-99
+                               subscribes its news channel the same way)
+    """
+
+    bus: any
+    symbols: list[str] = field(default_factory=lambda: ["BTCUSDC"])
+    provider: any = None                 # callable(bus, symbol) -> articles
+    poll_interval_s: float = 600.0
+    history_len: int = 50
+    now_fn: any = time.time
+    name: str = "news"
+    _last: dict = field(default_factory=dict)
+
+    async def run_once(self) -> dict:
+        from ai_crypto_trader_tpu.utils.symbols import base_asset
+
+        provider = self.provider or deterministic_news_provider
+        analyzer = NewsAnalyzer(now_fn=self.now_fn)
+        published = 0
+        now = self.now_fn()
+        for symbol in self.symbols:
+            if now - self._last.get(symbol, -1e18) < self.poll_interval_s:
+                continue
+            articles = provider(self.bus, symbol)
+            if not articles:
+                continue
+            self._last[symbol] = now
+            agg = analyzer.aggregate(articles, base_asset(symbol))
+            analyses = agg.pop("analyses", [])
+            agg.update({"symbol": symbol, "timestamp": now})
+            recent = self.bus.get(f"news_recent_{symbol}") or []
+            for article, analysis in zip(articles, analyses):
+                recent.append({
+                    "title": article.get("title", ""),
+                    "source": article.get("source", ""),
+                    "published_at": article.get("published_at", now),
+                    "direction": analysis["direction"],
+                    "sentiment": analysis["sentiment"]["compound"],
+                    "market_impact": analysis["market_impact"],
+                    "topics": analysis["topics"],
+                })
+            self.bus.set(f"news_analysis_{symbol}", agg)
+            self.bus.set(f"news_recent_{symbol}", recent[-self.history_len:])
+            await self.bus.publish("news_updates", agg)
+            published += 1
+        return {"published": published}

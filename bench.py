@@ -22,31 +22,51 @@ The four other target rows print one JSON line each ahead of it:
   nn_train_step_ms        LSTM train step, batch 32 × seq 60 (the
                           reference's Keras budget, config.json:409-415)
 
-Population width defaults to 4096 (override: BENCH_POP); scan unroll is
-auto-tuned over {8, 12, 16, 24} on TPU (override: BENCH_UNROLL).
+Population width defaults to 4096 on TPU / 256 on CPU (override:
+BENCH_POP); scan unroll is auto-tuned over {8, 12, 16, 24} on TPU
+(override: BENCH_UNROLL).
 
-Robustness: the axon TPU plugin dials the chip through a relay; when the
-tunnel is down that dial HANGS (it does not error), and the driver runs
-this script without a timeout. The chip is therefore probed in a
-subprocess with a deadline, and on probe failure the benchmark re-execs
-onto the CPU backend (with PALLAS_AXON_POOL_IPS scrubbed so the
-sitecustomize can't re-dial) — the JSON lines are printed either way.
+Robustness (VERDICT r4 missing#1): the axon TPU plugin dials the chip
+through a relay; when the tunnel is down that dial HANGS (it does not
+error), and the driver runs this script under a finite capture budget.
+Round 4's probe-retry ladder (3 × 900 s) outlasted that budget and the
+artifact came back EMPTY.  This script is therefore split in two:
+
+  orchestrator (default)  never imports jax.  Budgeted by
+      BENCH_TOTAL_BUDGET (default 1500 s).  ONE bounded probe
+      (BENCH_TPU_PROBE_TIMEOUT, default 240 s); on success the TPU worker
+      runs with its output captured and re-printed whole.  On probe
+      failure the full CPU bench runs IMMEDIATELY as a streamed
+      subprocess — its rows land on stdout before any further chip
+      patience — and only if budget remains is the TPU probed once more.
+      Whatever happens, the LAST stdout line is a parseable headline row
+      (worst case: the measured pure-Python reference loop itself,
+      backend "host").
+
+  worker (--worker)       imports jax on whatever backend the env pins,
+      runs the suite, prints rows.  The headline is printed EARLY (right
+      after the replay sweep) and re-printed LAST, so a worker killed
+      mid-secondary-bench still leaves a parseable headline in the
+      captured output.
 """
 
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 
-# Per-attempt deadline stays at the old single-probe 900 s: a slow-but-alive
-# dial must not be killed early (a killed mid-dial process wedges the chip
-# grant for minutes — see .claude/skills/verify/SKILL.md). Retries EXTEND
-# total patience beyond one attempt; backoff outlasts the wedge window.
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "900"))
-PROBE_RETRIES = int(os.environ.get("BENCH_TPU_RETRIES", "3"))
+T0 = time.monotonic()
+
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET", "1500"))
+# First probe is short: a live relay dials in seconds; a dead one hangs
+# forever.  The old 900 s patience moved AFTER the CPU rows are safe (the
+# CPU bench itself is the grant-wedge cooldown before the second probe).
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
+HEADLINE_METRIC = "backtest_candles_per_sec_per_chip"
 
 # Set once the backend is known; stamped into every JSON row so the driver's
 # parsed result can distinguish a CPU-fallback run from the real chip
@@ -56,6 +76,14 @@ BACKEND = "unknown"
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def elapsed() -> float:
+    return time.monotonic() - T0
+
+
+def remaining() -> float:
+    return TOTAL_BUDGET_S - elapsed()
 
 
 def fetch(x) -> float:
@@ -83,48 +111,171 @@ def reference_cpu_candles_per_sec(inputs, n=200_000) -> float:
     return n / dt
 
 
-def _fallback_to_cpu(reason: str):
-    log(f"TPU unavailable ({reason}); falling back to CPU")
-    env = dict(os.environ, JAX_PLATFORMS="cpu", _BENCH_CPU_FALLBACK="1")
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize must not re-dial
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
-
-
-def probe_tpu() -> bool:
-    """Initialize the TPU backend in a throwaway subprocess with a deadline,
-    retrying with backoff — the axon relay demonstrably flaps (it carried a
-    measurement mid-session in r3, then was down at driver capture), so one
-    probe is not evidence the chip is gone for the whole run.
-
-    Each dial either succeeds (the grant is released on exit and the main
-    process re-acquires it in seconds), errors, or hangs past the deadline;
-    only the first case lets the in-process init proceed safely."""
-    code = "import jax; print(len(jax.devices()), jax.devices()[0].platform)"
-    for attempt in range(PROBE_RETRIES):
-        try:
-            r = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True,
-                               timeout=PROBE_TIMEOUT_S)
-            if r.returncode == 0:
-                log(f"probe ok (attempt {attempt + 1}): {r.stdout.strip()}")
-                return True
-            log(f"probe attempt {attempt + 1} rc={r.returncode}: "
-                f"{(r.stderr or '').strip()[-400:]}")
-        except subprocess.TimeoutExpired:
-            log(f"probe attempt {attempt + 1}: no dial in {PROBE_TIMEOUT_S:.0f}s")
-        if attempt + 1 < PROBE_RETRIES:
-            pause = min(120 * (attempt + 1), 360)
-            log(f"retrying in {pause}s (grant-wedge cooldown)")
-            time.sleep(pause)
-    return False
-
-
 def emit(metric, value, unit, vs_baseline=None, engine=None):
     row = {"metric": metric, "value": round(value, 3), "unit": unit,
            "vs_baseline": vs_baseline, "backend": BACKEND}
     if engine is not None:
         row["engine"] = engine
     print(json.dumps(row), flush=True)
+
+
+# --------------------------------------------------------------------------
+# orchestrator
+# --------------------------------------------------------------------------
+
+def probe_tpu(deadline_s: float) -> bool:
+    """Initialize the TPU backend in a throwaway subprocess with a deadline.
+
+    Each dial either succeeds (the grant is released on exit and the main
+    process re-acquires it in seconds), errors, or hangs past the deadline;
+    only the first case lets a TPU worker proceed safely."""
+    code = "import jax; print(len(jax.devices()), jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=deadline_s)
+        if r.returncode == 0 and "tpu" in r.stdout:
+            log(f"probe ok ({deadline_s:.0f}s deadline): {r.stdout.strip()}")
+            return True
+        log(f"probe rc={r.returncode}: {(r.stderr or r.stdout or '').strip()[-400:]}")
+    except subprocess.TimeoutExpired:
+        log(f"probe: no dial in {deadline_s:.0f}s")
+    return False
+
+
+def _worker_cmd():
+    return [sys.executable, os.path.abspath(__file__), "--worker"]
+
+
+def run_bench_worker(label: str, budget_s: float, *, cpu: bool) -> bool:
+    """Run the bench worker as a subprocess with stdout STREAMED
+    line-by-line — rows land on the driver's capture as they are produced
+    (VERDICT r4 next#1b: a kill of either process mid-run must leave every
+    row printed so far, the early headline included, on the artifact).
+    On completion the latest headline row is re-printed if a secondary row
+    landed after it, restoring the headline-last invariant.  Returns True
+    iff a headline row reached stdout."""
+    env = dict(os.environ, BENCH_WORKER_BUDGET=str(max(60.0, budget_s)))
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize must not re-dial
+    log(f"{label} worker: budget {budget_s:.0f}s")
+    p = subprocess.Popen(_worker_cmd(), stdout=subprocess.PIPE, text=True,
+                         env=env)
+    seen = {"headline": None, "last": None}
+
+    def pump():
+        for ln in p.stdout:
+            ln = ln.strip()
+            if not ln:
+                continue
+            seen["last"] = ln
+            try:
+                if json.loads(ln).get("metric") == HEADLINE_METRIC:
+                    seen["headline"] = ln
+            except ValueError:
+                pass
+            print(ln, flush=True)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        p.wait(timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        log(f"{label} worker killed at {budget_s:.0f}s budget")
+        p.kill()
+        p.wait()
+    t.join(timeout=10)
+    if seen["headline"] and seen["last"] != seen["headline"]:
+        print(seen["headline"], flush=True)
+    return seen["headline"] is not None
+
+
+def emergency_headline():
+    """Absolute floor: measure the pure-Python reference loop itself (in a
+    scrubbed subprocess — the oracle's module imports jax, which must never
+    happen in the orchestrator while the axon env could dial) and print it
+    as the headline, vs_baseline 1.0 by construction.  Only reachable when
+    every jax worker failed — a parsed row with backend 'host' still beats
+    an empty artifact."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--emergency"],
+            env=env, timeout=max(30.0, min(180.0, remaining())))
+        if r.returncode == 0:
+            return
+    except subprocess.TimeoutExpired:
+        log("emergency subprocess timed out")
+    # truly last line of defense: a parseable row, even with no measurement
+    print(json.dumps({"metric": HEADLINE_METRIC, "value": 0.0,
+                      "unit": "candles/s/chip", "vs_baseline": None,
+                      "backend": "none", "engine": "failed"}), flush=True)
+
+
+def run_emergency():
+    """--emergency: time the scalar reference-loop oracle on synthetic
+    numpy inputs (no jax compute; its module import is CPU-safe here)."""
+    global BACKEND
+    BACKEND = "host"
+    rng = np.random.default_rng(0)
+    n = 20_000
+    close = 40_000.0 * np.exp(np.cumsum(rng.normal(0.0, 1e-3, n)))
+    signal = rng.integers(-1, 2, n).astype(np.float64)
+    inputs = (close, signal, rng.uniform(0.0, 100.0, n),
+              np.abs(rng.normal(0.01, 0.005, n)),
+              rng.uniform(1e4, 1e5, n), rng.uniform(0.0, 1.0, n), signal)
+    cps = reference_cpu_candles_per_sec(inputs, n=n)
+    emit(HEADLINE_METRIC, cps, "candles/s/chip", 1.0, engine="reference-loop")
+
+
+def orchestrate():
+    # The sitecustomize pins the platform to the TPU plugin whenever
+    # PALLAS_AXON_POOL_IPS is set, JAX_PLATFORMS notwithstanding — probe in
+    # both configurations that can dial the chip.
+    may_dial = (os.environ.get("PALLAS_AXON_POOL_IPS")
+                or os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"))
+    headline_out = False
+
+    if may_dial and probe_tpu(min(PROBE_TIMEOUT_S, max(30.0, remaining() - 300))):
+        # happy path: chip is live — spend the budget on TPU rows, keeping a
+        # slice back so a pathological worker still leaves time for a floor.
+        headline_out = run_bench_worker("TPU", max(60.0, remaining() - 120),
+                                        cpu=False)
+        if headline_out:
+            return
+        log("TPU worker produced no headline; falling back to CPU")
+
+    if remaining() > 90:
+        headline_out = run_bench_worker("CPU", max(60.0, remaining() - 60),
+                                        cpu=True)
+
+    # Second (long-patience) chip attempt, only with real budget left: the
+    # relay demonstrably flaps (r3: up mid-session, down at capture).  CPU
+    # rows are already on stdout, so a TPU headline printed after them
+    # simply supersedes the CPU one at the driver's final-line parse.
+    if may_dial and remaining() > 420:
+        if probe_tpu(min(600.0, remaining() - 360)):
+            headline_out = run_bench_worker(
+                "TPU", max(60.0, remaining() - 30), cpu=False) or headline_out
+
+    if not headline_out:
+        try:
+            emergency_headline()
+        except Exception as e:           # noqa: BLE001 — last line of defense
+            log(f"emergency headline failed ({type(e).__name__}: {e})")
+
+
+# --------------------------------------------------------------------------
+# worker benches
+# --------------------------------------------------------------------------
+
+def worker_budget() -> float:
+    return float(os.environ.get("BENCH_WORKER_BUDGET", "1e9"))
+
+
+def budget_left(reserve: float = 0.0) -> bool:
+    return elapsed() + reserve < worker_budget()
 
 
 def pallas_scan_parity(scan_stats, pallas_stats, T) -> bool:
@@ -156,8 +307,6 @@ def pallas_scan_parity(scan_stats, pallas_stats, T) -> bool:
 
 def bench_rl(ind):
     """BASELINE row: RL env steps/sec (target: parity with 1× A100)."""
-    import time
-
     import jax
 
     from ai_crypto_trader_tpu.rl import (
@@ -185,11 +334,8 @@ def bench_rl(ind):
 
 def bench_mc():
     """BASELINE row: Monte-Carlo 10k-path portfolio VaR."""
-    import time
-
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from ai_crypto_trader_tpu.mc import run_simulation
 
@@ -223,8 +369,6 @@ def bench_mc():
 
 def bench_nn():
     """BASELINE row: NN train step time (batch 32 × seq 60, LSTM-64)."""
-    import time
-
     import jax
     import jax.numpy as jnp
     import optax
@@ -272,8 +416,6 @@ def bench_nn():
 
 
 def _torch_cpu_lstm_step_ms(B, T, F, iters=30):
-    import time
-
     import torch
 
     torch.manual_seed(0)
@@ -310,8 +452,6 @@ def _torch_cpu_lstm_step_ms(B, T, F, iters=30):
 def bench_ga(arrays):
     """BASELINE row: GA population sweep with REAL backtest fitness (the
     reference's sequential evaluate loop, genetic_algorithm.py:119-133)."""
-    import time
-
     import jax
 
     from ai_crypto_trader_tpu.config import GAParams
@@ -337,18 +477,7 @@ def bench_ga(arrays):
     return n_backtests / dt, T_GA
 
 
-def main():
-    on_cpu = bool(os.environ.get("_BENCH_CPU_FALLBACK"))
-    # The sitecustomize pins the platform to the TPU plugin whenever
-    # PALLAS_AXON_POOL_IPS is set, JAX_PLATFORMS notwithstanding — probe in
-    # both configurations that can dial the chip.
-    may_dial = (os.environ.get("PALLAS_AXON_POOL_IPS")
-                or os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"))
-    if not on_cpu and may_dial:
-        if not probe_tpu():
-            _fallback_to_cpu(f"no successful dial in {PROBE_RETRIES} attempts "
-                             f"× {PROBE_TIMEOUT_S:.0f}s")
-
+def run_worker():
     import jax
 
     # persistent compilation cache: the 525k-candle graphs take minutes to
@@ -364,22 +493,21 @@ def main():
     from ai_crypto_trader_tpu.backtest import prepare_inputs, sample_params, sweep
     from ai_crypto_trader_tpu.data import generate_ohlcv
 
-    T = int(os.environ.get("BENCH_T", "525600"))   # 1 year of 1-minute candles
-    B = int(os.environ.get("BENCH_POP", "4096"))   # strategy population width
-    try:
-        devices = jax.devices()
-        log(f"devices: {devices}")
-    except RuntimeError as e:
-        if on_cpu:
-            raise
-        _fallback_to_cpu(str(e))
+    devices = jax.devices()
+    log(f"devices: {devices}")
 
     global BACKEND
     platform = devices[0].platform
     BACKEND = platform
+    on_cpu = platform == "cpu"
+
+    T = int(os.environ.get("BENCH_T", "525600"))   # 1 year of 1-minute candles
+    # population width: 4096 saturates the chip; 256 keeps the CPU fallback
+    # inside the driver budget on a 1-core box (VERDICT r4 next#1)
+    B = int(os.environ.get("BENCH_POP", "256" if on_cpu else "4096"))
     # VERDICT r2 weak#7: sweep the unroll grid on-chip (32 was measured 2×
     # slower than 8 on both backends; probe between instead)
-    unrolls = (8, 12, 16, 24) if platform not in ("cpu",) else (8,)
+    unrolls = (8,) if on_cpu else (8, 12, 16, 24)
     if os.environ.get("BENCH_UNROLL"):
         unrolls = (int(os.environ["BENCH_UNROLL"]),)
 
@@ -415,10 +543,25 @@ def main():
             f"{T*B/dt:,.0f} candles/s/chip (pop {B} × {T} candles)")
         if best_dt is None or dt < best_dt:
             best_dt, best_unroll = dt, unroll
+        if not budget_left(reserve=240):
+            log("worker budget low; stopping unroll sweep early")
+            break
 
     candles_per_sec = T * B / best_dt
     engine = "scan"
     log(f"best: unroll={best_unroll}, {candles_per_sec:,.0f} candles/s/chip")
+
+    ref_cps = reference_cpu_candles_per_sec(inp)
+    log(f"reference CPU loop: {ref_cps:,.0f} candles/s")
+
+    def emit_headline():
+        emit(HEADLINE_METRIC, candles_per_sec, "candles/s/chip",
+             round(candles_per_sec / ref_cps, 1), engine=engine)
+
+    # EARLY headline: a worker killed later (driver budget, flaky relay)
+    # still leaves a parseable row in the captured output; the orchestrator
+    # reorders it last.  It is re-emitted at the end with the final engine.
+    emit_headline()
 
     # Pallas replay kernel: VMEM-resident candle loop with no per-step XLA
     # dispatch (ops/pallas_backtest.py). TPU-only candidate; the scan path
@@ -426,12 +569,10 @@ def main():
     # the kernel may only win if it ALSO passes the full-shape on-chip
     # parity cross-check against the scan engine (VERDICT r3 weak#2: a fast
     # wrong answer must not become the headline).
-    if platform not in ("cpu",) and os.environ.get("BENCH_PALLAS", "1") == "1":
+    if not on_cpu and os.environ.get("BENCH_PALLAS", "1") == "1":
         try:
             from ai_crypto_trader_tpu.ops.pallas_backtest import sweep_pallas
 
-            # computed here (TPU-only branch) and fetched, so the dispatch
-            # can't run concurrently with the timed CPU baseline below
             scan_stats = sweep(inp, params, unroll=best_unroll)
             fetch(scan_stats.final_balance)
 
@@ -459,40 +600,38 @@ def main():
             log(f"pallas sweep unavailable ({type(e).__name__}: {e}); "
                 "keeping scan number")
 
-    ref_cps = reference_cpu_candles_per_sec(inp)
-    log(f"reference CPU loop: {ref_cps:,.0f} candles/s")
-
     # ---- the four other BASELINE target rows (one JSON line each; any
-    # failure degrades to a log line, never kills the headline) ------------
-    try:
+    # failure degrades to a log line, never kills the headline; each is
+    # skipped when the worker budget is nearly spent) ----------------------
+    def ga_row():
         ga_rate, t_ga = bench_ga(arrays)
         emit("ga_backtests_per_sec", ga_rate, "backtests/s",
              round(ga_rate / (ref_cps / t_ga), 1))
-    except Exception as e:                       # noqa: BLE001
-        log(f"ga bench unavailable ({type(e).__name__}: {e})")
-    try:
-        bench_rl(ind)
-    except Exception as e:                       # noqa: BLE001
-        log(f"rl bench unavailable ({type(e).__name__}: {e})")
-    try:
-        bench_mc()
-    except Exception as e:                       # noqa: BLE001
-        log(f"mc bench unavailable ({type(e).__name__}: {e})")
-    try:
-        bench_nn()
-    except Exception as e:                       # noqa: BLE001
-        log(f"nn bench unavailable ({type(e).__name__}: {e})")
+
+    secondary = [
+        ("ga", ga_row),
+        ("rl", lambda: bench_rl(ind)),
+        ("mc", bench_mc),
+        ("nn", bench_nn),
+    ]
+    for name, fn in secondary:
+        if not budget_left(reserve=90):
+            log(f"{name} bench skipped: worker budget nearly spent "
+                f"({elapsed():.0f}s of {worker_budget():.0f}s)")
+            continue
+        try:
+            fn()
+        except Exception as e:                   # noqa: BLE001
+            log(f"{name} bench unavailable ({type(e).__name__}: {e})")
 
     # headline LAST — the driver parses the final JSON line
-    print(json.dumps({
-        "metric": "backtest_candles_per_sec_per_chip",
-        "value": round(candles_per_sec, 1),
-        "unit": "candles/s/chip",
-        "vs_baseline": round(candles_per_sec / ref_cps, 1),
-        "backend": BACKEND,
-        "engine": engine,
-    }))
+    emit_headline()
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        run_worker()
+    elif "--emergency" in sys.argv:
+        run_emergency()
+    else:
+        orchestrate()
