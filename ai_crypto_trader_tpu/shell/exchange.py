@@ -30,10 +30,14 @@ def _interval_minutes(interval: str) -> int:
 
 def resample_klines(rows: list, factor: int) -> list:
     """Aggregate 1×-interval kline rows into factor×-interval bars (shared
-    by FakeExchange's interval support and the monitor's local fallback)."""
+    by FakeExchange's interval support and the monitor's local fallback).
+
+    The trailing chunk may be partial — it is the venue's in-progress bar
+    and is served as such (Binance includes the current incomplete candle);
+    callers that align chunk starts to absolute time get stable bar
+    boundaries across successive calls (round-4 advisor)."""
     out = []
-    usable = len(rows) - len(rows) % factor
-    for i in range(0, usable, factor):
+    for i in range(0, len(rows), factor):
         chunk = rows[i: i + factor]
         out.append([chunk[0][0], chunk[0][1],
                     max(r[2] for r in chunk), min(r[3] for r in chunk),
@@ -71,6 +75,29 @@ class ExchangeInterface(ABC):
         filled or canceled). Default pessimistically True for adapters that
         don't track state."""
         return True
+
+    def executed_qty(self, symbol: str, order_id: int,
+                     assumed_total: float, is_open: bool) -> float:
+        """Cumulative filled base quantity for one order.
+
+        The default degrades to all-or-nothing from open/closed state — an
+        adapter with real fill accounting MUST override: the default books
+        a venue-cancelled/expired/rejected order as fully filled (round-4
+        advisor), which fabricates inventory. `is_open` is the caller's
+        single per-tick status read, passed in so the default costs no
+        extra REST round-trip."""
+        return 0.0 if is_open else assumed_total
+
+    def order_state(self, symbol: str, order_id: int,
+                    assumed_total: float) -> dict:
+        """One combined per-tick status read: {"is_open", "executed_qty"}.
+        Reconcilers call THIS (one venue round-trip per order per tick on
+        adapters that override it); the default composes the two simpler
+        queries for adapters where reads are local."""
+        is_open = self.order_is_open(symbol, order_id)
+        return {"is_open": is_open,
+                "executed_qty": self.executed_qty(symbol, order_id,
+                                                  assumed_total, is_open)}
 
     def list_symbols(self, quote: str | None = None) -> list[str]:
         """All tradable symbols, optionally filtered to one quote asset —
@@ -153,6 +180,10 @@ class FakeExchange(ExchangeInterface):
         s = self.series[symbol]
         end = self.cursor[symbol] + 1
         start = max(end - limit * factor, 0)
+        # align chunk starts to absolute time so 3m/5m/15m bar boundaries
+        # are stable across ticks, like a real venue's fixed-boundary bars
+        # (round-4 advisor: sliding anchors made HTF histories jitter)
+        start -= start % factor
         rows = []
         for i in range(start, end):
             rows.append([int(s.timestamp[i]), float(s.open[i]), float(s.high[i]),
@@ -251,6 +282,11 @@ class FakeExchange(ExchangeInterface):
         runs reconcile every tracked order every tick)."""
         return list(self._fills_by_oid.get(order_id, ()))
 
+    def executed_qty(self, symbol: str, order_id: int,
+                     assumed_total: float, is_open: bool) -> float:
+        return float(sum(f["quantity"] for f in self.fills_for(order_id)
+                         if f.get("status") == "FILLED"))
+
     def get_balances(self) -> dict:
         return dict(self.balances)
 
@@ -294,6 +330,21 @@ class BinanceExchange(ExchangeInterface):
     def order_is_open(self, symbol, order_id):
         o = self.client.get_order(symbol=symbol, orderId=order_id)
         return o.get("status") in ("NEW", "PARTIALLY_FILLED")
+
+    def executed_qty(self, symbol, order_id, assumed_total, is_open):
+        """Binance's get_order returns executedQty for EVERY status —
+        including CANCELED/EXPIRED/REJECTED and partial fills — so live
+        reconciliation never books phantom inventory (round-4 advisor)."""
+        o = self.client.get_order(symbol=symbol, orderId=order_id)
+        return float(o.get("executedQty", 0.0))
+
+    def order_state(self, symbol, order_id, assumed_total):
+        """ONE get_order answers both questions — reconcilers polling
+        order_is_open + executed_qty separately would double the REST
+        volume through the rate limiter."""
+        o = self.client.get_order(symbol=symbol, orderId=order_id)
+        return {"is_open": o.get("status") in ("NEW", "PARTIALLY_FILLED"),
+                "executed_qty": float(o.get("executedQty", 0.0))}
 
     def get_balances(self):
         acct = self.client.get_account()
@@ -433,6 +484,14 @@ class ResilientExchange(ExchangeInterface):
 
     def order_is_open(self, symbol, order_id):
         return self._read(self.inner.order_is_open, symbol, order_id)
+
+    def executed_qty(self, symbol, order_id, assumed_total, is_open):
+        return self._read(self.inner.executed_qty, symbol, order_id,
+                          assumed_total, is_open)
+
+    def order_state(self, symbol, order_id, assumed_total):
+        return self._read(self.inner.order_state, symbol, order_id,
+                          assumed_total)
 
     # --- mutations: single attempt -----------------------------------------
     def place_order(self, symbol, side, order_type, quantity, price=None,

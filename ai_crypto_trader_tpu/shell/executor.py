@@ -96,6 +96,15 @@ class TradeExecutor:
         # see engine.reference_quirks docs), then socially adjusted
         sl_pct = float(np.asarray(plan.stop_loss_pct)) * 100.0 * social["stop_loss_factor"]
         tp_pct = float(np.asarray(plan.take_profit_pct)) * 100.0 * social["take_profit_factor"]
+        # Hot-swapped live params take precedence over the volatility sizer's
+        # exits: the evolver / generator publish `strategy_params` on the bus
+        # (`hot_swap_strategy`, strategy_evolution_service.py:349-362) and
+        # the reference executor reads the current strategy at entry time.
+        live = self.bus.get("strategy_params") or {}
+        if isinstance(live.get("stop_loss"), (int, float)):
+            sl_pct = float(live["stop_loss"]) * social["stop_loss_factor"]
+        if isinstance(live.get("take_profit"), (int, float)):
+            tp_pct = float(live["take_profit"]) * social["take_profit_factor"]
 
         order = self.exchange.place_order(symbol, "BUY", "MARKET",
                                           quantity=size / signal["current_price"])

@@ -148,8 +148,8 @@ class TechnicalPolicyBackend:
         return json.dumps({
             "decision": decision, "confidence": round(confidence, 3),
             "reasoning": reasoning, "risk_level": "MEDIUM",
-            "key_factors": [k for k in ("rsi", "macd", "bb_position")
-                            if k in ctx],
+            "key_indicators": [k for k in ("rsi", "macd", "bb_position")
+                               if k in ctx],
         })
 
     def _risk(self, ctx: dict) -> str:
@@ -242,7 +242,7 @@ class LLMTrader:
         prompt = self._format(
             template, _analysis_fields(market_data), market_data,
             "Analyze this trading opportunity and answer in JSON with "
-            "decision/confidence/reasoning/key_factors.")
+            "decision/confidence/reasoning/key_indicators.")
         try:
             out = self._safe_json(await self.complete(prompt))
         except Exception as e:                      # noqa: BLE001

@@ -11,6 +11,7 @@ from ai_crypto_trader_tpu.strategy.evolution import StrategyEvolver  # noqa: F40
 from ai_crypto_trader_tpu.strategy.registry import ModelRegistry  # noqa: F401
 from ai_crypto_trader_tpu.strategy.explain import explain_signal  # noqa: F401
 from ai_crypto_trader_tpu.strategy.generator import (  # noqa: F401
+    GeneratorService,
     StrategyGenerator,
     StrategyStructure,
 )

@@ -85,9 +85,11 @@ class DCAStrategy:
     @staticmethod
     def rebalance_orders(holdings: dict[str, float], prices: dict[str, float],
                          targets: dict[str, float],
-                         threshold_pct: float = 5.0) -> list[dict]:
+                         threshold_pct: float = 5.0,
+                         quote: str = "USDC") -> list[dict]:
         """`_rebalance_portfolio:864`: orders moving the portfolio toward
-        target weights when drift exceeds the threshold."""
+        target weights when drift exceeds the threshold. ``quote`` names
+        the venue's quote asset for the generated order symbols."""
         values = {a: holdings.get(a, 0.0) * prices[a] for a in targets}
         total = sum(values.values())
         if total <= 0:
@@ -99,7 +101,7 @@ class DCAStrategy:
             if abs(drift) >= threshold_pct:
                 delta_value = (target_w - current_w) * total
                 orders.append({
-                    "symbol": f"{asset}USDC",
+                    "symbol": f"{asset}{quote}",
                     "side": "BUY" if delta_value > 0 else "SELL",
                     "quantity": abs(delta_value) / prices[asset],
                 })

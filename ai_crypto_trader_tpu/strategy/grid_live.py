@@ -30,19 +30,6 @@ from ai_crypto_trader_tpu.strategy.grid import (
     GridTrader, auto_boundaries, REGIME_GRID_COUNTS)
 
 
-def _executed_qty(exchange, order_id: int, assumed_total: float,
-                  is_open: bool) -> float:
-    """Cumulative filled base quantity for one order.
-
-    Prefers the fills ledger (FakeExchange.fills_for); degrades to
-    all-or-nothing on exchanges exposing only open/closed state.
-    `is_open` is the caller's single per-tick status read — no duplicate
-    REST round-trip through the rate limiter."""
-    fills_for = getattr(exchange, "fills_for", None)
-    if fills_for is not None:
-        return float(sum(f["quantity"] for f in fills_for(order_id)
-                         if f.get("status") == "FILLED"))
-    return 0.0 if is_open else assumed_total
 
 
 @dataclass
@@ -126,8 +113,8 @@ class GridTraderService:
 
         fills = {"buy": 0, "sell": 0}
         for oid, rec in list(self.orders.items()):
-            is_open = self.exchange.order_is_open(self.symbol, oid)
-            done = _executed_qty(self.exchange, oid, rec["qty"], is_open)
+            st = self.exchange.order_state(self.symbol, oid, rec["qty"])
+            is_open, done = st["is_open"], st["executed_qty"]
             newly = done - rec["filled"]
             if newly > 1e-12:
                 rec["filled"] = done
@@ -188,8 +175,8 @@ class GridTraderService:
         # already-sold quantity must not be re-listed as inventory).
         inventory = 0.0
         for oid, rec in list(self.orders.items()):
-            is_open = self.exchange.order_is_open(self.symbol, oid)
-            done = _executed_qty(self.exchange, oid, rec["qty"], is_open)
+            st = self.exchange.order_state(self.symbol, oid, rec["qty"])
+            is_open, done = st["is_open"], st["executed_qty"]
             newly = done - rec["filled"]
             if rec["side"] == "BUY":
                 # bought but never paired with a SELL → carry it
@@ -291,22 +278,43 @@ class DCAService:
 
     def _rebalance(self) -> int:
         """Execute the drift orders through the exchange
-        (`_rebalance_portfolio:864` — the reference computes AND places)."""
+        (`_rebalance_portfolio:864` — the reference computes AND places).
+
+        The quote asset comes from the configured DCA symbol — a
+        USDT-quoted deployment must price against USDT (round-4 advisor:
+        a hardcoded USDC quote raised on every non-USDC venue). A single
+        unpriceable asset drops out of this round's rebalance instead of
+        killing the whole service tick."""
+        from ai_crypto_trader_tpu.utils.symbols import QUOTE_ASSETS, quote_asset
+
+        quote = quote_asset(self.dca.symbol)
         balances = self.exchange.get_balances()
         prices = {}
         for asset in self.rebalance_targets:
-            if asset in ("USDC", "USDT"):
+            if asset in QUOTE_ASSETS:
                 prices[asset] = 1.0
             else:
-                prices[asset] = self.exchange.get_ticker(
-                    f"{asset}USDC")["price"]
+                try:
+                    prices[asset] = self.exchange.get_ticker(
+                        f"{asset}{quote}")["price"]
+                except Exception:      # noqa: BLE001 — unknown symbol etc.
+                    continue
+        targets = {a: w for a, w in self.rebalance_targets.items()
+                   if a in prices}
+        # renormalize after dropping unpriceable assets: raw weights
+        # summing <1 against a fully-priced total would read every other
+        # asset as overweight and spuriously SELL it each round
+        weight_sum = sum(targets.values())
+        if not targets or weight_sum <= 0:
+            return 0
+        targets = {a: w / weight_sum for a, w in targets.items()}
         orders = self.dca.rebalance_orders(
-            {a: balances.get(a, 0.0) for a in self.rebalance_targets},
-            prices, self.rebalance_targets,
-            threshold_pct=self.rebalance_threshold_pct)
+            {a: balances.get(a, 0.0) for a in targets},
+            prices, targets, threshold_pct=self.rebalance_threshold_pct,
+            quote=quote)
         placed = 0
         for o in orders:
-            if o["symbol"].startswith(("USDC", "USDT")):
+            if o["symbol"].startswith(tuple(QUOTE_ASSETS)):
                 continue               # quote legs rebalance implicitly
             r = self.exchange.place_order(o["symbol"], o["side"], "MARKET",
                                           quantity=o["quantity"])

@@ -63,12 +63,21 @@ class ModelRegistry:
             sims.append(1.0 - min(abs(va - vb) / scale, 1.0))
         return float(np.mean(sims))
 
-    def register(self, kind: str, payload: dict, metadata: dict | None = None) -> str:
+    def register(self, kind: str, payload: dict, metadata: dict | None = None,
+                 *, similarity_threshold: float | None = None) -> str:
         """Returns the version id; near-duplicates return the existing id
-        instead of creating noise versions."""
+        instead of creating noise versions.
+
+        ``similarity_threshold`` overrides the instance default for this
+        call: adopted structure-search improvements pass 1.0 (exact-dup
+        only) because a small-delta improvement that cleared its adoption
+        gate must get its OWN version — at 0.9 its performance would be
+        attached to the older near-identical payload (round-4 advisor)."""
+        thr = (self.similarity_threshold if similarity_threshold is None
+               else similarity_threshold)
         for vid, e in self.entries.items():
             if (e["kind"] == kind
-                    and self._similarity(e["payload"], payload) >= self.similarity_threshold):
+                    and self._similarity(e["payload"], payload) >= thr):
                 return vid
         vid = str(uuid.uuid4())[:8]
         self.entries[vid] = {

@@ -101,10 +101,17 @@ class StrategySelector:
         if hour_of_day is not None:
             hourly = strategy.get("hourly_performance")
             if hourly is None:
-                # cache on the strategy dict: trades only change when one
-                # closes, and the selector re-scores every cycle
-                hourly = hourly_performance(strategy.get("trades", []))
-                strategy["hourly_performance"] = hourly
+                # derived profile, cached keyed by trade count: it only
+                # changes when a trade closes, and the selector re-scores
+                # every cycle — an unkeyed cache went stale at exactly
+                # that moment (r4 advisor)
+                n_trades = len(strategy.get("trades", []))
+                cached = strategy.get("_hourly_cache")
+                if cached is not None and cached[0] == n_trades:
+                    hourly = cached[1]
+                else:
+                    hourly = hourly_performance(strategy.get("trades", []))
+                    strategy["_hourly_cache"] = (n_trades, hourly)
             perf = hourly.get(str(int(hour_of_day)), {})
             count = perf.get("trade_count", 0)
             if count >= 10:              # enough data (:733)

@@ -200,3 +200,19 @@ def test_social_news_pattern_panels_render_live():
     assert "News" in page                           # news feed card
     assert "Bitcoin" in page                        # provider headline
     assert "Pattern signals" in page                # pattern feed card
+
+
+def test_overlay_rsi_matches_ops_kernel():
+    """VERDICT r4 weak#7: the chart's display RSI must agree with the
+    published `rsi` columns from ops/indicators (Wilder smoothing)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.ops.indicators import rsi
+    from ai_crypto_trader_tpu.shell.dashboard import chart_overlays
+
+    closes = np.asarray(generate_ohlcv(n=300, seed=2)["close"], np.float64)
+    ours = chart_overlays(closes)["rsi"]
+    theirs = np.asarray(rsi(jnp.asarray(closes)))
+    np.testing.assert_allclose(ours[20:], theirs[20:], rtol=1e-3, atol=1e-2)
