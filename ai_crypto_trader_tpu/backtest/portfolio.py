@@ -62,6 +62,7 @@ class SharedCarry(NamedTuple):
     per-symbol realized P&L / trade counts."""
 
     balance: jnp.ndarray       # scalar f32 — the shared capital pool
+    last_booked: jnp.ndarray   # balance at the last booked equity point
     n_open: jnp.ndarray        # scalar i32 — open slots used (global cap)
     in_pos: jnp.ndarray        # [S] bool
     entry: jnp.ndarray         # [S]
@@ -114,9 +115,32 @@ def _shared_close(c: SharedCarry, s: int, price, do_close) -> SharedCarry:
     )
 
 
+def _book_equity(c: SharedCarry, book, baseline) -> SharedCarry:
+    """Book one equity/return/drawdown point where ``book`` (traced bool):
+    return measured vs ``baseline`` (the last booked balance for the
+    reference's per-update cadence; the candle-open balance per-candle)."""
+    equity = c.balance
+    max_eq = jnp.where(book, jnp.maximum(c.max_equity, equity), c.max_equity)
+    dd = max_eq - equity
+    dd_pct = dd / max_eq * 100.0
+    new_max = book & (dd > c.max_dd)
+    r = jnp.where(book, (equity - baseline) / baseline, 0.0)
+    return c._replace(
+        last_booked=jnp.where(book, equity, c.last_booked),
+        max_equity=max_eq,
+        max_dd=jnp.where(new_max, dd, c.max_dd),
+        max_dd_pct=jnp.where(new_max, dd_pct, c.max_dd_pct),
+        sum_r=c.sum_r + r,
+        sum_r2=c.sum_r2 + r * r,
+        sum_neg_r2=c.sum_neg_r2 + jnp.where(r < 0, r * r, 0.0),
+        n_r=c.n_r + book.astype(jnp.int32),
+    )
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("max_positions", "warmup", "use_param_sl_tp", "unroll"),
+    static_argnames=("max_positions", "warmup", "use_param_sl_tp", "unroll",
+                     "equity_cadence"),
 )
 def shared_capital_backtest(
     inputs: BacktestInputs,
@@ -128,6 +152,7 @@ def shared_capital_backtest(
     warmup: int = 10,
     use_param_sl_tp: bool = False,
     unroll: int = 1,
+    equity_cadence: str = "per_update",
 ):
     """Multi-symbol replay over ONE capital pool with a global position cap.
 
@@ -148,8 +173,15 @@ def shared_capital_backtest(
         same candle (matching the single-symbol engine);
       * entries are sized by `sig.position_size` on the RUNNING shared
         balance and admitted only while ``n_open < max_positions``;
-      * one equity point per active candle on the realized balance (the
-        single-symbol 'continue' short-circuit has no portfolio analog);
+      * equity cadence (VERDICT r4 weak#6, reconciled):
+        ``"per_update"`` (default) books one equity/return/drawdown point
+        per symbol-update exactly like the reference loop — skipped while
+        that symbol still holds a position after exits or when the slot
+        cap is reached (`strategy_tester.py:220-225` ``continue`` before
+        the booking at `:280-300`), with returns measured against the
+        PREVIOUSLY BOOKED balance; ``"per_candle"`` books once per active
+        candle on the realized balance (the previous behavior, kept for
+        comparison);
       * at the end every open slot is liquidated at its last close, in
         symbol order.
 
@@ -157,11 +189,13 @@ def shared_capital_backtest(
     straight-line code per scan step — exact sequential semantics with no
     nested while-loop dispatch. vmap over ``params`` for population sweeps.
     """
+    if equity_cadence not in ("per_update", "per_candle"):
+        raise ValueError(f"unknown equity_cadence {equity_cadence!r}")
     S, T = inputs.close.shape
     f = lambda v: jnp.asarray(v, jnp.float32)
     i = lambda v: jnp.asarray(v, jnp.int32)
     init = SharedCarry(
-        balance=f(initial_balance), n_open=i(0),
+        balance=f(initial_balance), last_booked=f(initial_balance), n_open=i(0),
         in_pos=jnp.zeros((S,), bool), entry=jnp.zeros((S,), jnp.float32),
         qty=jnp.zeros((S,), jnp.float32), sl=jnp.zeros((S,), jnp.float32),
         tp=jnp.zeros((S,), jnp.float32),
@@ -190,11 +224,13 @@ def shared_capital_backtest(
             hit_tp = active & c.in_pos[s] & ~hit_sl & (pnl_pct >= c.tp[s])
             c = _shared_close(c, s, close[s], hit_sl | hit_tp)
 
+            # the reference 'continue's past the booking when the symbol
+            # still holds after exits or the slot cap binds (:220-225)
+            reaches_booking = active & ~c.in_pos[s] & (c.n_open < max_positions)
+
             # --- entry gate: shared balance + global slot cap ---
             gate = (
-                active
-                & ~c.in_pos[s]
-                & (c.n_open < max_positions)
+                reaches_booking
                 & (conf[s] >= ai_confidence_threshold)
                 & (strength[s] >= min_signal_strength)
                 & (signal[s] == decision[s])
@@ -219,23 +255,14 @@ def shared_capital_backtest(
                 tp=c.tp.at[s].set(jnp.where(gate, tp_new, c.tp[s])),
             )
 
-        # --- one equity point per active candle, realized balance ---
-        equity = c.balance
-        max_eq = jnp.where(active, jnp.maximum(c.max_equity, equity),
-                           c.max_equity)
-        dd = max_eq - equity
-        dd_pct = dd / max_eq * 100.0
-        new_max = active & (dd > c.max_dd)
-        r = jnp.where(active, (equity - prev_balance) / prev_balance, 0.0)
-        c = c._replace(
-            max_equity=max_eq,
-            max_dd=jnp.where(new_max, dd, c.max_dd),
-            max_dd_pct=jnp.where(new_max, dd_pct, c.max_dd_pct),
-            sum_r=c.sum_r + r,
-            sum_r2=c.sum_r2 + r * r,
-            sum_neg_r2=c.sum_neg_r2 + jnp.where(r < 0, r * r, 0.0),
-            n_r=c.n_r + active.astype(jnp.int32),
-        )
+            if equity_cadence == "per_update":
+                # reference booking (:280-300): one point per update that
+                # reached it, vs the previously BOOKED balance
+                c = _book_equity(c, reaches_booking, c.last_booked)
+
+        if equity_cadence == "per_candle":
+            # one equity point per active candle, vs the candle-open balance
+            c = _book_equity(c, active, prev_balance)
         return c, None
 
     final, _ = lax.scan(step, init, xs, unroll=unroll)

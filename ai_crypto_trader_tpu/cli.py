@@ -289,6 +289,16 @@ def cmd_trade(args):
     system = TradingSystem(ex, [args.symbol], now_fn=lambda: clock["t"],
                            dashboard_path=args.dashboard,
                            log_path=os.environ.get("LOG_PATH"))
+    if args.full_stack:
+        from ai_crypto_trader_tpu.shell.stack import build_full_stack
+        from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+        registry = ModelRegistry(path=args.registry)
+        system.registry = registry
+        names = [s.name for s in build_full_stack(
+            system, registry=registry, grid_symbol=args.symbol,
+            dca_symbol=args.symbol)]
+        print(f"full stack: {', '.join(names)}", flush=True)
 
     server = None
     if args.serve is not None:
@@ -446,6 +456,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--serve", type=int, default=None, metavar="PORT",
                     help="serve the LIVE dashboard on this port during the "
                          "run (reference dashboard.py :8050 behavior)")
+    sp.add_argument("--full-stack", action="store_true",
+                    help="register the reference's full service roster "
+                         "(social/news/patterns/regime/NN/evolver/"
+                         "generator/grid/DCA) on the paper loop")
+    sp.add_argument("--registry", default="models/registry.json",
+                    help="model-registry file for --full-stack versioning")
     sp.add_argument("--serve-hold-s", type=float, default=0.0,
                     help="keep serving this many seconds after the ticks")
     sp.set_defaults(fn=cmd_trade)

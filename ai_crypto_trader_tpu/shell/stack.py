@@ -1,0 +1,192 @@
+"""Full-service assembly: the reference launcher's complete service roster.
+
+`run_trader.py:1326-1494` starts ~14 services in daemon threads (monitor,
+analyzer, executor, social, news, patterns, regime, NN, evolution, grid,
+DCA, risk, registry, dashboard).  TradingSystem carries the live signal
+path + risk/alerts/metrics natively; everything else is a cadence service
+(`.name` / `async run_once()`).  This module provides the two adapters the
+roster still lacked — a periodic evolver and a regime cadence — and
+`build_full_stack`, which registers the whole roster on a TradingSystem
+(used by the CLI's paper mode and the long-run soak test).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class EvolverService:
+    """Periodic strategy evolution (the continuously-scheduled loop of
+    `services/strategy_evolution_service.py:1571-1650`: monitor performance
+    on a cadence, evolve when warranted, hot-swap the result).
+
+    `StrategyEvolver.evolve` already performs dispatch → optimize → regime
+    adjust → registry version → hot swap; this adapter feeds it live bus
+    state: recent klines, the current regime, live params (seeded from the
+    hot-swap surface so successive evolutions compound), and the executor's
+    realized metrics when published."""
+
+    bus: object
+    evolver: object                    # strategy.evolution.StrategyEvolver
+    symbol: str = "BTCUSDC"
+    interval: str = "1m"
+    interval_s: float = 3600.0
+    min_candles: int = 128
+    now_fn: object = time.time
+    name: str = "evolver"
+    history: list = field(default_factory=list)
+    _last: float = -1e18
+
+    def _current_params(self):
+        from ai_crypto_trader_tpu.backtest.strategy import (
+            StrategyParams, clamp_params, default_params)
+
+        d = default_params()._asdict()
+        live = self.bus.get("strategy_params") or {}
+        d.update({k: float(v) for k, v in live.items()
+                  if k in d and isinstance(v, (int, float))})
+        return clamp_params(StrategyParams(**d))
+
+    async def run_once(self) -> dict:
+        now = self.now_fn()
+        if now - self._last < self.interval_s:
+            return {"ran": False}
+        rows = self.bus.get(f"historical_data_{self.symbol}_{self.interval}")
+        # drop the venue's in-progress last bar (same rule as
+        # GeneratorService._accumulate) — GA/RL fitness must not see a
+        # phantom near-empty candle
+        rows = (rows or [])[:-1]
+        if len(rows) < self.min_candles:
+            return {"ran": False, "reason": "insufficient_history"}
+        self._last = now
+        cols = np.asarray([r[1:6] for r in rows], np.float64)
+        ohlcv = {"open": cols[:, 0], "high": cols[:, 1], "low": cols[:, 2],
+                 "close": cols[:, 3], "volume": cols[:, 4]}
+        regime = (self.bus.get(f"market_regime_{self.symbol}")
+                  or self.bus.get("market_regime") or {}).get("regime",
+                                                             "ranging")
+        metrics = self.bus.get("strategy_metrics")
+        out = await self.evolver.evolve(
+            ohlcv, current=self._current_params(), metrics=metrics,
+            regime=regime, history_length=len(self.history))
+        self.history.append({"at": now, "evolved": out.get("evolved"),
+                             "method": out.get("method"),
+                             "version": out.get("version")})
+        return {"ran": True, **{k: out[k] for k in ("evolved",)
+                                if k in out}}
+
+
+@dataclass
+class RegimeCadence:
+    """Drives MarketRegimeService.update per symbol on an interval (its
+    reference runs a collector+detector loop,
+    `services/market_regime_service.py` scheduled updates)."""
+
+    svc: object                        # regime.service.MarketRegimeService
+    symbols: list = field(default_factory=lambda: ["BTCUSDC"])
+    interval_s: float = 300.0
+    now_fn: object = time.time
+    name: str = "regime"
+    _last: dict = field(default_factory=dict)
+
+    async def run_once(self) -> dict:
+        now = self.now_fn()
+        updated = 0
+        for symbol in self.symbols:
+            if now - self._last.get(symbol, -1e18) < self.interval_s:
+                continue
+            self._last[symbol] = now
+            await self.svc.update(symbol)
+            updated += 1
+        return {"updated": updated}
+
+
+def build_full_stack(system, *, registry=None, llm=None,
+                     grid_symbol: str | None = None,
+                     dca_symbol: str | None = None,
+                     nn: bool = True, generator: bool = True,
+                     evolver: bool = True,
+                     cadences: dict | None = None) -> list:
+    """Register the reference's full service roster on a TradingSystem.
+
+    Returns the list of services added (also appended to
+    ``system.extra_services``).  ``cadences`` overrides per-service kwargs
+    by service name — the soak test shrinks training epochs and intervals
+    through it; production uses the defaults."""
+    from ai_crypto_trader_tpu.patterns.model import PatternRecognizer, _build
+    from ai_crypto_trader_tpu.patterns.service import ChartPatternService
+    from ai_crypto_trader_tpu.regime.service import MarketRegimeService
+    from ai_crypto_trader_tpu.social.news import NewsService
+    from ai_crypto_trader_tpu.social.service import SocialMonitorService
+    from ai_crypto_trader_tpu.strategy.evolution import StrategyEvolver
+    from ai_crypto_trader_tpu.strategy.generator import GeneratorService
+
+    cadences = cadences or {}
+
+    def kw(name, **defaults):
+        return {**defaults, **cadences.get(name, {})}
+
+    bus, symbols, now_fn = system.bus, system.symbols, system.now_fn
+    services = [
+        SocialMonitorService(bus, symbols, now_fn=now_fn,
+                             **kw("social")),
+        NewsService(bus, symbols, now_fn=now_fn, **kw("news")),
+    ]
+
+    pat_kw = kw("patterns")
+    import jax
+    import jax.numpy as jnp
+
+    seq_len = pat_kw.pop("seq_len", 60)
+    rec = PatternRecognizer("cnn", params=_build("cnn").init(
+        jax.random.PRNGKey(0), jnp.zeros((2, seq_len, 5), jnp.float32),
+        False))
+    services.append(ChartPatternService(bus, rec, symbols, seq_len=seq_len,
+                                        now_fn=now_fn, **pat_kw))
+
+    regime_kw = kw("regime")
+    cadence_keys = {k: regime_kw.pop(k) for k in ("interval_s",)
+                    if k in regime_kw}
+    services.append(RegimeCadence(
+        MarketRegimeService(bus, now_fn=now_fn, **regime_kw),
+        symbols, now_fn=now_fn, **cadence_keys))
+
+    if nn:
+        from ai_crypto_trader_tpu.models.service import PredictionService
+
+        services.append(PredictionService(bus, symbols, now_fn=now_fn,
+                                          **kw("nn")))
+    if evolver:
+        from ai_crypto_trader_tpu.config import EvolutionParams
+
+        ev_cfg = cadences.get("evolution_cfg") or EvolutionParams()
+        services.append(EvolverService(
+            bus, StrategyEvolver(bus, cfg=ev_cfg, registry=registry,
+                                 now_fn=now_fn),
+            symbol=symbols[0], now_fn=now_fn, **kw("evolver")))
+    if generator:
+        services.append(GeneratorService(bus, symbols[0], registry=registry,
+                                         llm=llm, now_fn=now_fn,
+                                         **kw("generator")))
+    if grid_symbol:
+        from ai_crypto_trader_tpu.strategy.grid_live import GridTraderService
+
+        services.append(GridTraderService(system.exchange, grid_symbol,
+                                          bus=bus, **kw("grid")))
+    if dca_symbol:
+        from ai_crypto_trader_tpu.strategy.dca import DCAStrategy
+        from ai_crypto_trader_tpu.strategy.grid_live import DCAService
+
+        dca_kw = kw("dca")
+        strat_kw = {k: dca_kw.pop(k) for k in
+                    ("base_amount", "interval_s", "schedule") if k in dca_kw}
+        services.append(DCAService(
+            system.exchange, DCAStrategy(symbol=dca_symbol, **strat_kw),
+            bus=bus, now_fn=now_fn, **dca_kw))
+
+    system.extra_services.extend(services)
+    return services

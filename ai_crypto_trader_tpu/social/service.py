@@ -44,6 +44,11 @@ def resample_tail(arr: np.ndarray, stride: int) -> np.ndarray:
     return arr[::-1][::stride][::-1]
 
 
+# Growing-history analytics are fed bucketed tails (see utils/shapes.py:
+# unbounded length churn segfaulted the 2000-tick soak).
+from ai_crypto_trader_tpu.utils.shapes import bucket_len  # noqa: F401,E402
+
+
 def deterministic_provider(bus: EventBus, symbol: str) -> dict | None:
     """Offline stand-in provider: derives social-shaped metrics from recent
     price action on the bus (momentum-chasing sentiment with noise-free
@@ -121,8 +126,12 @@ class SocialMonitorService:
         return published
 
     def _snapshot(self, symbol: str, now: float) -> SocialSnapshot:
-        """Recent observations as the risk adjuster's input."""
+        """Recent observations as the risk adjuster's input. The window is
+        bucketed so the risk-adjustment jit sees a handful of shapes, not
+        one per history length."""
         rows = self._history.get(symbol, [])[-24:]
+        b = bucket_len(len(rows), (1, 2, 4, 8, 16, 24))
+        rows = rows[-b:] if b else rows
         sent = np.asarray([[r.get(s, 0.5) for s in SOURCES] for r in rows]
                           or [[0.5] * 4], np.float32)
         ages = np.asarray([(now - r["ts"]) / 3600.0 for r in rows] or [0.0],
@@ -136,6 +145,9 @@ class SocialMonitorService:
         hist = self._history.get(symbol, [])
         feats = ["social_volume", "social_engagement", "overall_sentiment"]
         if len(hist) >= 50:
+            # bucketed tail: one new shape per poll here was the single
+            # biggest compile-churn source in the whole launcher
+            hist = hist[-bucket_len(len(hist)):]
             x = jnp.asarray([[r.get(f, 0.0) for f in feats] for r in hist],
                             jnp.float32)
             z = normalize_metrics(x)
@@ -159,7 +171,9 @@ class SocialMonitorService:
             return {"status": "insufficient_history"}
         per_source = {s: np.asarray([r.get(s, 0.5) for r in hist], np.float32)
                       for s in SOURCES}
-        n = min(len(close), len(hist))
+        n = bucket_len(min(len(close), len(hist)))
+        if n is None or n < horizon + 5:
+            return {"status": "insufficient_history"}
         close = np.asarray(close[-n:], np.float32)
         per_source = {s: v[-n:] for s, v in per_source.items()}
         report = {s: float(sentiment_accuracy(jnp.asarray(v),
@@ -226,7 +240,9 @@ class SocialMonitorService:
                 close = resample_tail(close, stride)
                 if len(close) < 10:
                     continue
-                n = min(len(sent), len(close))
+                n = bucket_len(min(len(sent), len(close)))
+                if n is None:
+                    continue
                 c = close[-n:]
                 returns = np.zeros(n, np.float32)
                 returns[1:] = np.diff(c) / c[:-1]

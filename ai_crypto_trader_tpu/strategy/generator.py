@@ -503,7 +503,18 @@ class GeneratorService:
             return {"ran": False, "reason": "insufficient_history"}
         self._last = now
 
-        cols = np.asarray([row[1:6] for row in self._history], np.float64)
+        # bucketed window: each scheduled run would otherwise hand the
+        # compiled fold evaluators a NEW candle count (one fresh XLA
+        # program per run while the buffer fills toward history_cap) —
+        # unbounded shape churn is what segfaults a long-lived process
+        from ai_crypto_trader_tpu.utils.shapes import bucket_len
+
+        buckets = tuple(sorted({self.min_candles, self.min_candles * 3 // 2,
+                                self.min_candles * 2, self.min_candles * 3,
+                                self.min_candles * 4, self.min_candles * 6,
+                                self.history_cap}))
+        window = self._history[-bucket_len(n, buckets):]
+        cols = np.asarray([row[1:6] for row in window], np.float64)
         ohlcv = {"open": cols[:, 0], "high": cols[:, 1], "low": cols[:, 2],
                  "close": cols[:, 3], "volume": cols[:, 4]}
         gen = StrategyGenerator(

@@ -1,0 +1,24 @@
+"""Static-shape hygiene helpers.
+
+XLA compiles one program per input shape; host code that feeds jitted
+analytics from GROWING histories (social sentiment buffers, the structure
+search's candle accumulator) would otherwise trigger one fresh compile per
+sample — enough cumulative XLA:CPU compiles in a long-lived process to hit
+the known backend_compile_and_load segfault (observed in the 2000-tick
+soak). Callers take the LAST ``bucket_len(n)`` samples so every jitted
+consumer sees O(log) distinct shapes over the process lifetime.
+"""
+
+from __future__ import annotations
+
+# Geometric (~1.5×) length buckets shared by the growing-history call sites.
+LEN_BUCKETS = (8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512)
+
+
+def bucket_len(n: int, buckets: tuple = LEN_BUCKETS) -> int | None:
+    """Largest bucket ≤ n (None when n is below the smallest bucket)."""
+    fit = None
+    for b in buckets:
+        if b <= n:
+            fit = b
+    return fit

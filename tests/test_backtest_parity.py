@@ -20,6 +20,11 @@ from ai_crypto_trader_tpu.backtest import (
     sweep_sharded,
 )
 
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 # ---------------------------------------------------------------------------
 # Scalar port of the reference loop (the oracle)

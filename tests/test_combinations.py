@@ -1,5 +1,6 @@
 """Combined indicators + regime data collector."""
 
+import pytest
 import asyncio
 
 import numpy as np
@@ -28,6 +29,7 @@ class TestCombinations:
             assert np.isfinite(arr).all(), name
             assert arr.min() >= -1.0 - 1e-5 and arr.max() <= 1.0 + 1e-5, name
 
+    @pytest.mark.slow
     def test_uptrend_scores_positive(self):
         n = 512
         up = np.linspace(100, 160, n).astype(np.float32)

@@ -21,6 +21,11 @@ from ai_crypto_trader_tpu.evolve import (
     run_ga_sharded,
 )
 
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 def _arrays(ohlcv, n=512):
     return {k: jnp.asarray(v[:n]) for k, v in ohlcv.items() if k != "regime"}

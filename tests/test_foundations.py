@@ -120,6 +120,7 @@ class TestLLMTrader:
 
 
 class TestLauncherRunLoop:
+    @pytest.mark.slow
     def test_run_wall_clock(self):
         from ai_crypto_trader_tpu.shell.exchange import FakeExchange
         from ai_crypto_trader_tpu.shell.launcher import TradingSystem

@@ -18,6 +18,11 @@ from ai_crypto_trader_tpu.strategy.generator import (
     RULE_NAMES, LLMStructureProposer, StrategyGenerator, StrategyStructure,
     default_seed, evaluate_structures, fold_features, mutate)
 
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def ohlcv():

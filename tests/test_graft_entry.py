@@ -7,9 +7,16 @@ the virtual-CPU platform itself (re-exec when jax is already initialized),
 so it must succeed from an arbitrarily hostile calling environment.
 """
 
+import pytest
 import os
 import subprocess
 import sys
+
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -27,7 +34,9 @@ def test_dryrun_multichip_survives_hostile_env():
     )
     out = subprocess.run(
         [sys.executable, "-c", code], env=env, cwd=REPO,
-        capture_output=True, text=True, timeout=600)
+        # generous: under `-m slow -n 8` on a 1-CPU box this subprocess
+        # time-slices against 8 workers and 600 s was measured too tight
+        capture_output=True, text=True, timeout=1800)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "dryrun_multichip(2) OK" in out.stdout
 

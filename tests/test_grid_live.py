@@ -20,6 +20,7 @@ from ai_crypto_trader_tpu.strategy.grid_live import (
     DCAService, GridTraderService)
 
 
+
 def flat_series(n=600, price=100.0, amp=0.0, symbol="BTCUSDC"):
     """Deterministic price path: flat, or a triangle wave of ±amp."""
     t = np.arange(n)
@@ -229,6 +230,7 @@ class TestReanchor:
         assert svc._escaped(float(svc.levels[0]) * 0.95)
 
 
+@pytest.mark.slow
 class TestLauncherIntegration:
     def test_runs_as_extra_service(self):
         """Both services ride the launcher tick with heartbeats."""

@@ -168,6 +168,7 @@ class TestComputeIndicators:
             assert out[name].shape == arrays["close"].shape, name
             assert not np.isnan(np.asarray(out[name])).any(), name
 
+    @pytest.mark.slow
     def test_vmap_batch(self, ohlcv):
         import jax
         arrays = {k: jnp.stack([jnp.asarray(v)[:512]] * 3)

@@ -18,6 +18,7 @@ from ai_crypto_trader_tpu.shell.llm import (
     LLMTrader, OpenAIBackend, TechnicalPolicyBackend)
 
 
+
 def chat_fixture(content: dict | str) -> dict:
     """A recorded chat-completions reply body."""
     text = content if isinstance(content, str) else json.dumps(content)

@@ -18,6 +18,12 @@ from ai_crypto_trader_tpu.models.long_context import (
     long_context_loss,
 )
 
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
+
 T, F = 512, 8
 
 

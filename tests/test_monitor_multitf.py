@@ -18,6 +18,11 @@ from ai_crypto_trader_tpu.shell.bus import EventBus
 from ai_crypto_trader_tpu.shell.exchange import FakeExchange
 from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
 
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 def long_series(n=2400, seed=7, symbol="BTCUSDC"):
     d = generate_ohlcv(n=n, seed=seed)

@@ -55,6 +55,7 @@ class TestStructuredLogger:
 
 
 class TestLauncherMetricSeries:
+    @pytest.mark.slow
     def test_dashboard_series_emitted(self):
         from ai_crypto_trader_tpu.data.ingest import from_dict
         from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv

@@ -18,6 +18,11 @@ from ai_crypto_trader_tpu.backtest import prepare_inputs, sample_params, sweep
 from ai_crypto_trader_tpu.data import generate_ohlcv
 from ai_crypto_trader_tpu.ops.pallas_backtest import BLOCK_B, CHUNK_T, sweep_pallas
 
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 def make_inputs(T, seed=3):
     d = generate_ohlcv(n=T, seed=seed)

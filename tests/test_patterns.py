@@ -20,12 +20,14 @@ KEY = jax.random.PRNGKey(7)
 
 
 class TestSynthetic:
+    @pytest.mark.slow
     def test_all_classes_generate(self):
         for label in range(len(PATTERN_CLASSES)):
             path = generate_pattern(jax.random.fold_in(KEY, label), label, T=60)
             assert path.shape == (60,)
             assert np.isfinite(np.asarray(path)).all(), PATTERN_CLASSES[label]
 
+    @pytest.mark.slow
     def test_dataset_shapes_and_labels(self):
         X, y = generate_dataset(KEY, n_per_class=4, T=60)
         assert X.shape == (4 * 15, 60, 5)
@@ -54,6 +56,7 @@ class TestPreprocess:
         assert out[:, 4].max() <= 1.0 + 1e-6
 
 
+@pytest.mark.slow
 class TestModelTraining:
     @pytest.fixture(scope="class")
     def recognizer(self):

@@ -1,5 +1,6 @@
 """Portfolio multi-symbol backtest + health/recovery utilities."""
 
+import pytest
 import numpy as np
 import jax.numpy as jnp
 
@@ -22,6 +23,7 @@ class TestPortfolio:
                               if k != "regime"}
                 for i in range(3)}
 
+    @pytest.mark.slow
     def test_stack_pads_ragged(self):
         inputs, symbols = stack_symbol_inputs(self._per_symbol())
         assert symbols == ["S0USDC", "S1USDC", "S2USDC"]
@@ -42,6 +44,7 @@ class TestPortfolio:
 
 
 class TestBacktestQueue:
+    @pytest.mark.slow
     def test_enqueue_process_results(self):
         import asyncio
 

@@ -20,6 +20,11 @@ from ai_crypto_trader_tpu.backtest import (
 )
 from test_backtest_parity import python_position_size
 
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 # ---------------------------------------------------------------------------
 # Scalar oracle (the contract in shared_capital_backtest's docstring)
@@ -29,9 +34,11 @@ def python_shared_backtest(close, signal, strength, vol, volume, conf,
                            decision, sl_series, tp_series,
                            initial=10_000.0, max_positions=5, warmup=10,
                            thresh=0.7, min_strength=70.0,
-                           param_sl=None, param_tp=None):
+                           param_sl=None, param_tp=None,
+                           equity_cadence="per_update"):
     S, T = close.shape
     balance = initial
+    last_booked = initial
     in_pos = [False] * S
     entry = [0.0] * S
     qty = [0.0] * S
@@ -72,6 +79,11 @@ def python_shared_backtest(close, signal, strength, vol, volume, conf,
                 pnl_pct = (price - entry[s]) / entry[s] * 100.0
                 if pnl_pct <= -sl[s] or pnl_pct >= tp[s]:
                     close_pos(s, price)
+            # the reference's per-update short-circuits (:220-225): no
+            # booking when the symbol still holds or the slot cap binds
+            if equity_cadence == "per_update":
+                if in_pos[s] or sum(in_pos) >= max_positions:
+                    continue
             n_open = sum(in_pos)
             if (not in_pos[s] and n_open < max_positions
                     and conf[s, t] >= thresh and strength[s, t] >= min_strength
@@ -89,12 +101,22 @@ def python_shared_backtest(close, signal, strength, vol, volume, conf,
                 if not np.isnan(tp_series[s, t]):
                     tp[s] = float(tp_series[s, t])
                 in_pos[s] = True
-        returns.append((balance - prev) / prev)
-        if balance > max_eq:
-            max_eq = balance
-        dd = max_eq - balance
-        if dd > max_dd:
-            max_dd, max_dd_pct = dd, dd / max_eq * 100.0
+            if equity_cadence == "per_update":
+                # reference booking (:280-300), vs last BOOKED balance
+                returns.append((balance - last_booked) / last_booked)
+                last_booked = balance
+                if balance > max_eq:
+                    max_eq = balance
+                dd = max_eq - balance
+                if dd > max_dd:
+                    max_dd, max_dd_pct = dd, dd / max_eq * 100.0
+        if equity_cadence == "per_candle":
+            returns.append((balance - prev) / prev)
+            if balance > max_eq:
+                max_eq = balance
+            dd = max_eq - balance
+            if dd > max_dd:
+                max_dd, max_dd_pct = dd, dd / max_eq * 100.0
     for s in range(S):
         if in_pos[s]:
             close_pos(s, float(close[s, -1]))
@@ -123,11 +145,13 @@ def minputs():
 
 
 class TestSharedCapitalParity:
-    def test_vs_python_oracle(self, minputs):
+    @pytest.mark.parametrize("cadence", ["per_update", "per_candle"])
+    def test_vs_python_oracle(self, minputs, cadence):
         args = [np.asarray(x) for x in minputs]
-        oracle = python_shared_backtest(*args)
+        oracle = python_shared_backtest(*args, equity_cadence=cadence)
         assert oracle["total_trades"] > 0, "test vectors must actually trade"
-        stats, per_symbol = shared_capital_backtest(minputs)
+        stats, per_symbol = shared_capital_backtest(minputs,
+                                                    equity_cadence=cadence)
         assert int(stats.total_trades) == oracle["total_trades"]
         assert int(stats.winning_trades) == oracle["winning_trades"]
         assert int(stats.n_r) == oracle["n_r"]

@@ -16,6 +16,11 @@ import pytest
 from ai_crypto_trader_tpu.models.service import PredictionService
 from ai_crypto_trader_tpu.shell.bus import EventBus
 
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 class Clock:
     def __init__(self, t0=1_000_000.0):

@@ -98,6 +98,7 @@ class TestHMM:
 
 class TestDetector:
     @pytest.mark.parametrize("method", ["kmeans", "gmm", "hmm", "rules"])
+    @pytest.mark.slow
     def test_fit_detect(self, ohlcv, method):
         arrays = {k: jnp.asarray(v) for k, v in ohlcv.items() if k != "regime"}
         det = RegimeDetector(method=method).fit(arrays)

@@ -152,6 +152,7 @@ def test_open_circuit_rejects_before_burning_tokens():
     assert len(clock.sleeps) == sleeps_before
 
 
+@pytest.mark.slow
 def test_trading_system_survives_exchange_outage_and_recovers():
     """Full-pipeline drive: an outage mid-run must skip ticks (alert, no
     crash) and the system must resume after the breaker's reset window."""

@@ -1,5 +1,6 @@
 """Result analyzer + systematic improver."""
 
+import pytest
 import asyncio
 import json
 import os
@@ -66,6 +67,7 @@ class TestResults:
 
 
 class TestImprover:
+    @pytest.mark.slow
     def test_improve_iterates_and_reports(self):
         async def go():
             d = generate_ohlcv(n=600, seed=4)

@@ -19,6 +19,12 @@ from ai_crypto_trader_tpu.parallel import (
 )
 from ai_crypto_trader_tpu.parallel.mesh import make_mesh
 
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
+
 T, H, D = 256, 4, 16
 
 

@@ -1,6 +1,7 @@
 """RL: env semantics (long-only position accounting, episode structure) and
 DQN training machinery (replay ring, target sync, ε decay, learning)."""
 
+import pytest
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -17,6 +18,12 @@ from ai_crypto_trader_tpu.rl import (
     train_iteration,
 )
 from ai_crypto_trader_tpu.rl.env import BUY, HOLD, SELL
+
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 KEY = jax.random.PRNGKey(0)
 

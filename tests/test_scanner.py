@@ -90,6 +90,7 @@ class TestRanking:
 
 
 class TestScoreSemantics:
+    @pytest.mark.slow
     def test_score_pairs_vectorized_matches_scalar(self, exchange):
         """Scoring P pairs in one pass == scoring each pair alone."""
         import jax.numpy as jnp

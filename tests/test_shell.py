@@ -179,6 +179,7 @@ class TestCheckpoint:
 class TestPipeline:
     """monitor → analyzer → executor on the fake exchange, virtual clock."""
 
+    @pytest.mark.slow
     def test_end_to_end_trade_flow(self):
         async def go():
             clock = VirtualClock()

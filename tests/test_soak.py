@@ -1,0 +1,171 @@
+"""Long-run paper-trading soak (VERDICT r4 next#5): the FULL launcher —
+monitor/analyzer/executor + social/news/patterns/regime/NN/evolver/
+generator/grid/DCA + the dashboard server — driven for thousands of
+virtual ticks on FakeExchange.  The reference's product is a long-running
+process (`run_trader.py:1326-1494`); this pins sustained multi-service
+operation: no unhandled errors, every heartbeat advances, the books
+reconcile against the exchange ledger, and the dashboard still renders.
+
+Slow tier: run with `pytest -m slow tests/test_soak.py`.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.config import (EvolutionParams, GAParams,
+                                         TradingParams)
+from ai_crypto_trader_tpu.data.ingest import from_dict
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.shell.dashboard_server import DashboardServer
+from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+from ai_crypto_trader_tpu.shell.stack import build_full_stack
+from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+# Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
+# training / sharded-compile suite — deselected by the default
+# run, executed via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
+
+TICKS = 2_000
+SYMBOLS = ("BTCUSDC", "ETHUSDC")
+
+
+def test_full_stack_soak(tmp_path):
+    n = TICKS + 700
+    series = {s: from_dict(generate_ohlcv(n=n, seed=21 + i), symbol=s)
+              for i, s in enumerate(SYMBOLS)}
+    clock = {"t": 0.0}
+    ex = FakeExchange(series, quote_balance=100_000.0, fee_rate=0.0)
+    ex.advance(steps=600)              # warm history for the fixed window
+    system = TradingSystem(ex, list(SYMBOLS), now_fn=lambda: clock["t"],
+                           dashboard_path=str(tmp_path / "dash.html"))
+    # permissive gates so the loop actually trades during the soak
+    system.executor.trading = TradingParams(ai_confidence_threshold=0.0,
+                                            min_signal_strength=0.0,
+                                            max_positions=2)
+    registry = ModelRegistry(path=str(tmp_path / "registry.json"))
+    system.registry = registry
+    services = build_full_stack(
+        system, registry=registry,
+        grid_symbol="BTCUSDC", dca_symbol="ETHUSDC",
+        cadences={
+            # every service must FIRE repeatedly inside the soak window,
+            # with budgets sized for a test (the production defaults are
+            # hours-scale)
+            "social": {"cache_ttl_s": 120.0},
+            "news": {"poll_interval_s": 300.0},
+            "patterns": {"update_interval_s": 300.0,
+                         "report_interval_s": 600.0},
+            "regime": {"interval_s": 600.0, "retrain_interval_s": 1e9},
+            "nn": {"epochs": 1, "units": 8, "hpo_trials": 0,
+                   "retrain_interval_s": 1e9, "intervals": ("1m",),
+                   "seq_len": 30},
+            "evolver": {"interval_s": 20_000.0, "min_candles": 128},
+            "evolution_cfg": EvolutionParams(
+                method="ga", ga=GAParams(population_size=8, generations=2)),
+            "generator": {"interval_s": 30_000.0, "min_candles": 700,
+                          "pool_size": 4, "max_rounds": 1, "cv_folds": 2},
+            "grid": {"order_size": 200.0, "lookback": 200},
+            "dca": {"base_amount": 150.0, "interval_s": 7_200.0,
+                    "rebalance_targets": {"ETH": 0.5, "USDC": 0.5},
+                    "rebalance_interval_s": 40_000.0},
+        })
+    server = DashboardServer(system, port=0).start()
+
+    service_errors = []
+    q_alerts = system.bus.subscribe("alerts")
+
+    async def go():
+        for _ in range(TICKS):
+            ex.advance()
+            clock["t"] += 60.0
+            await system.tick()
+            while not q_alerts.empty():
+                msg = q_alerts.get_nowait()["data"]
+                if msg.get("name") == "ServiceError":
+                    service_errors.append(msg)
+        # one reconciling tick at the SAME candle: a protective SELL that
+        # matched inside the loop's final ex.advance() is only folded into
+        # the executor's books by the next on_price pass
+        await system.tick()
+        while not q_alerts.empty():
+            msg = q_alerts.get_nowait()["data"]
+            if msg.get("name") == "ServiceError":
+                service_errors.append(msg)
+        return system.status_cached()
+
+    try:
+        status = asyncio.run(go())
+
+        # 1. no unhandled service errors across the whole soak
+        assert service_errors == [], service_errors[:3]
+
+        # 2. every registered service heartbeated, and recently
+        beats = system.heartbeats.beats
+        for svc in services:
+            assert svc.name in beats, f"{svc.name} never heartbeated"
+            assert clock["t"] - beats[svc.name] <= 60.0, \
+                f"{svc.name} heartbeat stale"
+        for core in ("monitor", "analyzer", "executor"):
+            assert clock["t"] - beats[core] <= 60.0
+
+        # 3. the loop actually traded, and the services actually fired
+        counts = system.bus.published_counts
+        assert counts["market_updates"] >= 2 * TICKS * 0.9
+        assert counts["trading_signals"] > 0
+        assert counts["social_updates"] > 5
+        assert counts["news_updates"] > 2
+        assert counts["regime_updates"] > 1
+        assert counts.get("strategy_update", 0) >= 1     # evolver hot swap
+        assert status["closed_trades"] + len(status["active_trades"]) > 0
+        assert len(ex.fills) > 0
+
+        # 4. books reconcile against the exchange ledger:
+        #    (a) the fake's balances re-derive exactly from its fill log
+        derived = {"USDC": 100_000.0}
+        for f in ex.fills:
+            base = f["symbol"][:-4]
+            cost = f["quantity"] * f["price"]
+            if f["side"] == "BUY":
+                derived["USDC"] = derived.get("USDC", 0.0) - cost
+                derived[base] = derived.get(base, 0.0) + f["quantity"]
+            else:
+                derived["USDC"] = derived.get("USDC", 0.0) + cost
+                derived[base] = derived.get(base, 0.0) - f["quantity"]
+        for asset, v in ex.get_balances().items():
+            np.testing.assert_allclose(v, derived.get(asset, 0.0),
+                                       rtol=1e-9, atol=1e-6)
+        #    (b) every open executor position is backed by real inventory.
+        #    ETH is exempt from the strict check: the DCA rebalancer SELLs
+        #    drift on the same shared account (faithful to the reference's
+        #    one-Binance-account topology), which can consume backing.
+        for sym, trade in system.executor.active_trades.items():
+            if sym == "BTCUSDC":
+                assert (ex.get_balances().get("BTC", 0.0)
+                        >= trade.quantity - 1e-9)
+        #    (c) nothing went negative
+        assert all(v >= -1e-6 for v in ex.get_balances().values())
+
+        # 5. risk/observability state stayed live
+        assert system.bus.get("risk_metrics")["n_assets"] == 2
+        assert len(system.bus.get("portfolio_value_history")) == 500  # bounded
+        assert (tmp_path / "dash.html").exists()
+
+        # 6. the dashboard still renders every panel family at the end
+        import urllib.request
+
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/").read().decode()
+        for marker in ("Portfolio allocation", "social sentiment", "News",
+                       "Asset correlation", "VaR 95% history",
+                       "Model versions"):
+            assert marker in page, f"missing panel: {marker}"
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/health").read().decode()
+        assert '"healthy": true' in health
+    finally:
+        server.stop()

@@ -226,6 +226,7 @@ class TestCsvRoundTrip:
             np.testing.assert_allclose(back.columns[k], v, rtol=1e-6)
 
 
+@pytest.mark.slow
 class TestBacktestEndToEnd:
     def test_social_inputs_drive_population_backtest(self, daily, ohlcv):
         import jax

@@ -52,6 +52,7 @@ class TestTradeMetrics:
         assert m["total_trades"] == 0 and m["sharpe_ratio"] == 0.0
 
 
+@pytest.mark.slow
 class TestCVAndComparison:
     def test_cross_validate(self):
         out = cross_validate(_arrays(), default_params(), k=3)
@@ -130,6 +131,7 @@ class TestEvolver:
             assert out["version"] in reg.entries
         asyncio.run(go())
 
+    @pytest.mark.slow
     def test_evolve_ga_path(self):
         async def go():
             bus = EventBus()

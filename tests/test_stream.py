@@ -65,6 +65,7 @@ class TestIngest:
         assert bus.get("ticker_BTCUSDC")["price"] == 50_000.0
         assert bus.get("ticker_ETHUSDC")["quote_volume"] == 5e5
 
+    @pytest.mark.slow
     def test_throttle_suppresses_hot_symbol(self):
         clock, bus, mon = _setup()
         st = MarketStream(mon, now_fn=clock, throttle_s=5.0)

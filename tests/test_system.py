@@ -12,6 +12,7 @@ from ai_crypto_trader_tpu.utils.alerts import AlertManager
 from ai_crypto_trader_tpu.utils.profiling import StepTimer
 
 
+
 class TestAlerts:
     def test_fire_and_resolve(self):
         am = AlertManager(now_fn=lambda: 0.0)
@@ -74,6 +75,7 @@ class TestDashboard:
 
 
 class TestTradingSystem:
+    @pytest.mark.slow
     def test_tick_flow_and_status(self):
         from ai_crypto_trader_tpu.config import FrameworkConfig, TradingParams
         from ai_crypto_trader_tpu.shell.exchange import FakeExchange
@@ -103,6 +105,7 @@ class TestTradingSystem:
 
 
 class TestCLI:
+    @pytest.mark.slow
     def test_fetch_backtest_list_analyze(self, tmp_path, monkeypatch):
         from ai_crypto_trader_tpu import cli
         monkeypatch.chdir(tmp_path)
