@@ -102,7 +102,7 @@ class PredictionService:
         self.key, k = jax.random.split(self.key)
         result = train_model(k, feats, self.model_type,
                              seq_len=self.seq_len, epochs=self.epochs,
-                             units=self.units)
+                             units=self.units, target_col=3)
         self.models[(symbol, interval)] = result
         self.train_count += 1
         self._snapshot(symbol, interval, result)
@@ -146,7 +146,8 @@ class PredictionService:
             k2, feats, best["model_type"], seq_len=self.seq_len,
             units=best["units"], dropout=best["dropout"],
             learning_rate=best["learning_rate"],
-            batch_size=best["batch_size"], epochs=self.epochs)
+            batch_size=best["batch_size"], epochs=self.epochs,
+            target_col=3)
         self.models[(symbol, interval)] = result
         self.train_count += 1
         self._snapshot(symbol, interval, result)
@@ -203,8 +204,9 @@ class PredictionService:
                 feats = self._features(symbol, interval)
                 if feats is None:
                     continue
-                pred = predict_prices(result, feats, seq_len=self.seq_len,
-                                      target_col=3)
+                # denormalization column comes from the TrainResult (the
+                # close column the service trains on)
+                pred = predict_prices(result, feats, seq_len=self.seq_len)
                 payload = {
                     "symbol": symbol, "interval": interval,
                     "predicted_price": float(np.ravel(pred["predicted_price"])[0]),

@@ -76,6 +76,7 @@ class TrainResult:
     history: list = field(default_factory=list)
     best_val_loss: float = float("inf")
     epochs_run: int = 0
+    target_col: int = 0              # feature column the model predicts
 
     def model(self):
         return build_model(self.model_type, **self.model_kwargs)
@@ -112,6 +113,7 @@ def train_model(
     reduce_lr_factor: float = 0.5,
     min_lr: float = 1e-6,
     verbose: bool = False,
+    target_col: int = 0,
 ) -> TrainResult:
     """Fit one model; returns params + history + scaler.
 
@@ -129,7 +131,7 @@ def train_model(
     train_rows = max(T - int(T * val_fraction), seq_len + max(horizons) + 1)
     scaler = fit_scaler(features[:train_rows])
     scaled = np.asarray(scaler.transform(jnp.asarray(features)))
-    X, y = make_windows(scaled, seq_len, horizons)
+    X, y = make_windows(scaled, seq_len, horizons, target_col)
     hmax = max(horizons)
     target_row = np.arange(len(X)) + seq_len + hmax - 1
     is_train = target_row < train_rows
@@ -164,6 +166,7 @@ def train_model(
     n_batches = max(len(X_tr) // batch_size, 1)
 
     best = TrainResult(params=params, model_type=model_type, scaler=scaler,
+                       target_col=target_col,
                        model_kwargs=model_kwargs)
     patience = lr_patience = 0
     lr = learning_rate
@@ -202,14 +205,32 @@ def train_model(
 
 
 def predict_prices(result: TrainResult, features: np.ndarray,
-                   seq_len: int = 60, target_col: int = 0) -> dict:
+                   seq_len: int = 60, target_col: int | None = None) -> dict:
     """Predict the next price from the trailing window + denormalize +
     confidence from validation loss (`predict_prices`,
-    `neural_network_service.py:1090-1219`)."""
-    model = result.model()
-    scaled = result.scaler.transform(jnp.asarray(features))
-    window = scaled[-seq_len:][None]
-    out = model.apply(result.params, window, False)
+    `neural_network_service.py:1090-1219`).
+
+    ``target_col`` defaults to the column the model was TRAINED to predict
+    (recorded on TrainResult) — denormalizing with a different column's
+    min/max silently mis-scales the prediction (round-5 review)."""
+    if target_col is None:
+        target_col = result.target_col
+    # One jitted predict program PER TRAINED MODEL, cached on the result:
+    # building a fresh flax module per call makes its internal scan/pjit miss
+    # the compile cache every time (new module constants in the key), and a
+    # long-lived process accumulates one XLA compile per prediction — the
+    # cumulative-compile segfault the 2000-tick soak exposed. The window is
+    # also sliced BEFORE transforming (scaler is elementwise; identical
+    # result) so the program sees a FIXED [seq_len, F] shape.
+    fn = getattr(result, "_predict_fn", None)
+    if fn is None:
+        model = result.model()
+        fn = jax.jit(lambda p, w: model.apply(p, w, False))
+        result._predict_fn = fn
+    window_feats = np.asarray(features)[-seq_len:]
+    scaled = result.scaler.transform(jnp.asarray(window_feats))
+    window = scaled[None]
+    out = fn(result.params, window)
     mean_scaled = out["mean"][0]
     price = np.asarray(result.scaler.inverse(mean_scaled, target_col))
     confidence = float(1.0 / (1.0 + result.best_val_loss * 100.0))

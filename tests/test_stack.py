@@ -1,0 +1,103 @@
+"""shell/stack.py adapters: EvolverService cadence/seeding, RegimeCadence
+gating, and full-roster assembly (fast tier — the evolver is stubbed; the
+real end-to-end run is tests/test_soak.py)."""
+
+import asyncio
+
+import numpy as np
+
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.shell.stack import EvolverService, RegimeCadence
+
+
+def _klines(n=300, base=100.0):
+    return [[i * 60_000.0, base, base + 1, base - 1, base + 0.5, 10.0]
+            for i in range(n)]
+
+
+class StubEvolver:
+    def __init__(self):
+        self.calls = []
+
+    async def evolve(self, ohlcv, current=None, metrics=None,
+                     regime="ranging", history_length=0):
+        self.calls.append({"n": len(ohlcv["close"]), "current": current,
+                           "metrics": metrics, "regime": regime})
+        return {"evolved": True, "method": "stub", "version": "v1"}
+
+
+class TestEvolverService:
+    def test_cadence_history_gate_and_partial_bar(self):
+        bus = EventBus()
+        stub = StubEvolver()
+        clock = {"t": 0.0}
+        svc = EvolverService(bus, stub, interval_s=600.0, min_candles=128,
+                             now_fn=lambda: clock["t"])
+        # no history yet → gated, interval slot NOT consumed
+        assert asyncio.run(svc.run_once())["ran"] is False
+        bus.set("historical_data_BTCUSDC_1m", _klines(256))
+        out = asyncio.run(svc.run_once())
+        assert out["ran"] and out["evolved"]
+        # the venue's in-progress LAST bar is excluded from fitness data
+        assert stub.calls[0]["n"] == 255
+        # interval gate holds until interval_s elapses
+        assert asyncio.run(svc.run_once())["ran"] is False
+        clock["t"] = 600.0
+        assert asyncio.run(svc.run_once())["ran"] is True
+
+    def test_seeds_from_hot_swapped_params_and_regime(self):
+        bus = EventBus()
+        stub = StubEvolver()
+        svc = EvolverService(bus, stub, interval_s=1.0, min_candles=64,
+                             now_fn=lambda: 0.0)
+        bus.set("historical_data_BTCUSDC_1m", _klines(256))
+        bus.set("strategy_params", {"stop_loss": 4.5, "take_profit": 9.0,
+                                    "bogus_key": 1.0})
+        bus.set("market_regime_BTCUSDC", {"regime": "volatile"})
+        asyncio.run(svc.run_once())
+        call = stub.calls[0]
+        # successive evolutions compound: current params come from the
+        # hot-swap surface, unknown keys ignored, clamped to ranges
+        assert float(call["current"].stop_loss) == 4.5
+        assert float(call["current"].take_profit) == 9.0
+        assert call["regime"] == "volatile"
+
+
+class TestRegimeCadence:
+    def test_per_symbol_interval_gating(self):
+        class StubRegime:
+            def __init__(self):
+                self.updates = []
+
+            async def update(self, symbol):
+                self.updates.append(symbol)
+
+        clock = {"t": 0.0}
+        stub = StubRegime()
+        cad = RegimeCadence(stub, ["A", "B"], interval_s=300.0,
+                            now_fn=lambda: clock["t"])
+        assert asyncio.run(cad.run_once())["updated"] == 2
+        assert asyncio.run(cad.run_once())["updated"] == 0   # gated
+        clock["t"] = 300.0
+        assert asyncio.run(cad.run_once())["updated"] == 2
+        assert stub.updates == ["A", "B", "A", "B"]
+
+
+def test_build_full_stack_registers_roster():
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_shell import _series
+
+    from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+    from ai_crypto_trader_tpu.shell.stack import build_full_stack
+
+    ex = FakeExchange({"BTCUSDC": _series()})
+    system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: 0.0)
+    services = build_full_stack(system, grid_symbol="BTCUSDC",
+                                dca_symbol="BTCUSDC")
+    names = [s.name for s in services]
+    assert names == ["social", "news", "patterns", "regime", "nn",
+                     "evolver", "generator", "grid", "dca"]
+    assert system.extra_services == services
