@@ -80,7 +80,8 @@ class MarketMonitor:
         if isinstance(self.exchange, ResilientExchange):
             self.breaker = None
 
-    def _features_from_klines(self, klines: list) -> dict | None:
+    def _features_from_klines(self, klines: list,
+                              with_combo_scores: bool = False) -> dict | None:
         # Fixed-shape discipline: the indicator program is compiled for
         # exactly kline_limit candles — a variable-length window would
         # trigger a recompile per poll (XLA static shapes).
@@ -101,7 +102,8 @@ class MarketMonitor:
         )
         vp = volume_profile(arrays["high"], arrays["low"], arrays["close"],
                             arrays["volume"])
-        confluence = combination_signal(combined_indicators(ind))
+        combos = combined_indicators(ind)
+        confluence = combination_signal(combos)
         i = -1
         close = arr[:, 3]
         def chg(n):
@@ -130,7 +132,33 @@ class MarketMonitor:
                 "value_area_high": float(np.asarray(vp["value_area_high"])),
             },
             "confluence": float(np.asarray(confluence)[i]),
+            # latest combination scores, primary frame only (the structure
+            # view's input; 15 device→host pulls, skipped for the 3
+            # secondary frames whose copy would be discarded)
+            **({"_combo_last": {n: float(np.asarray(c)[-1])
+                                for n, c in combos.items()}}
+               if with_combo_scores else {}),
         }
+
+    def _structure_view(self, combo_last: dict) -> dict:
+        """Live evaluation of the ADOPTED strategy structure (the
+        generator's hot-swap surface, strategy/generator.py
+        GeneratorService): StrategyStructure.blend_signal — the scalar
+        twin of the search's own scoring — over the primary frame's latest
+        combination scores, so the adopted structure drives the live
+        context the analyzer/LLM gate sees."""
+        payload = self.bus.get("strategy_structure")
+        if not payload:
+            return {}
+        from ai_crypto_trader_tpu.strategy.generator import StrategyStructure
+
+        s = StrategyStructure.from_payload(payload)
+        if s is None:
+            return {}
+        blend, signal = s.blend_signal(combo_last)
+        return {"structure_blend": blend,
+                "structure_signal": signal,
+                "structure_version": payload.get("version")}
 
     def _fetch(self, symbol: str, interval: str):
         """Breaker-guarded per-interval fetch. Each frame is requested at
@@ -163,9 +191,13 @@ class MarketMonitor:
             if klines is None:
                 continue
             self._note_warmup(symbol, self.intervals[0], len(klines))
-            update = self._features_from_klines(klines[-self.kline_limit:])
+            update = self._features_from_klines(klines[-self.kline_limit:],
+                                                with_combo_scores=True)
             if update is None:
                 continue
+            combo_last = update.pop("_combo_last", None)
+            if combo_last:
+                update.update(self._structure_view(combo_last))
             self.bus.set(f"historical_data_{symbol}_{self.intervals[0]}",
                          klines[-self.kline_limit:])
             # The 0.6/0.4 trend blend pairs the primary frame with 5m

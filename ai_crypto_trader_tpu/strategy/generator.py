@@ -135,6 +135,22 @@ class StrategyStructure:
             w[RULE_NAMES.index(n)] = v
         return w
 
+    def blend_signal(self, scores: dict) -> tuple[float, str]:
+        """One candle's blend + thresholded signal from the 15 combination
+        scores — the scalar twin of `_eval_batch`'s vmapped scoring
+        (centering via _CENTERED, |w|-normalized blend, ≥buy / ≤−sell
+        thresholds); the live monitor view and the search MUST agree, so
+        both thresholds apply to the same 4-decimal rounding the blend is
+        published with."""
+        w = self.weight_vector()
+        vals = np.nan_to_num(np.asarray(
+            [float(scores[n]) - (0.5 if n in _CENTERED else 0.0)
+             for n in RULE_NAMES], np.float32))
+        blend = round(float(w @ vals / max(np.abs(w).sum(), 1e-9)), 4)
+        signal = ("BUY" if blend >= self.buy_threshold else
+                  "SELL" if blend <= -self.sell_threshold else "NEUTRAL")
+        return blend, signal
+
 
 def default_seed() -> StrategyStructure:
     """A sane trend+oscillator confluence seed (the reference seeds its

@@ -165,3 +165,50 @@ class TestHourlySelectionProfiles:
         out = sel.score_strategy(strat, regime="volatile", volatility=0.05,
                                  social_sentiment=1.0, hour_of_day=9)
         assert out["combined"] <= 1.0
+
+
+class TestStructureView:
+    def test_adopted_structure_drives_live_context(self):
+        """The generator's hot-swapped structure must show up in the next
+        market update: blend over the live combination scores + its
+        thresholded signal (the structure search's own math, live)."""
+        import asyncio
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_shell import _series
+
+        from ai_crypto_trader_tpu.shell.bus import EventBus
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
+
+        async def go():
+            bus = EventBus()
+            ex = FakeExchange({"BTCUSDC": _series()})
+            ex.advance(steps=400)
+            mon = MarketMonitor(bus, ex, symbols=["BTCUSDC"],
+                                intervals=("1m",), now_fn=lambda: 0.0)
+            await mon.poll()
+            md = bus.get("market_data_BTCUSDC")
+            assert "structure_signal" not in md       # nothing adopted yet
+
+            bus.set("strategy_structure", {
+                "rules": {"oscillator_consensus": 1.0,
+                          "trend_confirmation": 1.0},
+                "buy_threshold": 0.05, "sell_threshold": 0.05,
+                "version": "v9"})
+            await mon.poll(force=True)
+            md = bus.get("market_data_BTCUSDC")
+            assert md["structure_version"] == "v9"
+            assert -1.0 <= md["structure_blend"] <= 1.0
+            assert md["structure_signal"] in ("BUY", "SELL", "NEUTRAL")
+            # thresholds applied to the blend
+            if abs(md["structure_blend"]) >= 0.05:
+                assert md["structure_signal"] != "NEUTRAL"
+
+            # garbage payloads degrade to no structure columns
+            bus.set("strategy_structure", {"rules": "garbage"})
+            await mon.poll(force=True)
+            assert "structure_signal" not in bus.get("market_data_BTCUSDC")
+
+        asyncio.run(go())
