@@ -4,10 +4,14 @@ regime detection → GA evolution → NN training → DQN RL → Monte-Carlo ris
 Runs on CPU or a single TPU chip in about a minute at these toy sizes; every
 stage is the same code that scales to a mesh.
 
-    PYTHONPATH=. python examples/quickstart.py
+    python examples/quickstart.py
 """
 
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
