@@ -445,6 +445,31 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
         params = bus.get("strategy_params")
         if params:
             sections.append(_table(params, "Live strategy parameters"))
+        structure = bus.get("strategy_structure")
+        if structure and isinstance(structure.get("rules"), dict):
+            # adopted rule-graph structure (GeneratorService hot swap) +
+            # its live evaluation from the monitor's market_data columns;
+            # non-numeric weights render as-is (a bad payload must degrade,
+            # never take down the whole page)
+            rows = {f"rule: {name}": (f"{weight:+.2f}"
+                                      if isinstance(weight, (int, float))
+                                      else str(weight))
+                    for name, weight in sorted(structure["rules"].items())}
+            rows["thresholds"] = (f"buy ≥ {structure.get('buy_threshold', 0)}"
+                                  f" / sell ≤ -{structure.get('sell_threshold', 0)}")
+            rows["exits"] = (f"SL {structure.get('stop_loss', 0)}% / "
+                             f"TP {structure.get('take_profit', 0)}%")
+            if structure.get("version"):
+                rows["version"] = structure["version"]
+            md = bus.get(f"market_data_{symbol}") if symbol else None
+            # only pair the live blend with the structure it was computed
+            # against — right after a hot swap the monitor's last poll
+            # still reflects the PREVIOUS structure
+            if (md and isinstance(md.get("structure_blend"), (int, float))
+                    and md.get("structure_version") == structure.get("version")):
+                rows["live blend"] = (f"{md['structure_blend']:+.4f} → "
+                                      f"{md.get('structure_signal', '?')}")
+            sections.append(_table(rows, "Adopted strategy structure"))
         trades = bus.get("active_trades")
         if trades:
             sections.append(_table({s: f"entry {t.get('entry_price', 0):,.2f}"

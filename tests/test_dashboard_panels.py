@@ -216,3 +216,37 @@ def test_overlay_rsi_matches_ops_kernel():
     ours = chart_overlays(closes)["rsi"]
     theirs = np.asarray(rsi(jnp.asarray(closes)))
     np.testing.assert_allclose(ours[20:], theirs[20:], rtol=1e-3, atol=1e-2)
+
+
+def test_adopted_structure_panel_renders():
+    """The generator's hot-swapped structure renders as a card: rules,
+    thresholds, exits, version, and the monitor's live blend/signal."""
+    from ai_crypto_trader_tpu.shell.bus import EventBus
+
+    bus = EventBus()
+    bus.set("strategy_structure", {
+        "rules": {"oscillator_consensus": 1.0, "stoch_rsi": -0.5},
+        "buy_threshold": 0.2, "sell_threshold": 0.3,
+        "stop_loss": 2.5, "take_profit": 6.0, "version": "abc123"})
+    bus.set("market_data_BTCUSDC", {"structure_blend": 0.31,
+                                    "structure_signal": "BUY",
+                                    "structure_version": "abc123"})
+    page = render_dashboard(bus=bus, symbol="BTCUSDC")
+    assert "Adopted strategy structure" in page
+    assert "oscillator_consensus" in page and "stoch_rsi" in page
+    assert "abc123" in page
+    assert "+0.3100" in page and "BUY" in page
+    # a blend computed against a PREVIOUS structure must not render next
+    # to the new version
+    bus.set("market_data_BTCUSDC", {"structure_blend": 0.31,
+                                    "structure_signal": "BUY",
+                                    "structure_version": "old-version"})
+    page = render_dashboard(bus=bus, symbol="BTCUSDC")
+    assert "live blend" not in page
+
+    # malformed payloads degrade, never crash the page
+    bus.set("strategy_structure", {"rules": "garbage"})
+    assert "Adopted strategy structure" not in render_dashboard(
+        bus=bus, symbol="BTCUSDC")
+    bus.set("strategy_structure", {"rules": {"stoch_rsi": "not-a-number"}})
+    assert "not-a-number" in render_dashboard(bus=bus, symbol="BTCUSDC")
