@@ -152,6 +152,7 @@ def optimize_hyperparameters(
     seed: int = 0,
     sampler: str = "tpe",
     n_startup: int = 5,
+    target_col: int = 0,
 ) -> dict:
     """Returns {"best_params": ..., "best_val_loss": ..., "trials": [...]}.
 
@@ -172,7 +173,8 @@ def optimize_hyperparameters(
         r = train_model(jax.random.fold_in(key, i), features, t["model_type"],
                         seq_len=seq_len, units=t["units"], dropout=t["dropout"],
                         learning_rate=t["learning_rate"], batch_size=t["batch_size"],
-                        epochs=rung_epochs[0], early_stopping_patience=rung_epochs[0])
+                        epochs=rung_epochs[0], early_stopping_patience=rung_epochs[0],
+                        target_col=target_col)
         results.append({"trial": t, "val_loss": r.best_val_loss, "rung": 0})
 
     # Survivors graduate to the full budget; the winner is chosen among
@@ -186,7 +188,8 @@ def optimize_hyperparameters(
         r = train_model(jax.random.fold_in(key, 10_000 + rank), features,
                         t["model_type"], seq_len=seq_len, units=t["units"],
                         dropout=t["dropout"], learning_rate=t["learning_rate"],
-                        batch_size=t["batch_size"], epochs=rung_epochs[-1])
+                        batch_size=t["batch_size"], epochs=rung_epochs[-1],
+                        target_col=target_col)
         rec = {"trial": t, "val_loss": r.best_val_loss, "rung": 1}
         results[i] = rec
         finalists.append(rec)

@@ -137,9 +137,13 @@ class PredictionService:
         from ai_crypto_trader_tpu.models.hpo import optimize_hyperparameters
 
         self.key, k = jax.random.split(self.key)
+        # candidates must be RANKED on the same target the final model
+        # trains on (close, col 3) — ranking on open while deploying close
+        # selects hyperparameters for a different objective
         hpo = optimize_hyperparameters(
             k, feats, n_trials=self.hpo_trials,
-            rung_epochs=(2, max(2, self.epochs // 2)), seq_len=self.seq_len)
+            rung_epochs=(2, max(2, self.epochs // 2)), seq_len=self.seq_len,
+            target_col=3)
         best = hpo["best_params"]
         self.key, k2 = jax.random.split(self.key)
         result = train_model(

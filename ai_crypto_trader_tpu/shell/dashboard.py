@@ -454,7 +454,8 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
             rows = {f"rule: {name}": (f"{weight:+.2f}"
                                       if isinstance(weight, (int, float))
                                       else str(weight))
-                    for name, weight in sorted(structure["rules"].items())}
+                    for name, weight in sorted(structure["rules"].items(),
+                                               key=lambda kv: str(kv[0]))}
             rows["thresholds"] = (f"buy ≥ {structure.get('buy_threshold', 0)}"
                                   f" / sell ≤ -{structure.get('sell_threshold', 0)}")
             rows["exits"] = (f"SL {structure.get('stop_loss', 0)}% / "
@@ -464,8 +465,11 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
             md = bus.get(f"market_data_{symbol}") if symbol else None
             # only pair the live blend with the structure it was computed
             # against — right after a hot swap the monitor's last poll
-            # still reflects the PREVIOUS structure
+            # still reflects the PREVIOUS structure. Version must be
+            # truthy: registry-less adoptions carry version None on BOTH
+            # sides, which would false-match across a swap.
             if (md and isinstance(md.get("structure_blend"), (int, float))
+                    and structure.get("version")
                     and md.get("structure_version") == structure.get("version")):
                 rows["live blend"] = (f"{md['structure_blend']:+.4f} → "
                                       f"{md.get('structure_signal', '?')}")

@@ -250,3 +250,7 @@ def test_adopted_structure_panel_renders():
         bus=bus, symbol="BTCUSDC")
     bus.set("strategy_structure", {"rules": {"stoch_rsi": "not-a-number"}})
     assert "not-a-number" in render_dashboard(bus=bus, symbol="BTCUSDC")
+    # mixed-type rule keys must not crash the page either
+    bus.set("strategy_structure", {"rules": {"stoch_rsi": 1.0, 3: -0.5}})
+    assert "Adopted strategy structure" in render_dashboard(
+        bus=bus, symbol="BTCUSDC")
