@@ -47,6 +47,18 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ai_crypto_trader_tpu.backtest import signals as sig
 from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
+from ai_crypto_trader_tpu.utils import tracing
+
+
+def _traced_entry(name: str, close, attrs_fn, call):
+    """Host-side span around a jitted entry point. Pass-through when
+    tracing is off OR when the call happens inside a jax transform (the
+    argument is a Tracer: opening host spans mid-trace would record
+    garbage timings once per trace, not per execution)."""
+    if tracing.active() is None or isinstance(close, jax.core.Tracer):
+        return call()
+    return tracing.traced_dispatch(name, call, service="backtest",
+                                   attrs_fn=attrs_fn)
 
 
 class BacktestInputs(NamedTuple):
@@ -175,7 +187,7 @@ def _book_close(s: CarryState, price, do_close):
     static_argnames=("warmup", "reference_quirks", "use_param_sl_tp",
                      "return_curve", "unroll", "sell_exits"),
 )
-def run_backtest(
+def _run_backtest_jit(
     inputs: BacktestInputs,
     params: StrategyParams | None = None,
     initial_balance: float = 10_000.0,
@@ -305,16 +317,28 @@ def run_backtest(
     return (stats, curve) if return_curve else stats
 
 
+def run_backtest(inputs: BacktestInputs,
+                 params: StrategyParams | None = None, *args, **kw):
+    """Host entry for `_run_backtest_jit` (same signature): when tracing is
+    active and this is a real host-side dispatch (not a call inside vmap /
+    jit tracing), the run gets a `backtest.run` span with compile-vs-execute
+    attribution. Otherwise it is a direct pass-through."""
+    return _traced_entry(
+        "backtest.run", inputs.close,
+        lambda: {"candles": int(inputs.close.shape[-1])},
+        lambda: _run_backtest_jit(inputs, params, *args, **kw))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("warmup", "reference_quirks", "return_curve", "unroll"),
 )
-def sweep(inputs: BacktestInputs, params: StrategyParams,
-          initial_balance: float = 10_000.0,
-          ai_confidence_threshold: float = 0.7,
-          min_signal_strength: float = 70.0,
-          warmup: int = 10, reference_quirks: bool = False,
-          return_curve: bool = False, unroll: int = 8):
+def _sweep_jit(inputs: BacktestInputs, params: StrategyParams,
+               initial_balance: float = 10_000.0,
+               ai_confidence_threshold: float = 0.7,
+               min_signal_strength: float = 70.0,
+               warmup: int = 10, reference_quirks: bool = False,
+               return_curve: bool = False, unroll: int = 8):
     """vmap the backtester over a stacked StrategyParams population, as ONE
     compiled program (on the remote-compiled TPU backend, anything outside
     jit pays an op-by-op compile round-trip — never run this path eagerly).
@@ -328,13 +352,23 @@ def sweep(inputs: BacktestInputs, params: StrategyParams,
     stop_loss/take_profit, which would silently deaden those population
     dimensions. Per-genome ATR-adaptive inputs belong in
     `evolvable.population_backtest`, which rebuilds inputs per member."""
-    fn = lambda p: run_backtest(
+    fn = lambda p: _run_backtest_jit(
         inputs, p, initial_balance=initial_balance,
         ai_confidence_threshold=ai_confidence_threshold,
         min_signal_strength=min_signal_strength, warmup=warmup,
         reference_quirks=reference_quirks, use_param_sl_tp=True,
         return_curve=return_curve, unroll=unroll)
     return jax.vmap(fn)(params)
+
+
+def sweep(inputs: BacktestInputs, params: StrategyParams, *args, **kw):
+    """Host entry for `_sweep_jit` (same signature), with a
+    `backtest.sweep` span + compile/execute attribution when traced."""
+    return _traced_entry(
+        "backtest.sweep", inputs.close,
+        lambda: {"candles": int(inputs.close.shape[-1]),
+                 "population": int(jax.tree.leaves(params)[0].shape[0])},
+        lambda: _sweep_jit(inputs, params, *args, **kw))
 
 
 def sweep_sharded(mesh, inputs: BacktestInputs, params: StrategyParams, **kw):
@@ -358,7 +392,9 @@ def sweep_sharded(mesh, inputs: BacktestInputs, params: StrategyParams, **kw):
     pspec = P(data_axis)
 
     def local_sweep(p_shard):
-        return sweep(inputs, p_shard, **kw)
+        # private jit entry: inside shard_map tracing the closed-over
+        # inputs stay concrete, so the traced host wrapper must not run
+        return _sweep_jit(inputs, p_shard, **kw)
 
     shard_fn = jax.shard_map(
         local_sweep,

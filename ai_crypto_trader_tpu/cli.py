@@ -288,7 +288,9 @@ def cmd_trade(args):
     #                                      full fixed-shape indicator window
     system = TradingSystem(ex, [args.symbol], now_fn=lambda: clock["t"],
                            dashboard_path=args.dashboard,
-                           log_path=os.environ.get("LOG_PATH"))
+                           log_path=os.environ.get("LOG_PATH"),
+                           enable_tracing=bool(args.trace_jsonl),
+                           trace_jsonl=args.trace_jsonl)
     if args.full_stack:
         from ai_crypto_trader_tpu.shell.stack import build_full_stack
         from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
@@ -337,6 +339,7 @@ def cmd_trade(args):
     finally:
         if server is not None:
             server.stop()
+        system.shutdown()          # deactivate tracer + close span JSONL
 
 
 def cmd_scan(args):
@@ -462,6 +465,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "generator/grid/DCA) on the paper loop")
     sp.add_argument("--registry", default="models/registry.json",
                     help="model-registry file for --full-stack versioning")
+    sp.add_argument("--trace-jsonl", default=None, metavar="PATH",
+                    help="enable end-to-end tracing and append every "
+                         "finished span to this JSONL file "
+                         "(utils/tracing.py; /traces on --serve)")
     sp.add_argument("--serve-hold-s", type=float, default=0.0,
                     help="keep serving this many seconds after the ticks")
     sp.set_defaults(fn=cmd_trade)

@@ -31,6 +31,7 @@ import numpy as np
 
 from ai_crypto_trader_tpu.models.train import TrainResult, predict_prices, train_model
 from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.utils import tracing
 from ai_crypto_trader_tpu.utils.checkpoint import save_checkpoint
 
 INTERVAL_SECONDS = {
@@ -94,15 +95,26 @@ class PredictionService:
             return None
         return feats
 
+    # -- tracing -------------------------------------------------------------
+    def _traced_jax(self, name: str, attrs: dict, fn):
+        """Span + compile-vs-execute breakdown around one JAX dispatch
+        (tracing.traced_dispatch); a plain ``fn()`` when tracing is off."""
+        return tracing.traced_dispatch(name, fn, service=self.name,
+                                       attrs_fn=lambda: attrs)
+
     # -- training ------------------------------------------------------------
     def _train_one(self, symbol: str, interval: str) -> TrainResult | None:
         feats = self._features(symbol, interval)
         if feats is None:
             return None
         self.key, k = jax.random.split(self.key)
-        result = train_model(k, feats, self.model_type,
-                             seq_len=self.seq_len, epochs=self.epochs,
-                             units=self.units, target_col=3)
+        result = self._traced_jax(
+            "model.train",
+            {"symbol": symbol, "interval": interval,
+             "model_type": self.model_type},
+            lambda: train_model(k, feats, self.model_type,
+                                seq_len=self.seq_len, epochs=self.epochs,
+                                units=self.units, target_col=3))
         self.models[(symbol, interval)] = result
         self.train_count += 1
         self._snapshot(symbol, interval, result)
@@ -140,10 +152,13 @@ class PredictionService:
         # candidates must be RANKED on the same target the final model
         # trains on (close, col 3) — ranking on open while deploying close
         # selects hyperparameters for a different objective
-        hpo = optimize_hyperparameters(
-            k, feats, n_trials=self.hpo_trials,
-            rung_epochs=(2, max(2, self.epochs // 2)), seq_len=self.seq_len,
-            target_col=3)
+        hpo = self._traced_jax(
+            "model.hpo", {"symbol": symbol, "interval": interval,
+                          "n_trials": self.hpo_trials},
+            lambda: optimize_hyperparameters(
+                k, feats, n_trials=self.hpo_trials,
+                rung_epochs=(2, max(2, self.epochs // 2)),
+                seq_len=self.seq_len, target_col=3))
         best = hpo["best_params"]
         self.key, k2 = jax.random.split(self.key)
         result = train_model(
@@ -210,7 +225,12 @@ class PredictionService:
                     continue
                 # denormalization column comes from the TrainResult (the
                 # close column the service trains on)
-                pred = predict_prices(result, feats, seq_len=self.seq_len)
+                pred = self._traced_jax(
+                    "model.predict",
+                    {"symbol": symbol, "interval": interval,
+                     "model_type": result.model_type},
+                    lambda result=result, feats=feats: predict_prices(
+                        result, feats, seq_len=self.seq_len))
                 payload = {
                     "symbol": symbol, "interval": interval,
                     "predicted_price": float(np.ravel(pred["predicted_price"])[0]),

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 
 from ai_crypto_trader_tpu.shell.bus import EventBus
 from ai_crypto_trader_tpu.shell.llm import LLMTrader
+from ai_crypto_trader_tpu.utils import tracing
 
 
 def _flat_features(ctx: dict) -> dict:
@@ -124,6 +125,16 @@ class SignalAnalyzer:
         q = self._queue()
         while not q.empty():
             env = q.get_nowait()
-            if await self.handle_update(env["data"]):
-                n += 1
+            # span parents to the publish that produced this envelope (the
+            # carried trace context), so one trace_id follows the tick
+            # across the service boundary
+            with tracing.consumer_span(
+                    env, "analyzer.handle_update", service="analyzer",
+                    attributes={"symbol": env["data"].get("symbol")}) as sp:
+                signal = await self.handle_update(env["data"])
+                if signal:
+                    sp.set_attribute("decision", signal.get("decision"))
+                    n += 1
+                else:
+                    sp.set_attribute("gated", True)
         return n

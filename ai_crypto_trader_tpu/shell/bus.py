@@ -11,6 +11,15 @@ so every reference channel (`market_updates`, `trading_signals`,
 `pattern_signals`, `strategy_update`, …, `dashboard.py:91-99`) has a direct
 equivalent.  A multi-host deployment can swap in any transport behind the
 same interface without touching services.
+
+Observability: when tracing is active (utils/tracing.py), every publish
+stamps the envelope with the current trace context so subscribers can
+parent their handling spans to the publish — causal tracing across service
+boundaries with unchanged service signatures.  With a MetricsRegistry
+attached the bus reports `bus_fanout_latency_seconds{channel=...}` and
+`bus_queue_depth{channel=...}`; a StructuredLogger turns queue overflow
+(slow subscriber dropping oldest) into a warning carrying the trace_id, so
+logs ↔ traces ↔ metrics correlate on one id.
 """
 
 from __future__ import annotations
@@ -21,18 +30,24 @@ import time
 from collections import defaultdict
 from typing import Any, AsyncIterator
 
+from ai_crypto_trader_tpu.utils import tracing
+
 
 class EventBus:
     """Channels + KV store. Subscribers get bounded asyncio queues; slow
     consumers drop oldest (the reference's fire-and-forget pub/sub has no
     delivery guarantee either — parity, but explicit)."""
 
-    def __init__(self, max_queue: int = 1024, now_fn=time.time):
+    def __init__(self, max_queue: int = 1024, now_fn=time.time,
+                 metrics=None, log=None):
         self._subs: dict[str, list[asyncio.Queue]] = defaultdict(list)
         self._kv: dict[str, Any] = {}
         self._max_queue = max_queue
         self._now = now_fn
+        self.metrics = metrics            # MetricsRegistry | None
+        self.log = log                    # StructuredLogger | None
         self.published_counts: dict[str, int] = defaultdict(int)
+        self.dropped_counts: dict[str, int] = defaultdict(int)
 
     # --- pub/sub -----------------------------------------------------------
     def subscribe(self, channel: str) -> asyncio.Queue:
@@ -47,18 +62,58 @@ class EventBus:
     async def publish(self, channel: str, message: Any) -> int:
         self.published_counts[channel] += 1
         delivered = 0
+        dropped = 0
         envelope = {"channel": channel, "ts": self._now(), "data": message}
+        # Trace propagation: stamp the originating span's context onto the
+        # envelope (one module-global check when tracing is off).
+        ctx = tracing.inject()
+        if ctx is not None:
+            envelope["trace"] = ctx
+        fanout_t0 = time.perf_counter() if self.metrics is not None else 0.0
+        depth = 0
         for pattern, queues in list(self._subs.items()):
             if pattern == channel or fnmatch.fnmatch(channel, pattern):
                 for q in queues:
                     if q.full():
                         try:
                             q.get_nowait()          # drop oldest
+                            dropped += 1
                         except asyncio.QueueEmpty:
                             pass
                     q.put_nowait(envelope)
                     delivered += 1
+                    if q.qsize() > depth:
+                        depth = q.qsize()
+        # capture fanout latency BEFORE the drop-logging below: the flushed
+        # log write would otherwise inflate exactly the incidents this
+        # metric exists to diagnose
+        fanout_s = (time.perf_counter() - fanout_t0
+                    if self.metrics is not None else 0.0)
+        if dropped:
+            self.dropped_counts[channel] += dropped
+            if self.log is not None:
+                # slow-subscriber detection: a full queue means a consumer
+                # is not keeping up with the publish rate; the trace_id ties
+                # this line to the span and metric views of the same moment
+                self.log.warning(
+                    "slow subscriber: dropped oldest message(s)",
+                    channel=channel, dropped=dropped,
+                    total_dropped=self.dropped_counts[channel],
+                    queue_depth=depth,
+                    trace_id=ctx.get("trace_id") if ctx else None)
+        if self.metrics is not None:
+            self.metrics.observe("bus_fanout_latency_seconds", fanout_s,
+                                 channel=channel)
+            self.metrics.set_gauge("bus_queue_depth", depth, channel=channel)
+            if dropped:
+                self.metrics.inc("bus_dropped_messages_total", dropped,
+                                 channel=channel)
         return delivered
+
+    def queue_depths(self) -> dict[str, int]:
+        """Max pending depth per subscription pattern (telemetry view)."""
+        return {pattern: max((q.qsize() for q in queues), default=0)
+                for pattern, queues in self._subs.items()}
 
     async def listen(self, channel: str) -> AsyncIterator[dict]:
         q = self.subscribe(channel)

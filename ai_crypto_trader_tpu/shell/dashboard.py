@@ -375,6 +375,43 @@ def _patterns_html(signal: dict | None, report: dict | None) -> str:
             + "".join(parts) + "</div>")
 
 
+def _traces_html(traces: list) -> str:
+    """Recent-traces card (the observability counterpart of the reference's
+    unchecked Jaeger TODO): one <details> disclosure per trace with the
+    span tree inside — stage, service, duration, compile/execute split
+    where the span recorded one (model/backtest dispatches)."""
+    items = []
+    for t in traces[:8]:
+        head = (f"{t.get('root', '?')} · {t.get('n_spans', 0)} spans · "
+                f"{float(t.get('duration_s') or 0.0) * 1000:.1f} ms · "
+                f"{str(t.get('trace_id', ''))[:8]}")
+        rows = []
+        spans = sorted(t.get("spans") or [], key=lambda s: s.get("start", 0))
+        for s in spans:
+            dur = ((s.get("end") or 0) - (s.get("start") or 0)) * 1000
+            attrs = s.get("attributes") or {}
+            extra = ""
+            if "compile_s" in attrs:
+                extra = (f" (compile {float(attrs['compile_s']) * 1000:.1f} ms"
+                         f" / execute {float(attrs.get('execute_s') or 0.0) * 1000:.1f} ms)")
+            elif attrs.get("symbol"):
+                extra = f" [{attrs['symbol']}]"
+            marker = "└ " if s.get("parent_id") else ""
+            rows.append(
+                f"<tr><td>{html.escape(marker + str(s.get('name', '?')))}</td>"
+                f"<td>{html.escape(str(s.get('service') or ''))}</td>"
+                f"<td style='text-align:right'>{dur:.2f} ms"
+                f"{html.escape(extra)}</td></tr>")
+        items.append(
+            f"<details><summary>{html.escape(head)}</summary>"
+            f"<table><tr><th>span</th><th>service</th><th>duration</th></tr>"
+            + "".join(rows) + "</table></details>")
+    if not items:
+        return ""
+    return ("<div class='card'><h3>Recent traces</h3>"
+            + "".join(items) + "</div>")
+
+
 def _table(rows: dict, title: str) -> str:
     body = "".join(
         f"<tr><td>{html.escape(str(k))}</td>"
@@ -399,6 +436,7 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
                      model_versions: list | None = None,
                      symbol: str | None = None,
                      symbol_links: list | None = None,
+                     traces: list | None = None,
                      now_fn=time.time) -> str:
     """Return the dashboard HTML. Every section is optional — sections
     render from whatever state exists (like the reference's per-callback
@@ -532,6 +570,10 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
                 f"{s.get('decision')} ({s.get('confidence', 0):.2f})"
                 for s in signals[-10:]}
         sections.append(_table(rows, "Recent signals"))
+    if traces:
+        trace_panel = _traces_html(traces)
+        if trace_panel:
+            sections.append(trace_panel)
     if alerts:
         rows = {a["name"]: f"{a['severity']} — {a['description']}" for a in alerts}
         sections.append(_table(rows, "Active alerts"))

@@ -73,6 +73,17 @@ class DashboardServer:
                         self._send(json.dumps(outer.state(),
                                               default=str).encode(),
                                    "application/json")
+                    elif path == "/traces":
+                        # recent traces from the tracer's ring (empty list
+                        # when tracing is disabled): the JSON twin of the
+                        # span JSONL export, grouped by trace_id
+                        try:
+                            limit = int(q.get("limit", [20])[0])
+                        except ValueError:
+                            limit = 20
+                        self._send(json.dumps(outer.traces(limit),
+                                              default=str).encode(),
+                                   "application/json")
                     elif path == "/metrics":
                         self._send(outer.system.metrics.exposition().encode(),
                                    "text/plain; version=0.0.4")
@@ -127,7 +138,9 @@ class DashboardServer:
         registry = getattr(system, "registry", None)
         versions = (list(registry.entries.values())
                     if registry is not None else None)
+        traces = self.traces(limit=8)
         return render_dashboard(
+            traces=traces or None,
             bus=system.bus,
             klines=klines,
             trades=trades,
@@ -146,6 +159,10 @@ class DashboardServer:
             alerts=list(system.alerts.active.values()),
             refresh_s=self.refresh_s,
             now_fn=system.now_fn)
+
+    def traces(self, limit: int = 20) -> list:
+        tracer = getattr(self.system, "tracer", None)
+        return tracer.traces(limit=limit) if tracer is not None else []
 
     def state(self) -> dict:
         system = self.system

@@ -32,6 +32,7 @@ from ai_crypto_trader_tpu.shell.exchange import (
     ExchangeInterface,
     ExchangeUnavailable,
 )
+from ai_crypto_trader_tpu.utils import tracing
 
 
 @dataclass
@@ -310,8 +311,15 @@ class TradeExecutor:
         while not q.empty():
             env = q.get_nowait()
             try:
-                if await self.handle_signal(env["data"]):
-                    n += 1
+                with tracing.consumer_span(
+                        env, "executor.handle_signal", service="executor",
+                        attributes={"symbol": env["data"].get("symbol")}) as sp:
+                    trade = await self.handle_signal(env["data"])
+                    if trade:
+                        sp.set_attribute("entry_price", trade.entry_price)
+                        n += 1
+                    else:
+                        sp.set_attribute("gated", True)
             except ExchangeUnavailable:
                 q.put_nowait(env)
                 raise

@@ -27,6 +27,7 @@ from ai_crypto_trader_tpu.shell.dashboard import write_dashboard
 from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
 from ai_crypto_trader_tpu.shell.executor import TradeExecutor
 from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
+from ai_crypto_trader_tpu.utils import tracing
 from ai_crypto_trader_tpu.utils.alerts import AlertManager
 from ai_crypto_trader_tpu.utils.health import HeartbeatRegistry
 from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
@@ -46,6 +47,13 @@ class TradingSystem:
     extra_services: list = field(default_factory=list)
     # Structured JSON-lines log sink (utils/structlog.py); None → no file.
     log_path: str | None = None
+    # End-to-end tracing (utils/tracing.py). Default OFF: the disabled hot
+    # path is a single module-global check. `enable_tracing=True` activates
+    # span collection (ring buffer + dashboard /traces); `trace_jsonl`
+    # additionally appends every finished span to a JSONL file (implies
+    # enable_tracing).
+    enable_tracing: bool = False
+    trace_jsonl: str | None = None
 
     @classmethod
     def with_discovery(cls, exchange, scanner=None, **kw):
@@ -69,12 +77,24 @@ class TradingSystem:
     def __post_init__(self):
         from ai_crypto_trader_tpu.utils.structlog import StructuredLogger
 
-        self.bus = EventBus(now_fn=self.now_fn)
         self.log = StructuredLogger("launcher", path=self.log_path,
                                     now_fn=self.now_fn)
         self.metrics = MetricsRegistry(now_fn=self.now_fn)
+        self.tracer = None
+        if self.enable_tracing or self.trace_jsonl:
+            self.tracer = tracing.configure(tracing.Tracer(
+                service="trader", now_fn=self.now_fn,
+                jsonl_path=self.trace_jsonl, metrics=self.metrics))
+            # compile-vs-execute attribution for every traced JAX dispatch,
+            # plus the jit_compile_seconds histogram
+            tracing.JitCompileMonitor.install(metrics=self.metrics)
+        # bus telemetry: fanout latency + queue depth metrics, and slow-
+        # subscriber warnings through the structured log (trace-correlated)
+        self.bus = EventBus(now_fn=self.now_fn, metrics=self.metrics,
+                            log=self.log.child("bus"))
         self.alerts = AlertManager(now_fn=self.now_fn)
-        self.heartbeats = HeartbeatRegistry(now_fn=self.now_fn)
+        self.heartbeats = HeartbeatRegistry(now_fn=self.now_fn,
+                                            log=self.log.child("health"))
         self.monitor = MarketMonitor(self.bus, self.exchange,
                                      symbols=self.symbols, now_fn=self.now_fn)
         self.analyzer = SignalAnalyzer(
@@ -93,11 +113,24 @@ class TradingSystem:
     async def tick(self) -> dict:
         """One full pass of the live signal path + observability.
 
+        With tracing enabled the whole pass runs under one root `tick` span
+        so monitor publish → analyzer handling → executor → model predict
+        all share one trace_id (the envelope-carried context additionally
+        parents each consumer span to the exact publish that caused it).
+
         An exchange outage (open breaker / exhausted retries surfacing as
         ExchangeUnavailable from the resilient adapter) skips the affected
         stage for this tick instead of killing the loop — the reference's
         services likewise treat a circuit-broken call as a skipped cycle
         (`market_monitor_service.py:96-115`)."""
+        with tracing.span("tick", service="launcher") as sp:
+            out = await self._tick_inner()
+            sp.set_attribute("published", out.get("published", 0))
+            sp.set_attribute("analyzed", out.get("analyzed", 0))
+            sp.set_attribute("executed", out.get("executed", 0))
+        return out
+
+    async def _tick_inner(self) -> dict:
         from ai_crypto_trader_tpu.shell.exchange import ExchangeUnavailable
 
         published = analyzed = executed = 0
@@ -127,6 +160,7 @@ class TradingSystem:
             self.metrics.inc("signals_processed_total", executed)
             self.metrics.observe("tick_duration_seconds",
                                  time.perf_counter() - t0)
+            self._emit_health_gauges()
             self.log.warning("exchange unavailable; tick skipped",
                              error=str(exc))
             await self.bus.publish("alerts", {
@@ -166,9 +200,9 @@ class TradingSystem:
         self.metrics.set_gauge("closed_trades", len(self.executor.closed_trades))
         self.metrics.observe("tick_duration_seconds",
                              time.perf_counter() - t0)
-        for service, healthy in self.heartbeats.health().items():
-            self.metrics.set_gauge("service_health", 1.0 if healthy else 0.0,
-                                   service=service)
+        self._emit_health_gauges()
+        self._peak_value = max(getattr(self, "_peak_value", total), total)
+        self.metrics.set_gauge("drawdown_usd", self._peak_value - total)
         for symbol in self.symbols:
             sig = self.bus.get(f"latest_signal_{symbol}")
             if sig:
@@ -199,6 +233,32 @@ class TradingSystem:
             self._render_dashboard()
         return {"published": published, "analyzed": analyzed,
                 "executed": executed, "alerts": len(fired)}
+
+    def _emit_health_gauges(self):
+        """Health/alert-rule gauges (monitoring/alert_rules.yml). Emitted on
+        BOTH tick paths — an open circuit or stale heartbeat must be visible
+        to Prometheus precisely during the outage ticks that skip the main
+        body, or ExchangeCircuitOpen/ServiceDown could never fire."""
+        for service, healthy in self.heartbeats.health().items():
+            self.metrics.set_gauge("service_health", 1.0 if healthy else 0.0,
+                                   service=service)
+        for service, beat_t in self.heartbeats.beats.items():
+            self.metrics.set_gauge("heartbeat_timestamp", beat_t,
+                                   service=service)
+        self.metrics.set_gauge("last_market_update_timestamp",
+                               self._last_market_update)
+        self.metrics.set_gauge("max_positions",
+                               self.config.trading.max_positions)
+        breaker = self.monitor.breaker or getattr(self.exchange, "breaker",
+                                                  None)
+        if breaker is not None:
+            # label key is `breaker` (not `name`): `name` is the metric-name
+            # parameter of set_gauge itself
+            self.metrics.set_gauge(
+                "circuit_state",
+                {"closed": 0.0, "open": 1.0, "half_open": 0.5}.get(
+                    breaker.state.value, 0.0),
+                breaker=breaker.name)
 
     def _update_risk(self):
         """Portfolio risk from live bus data (PortfolioRiskService parity,
@@ -297,6 +357,8 @@ class TradingSystem:
         write_dashboard(self.dashboard_path, bus=self.bus,
                         price_series=prices, symbol=sym,
                         alerts=list(self.alerts.active.values()),
+                        traces=(self.tracer.traces(limit=8)
+                                if self.tracer is not None else None),
                         now_fn=self.now_fn)
 
     def _status_from(self, balances: dict, portfolio_value: float | None = None) -> dict:
@@ -322,6 +384,21 @@ class TradingSystem:
         """Last tick's snapshot — no exchange calls, safe from any thread."""
         cached = getattr(self, "_status_cache", None)
         return cached if cached is not None else self._status_from({})
+
+    def shutdown(self):
+        """Release process-global observability hooks: deactivate THIS
+        system's tracer (a later system's tracer is left alone) and close
+        its JSONL handle — without this, a discarded traced system keeps
+        stamping every future bus publish in the process."""
+        if self.tracer is not None:
+            if tracing.active() is self.tracer:
+                tracing.disable()
+            monitor = tracing.JitCompileMonitor._instance
+            if monitor is not None and monitor.metrics is self.metrics:
+                # stop routing future compile observations into the
+                # discarded registry (listener registration is permanent)
+                monitor.metrics = None
+            self.tracer.close()
 
     async def run(self, duration_s: float | None = None,
                   tick_interval_s: float = 5.0):

@@ -28,6 +28,7 @@ from ai_crypto_trader_tpu.shell.exchange import (
     ExchangeInterface,
     ResilientExchange,
 )
+from ai_crypto_trader_tpu.utils import tracing
 from ai_crypto_trader_tpu.utils.circuit_breaker import CircuitBreaker
 
 
@@ -187,45 +188,57 @@ class MarketMonitor:
         for symbol in (symbols if symbols is not None else self.symbols):
             if not force and now - self._last_pub.get(symbol, -1e18) < self.throttle_s:
                 continue
+            with tracing.span("monitor.poll", service="monitor",
+                              attributes={"symbol": symbol}):
+                published += await self._poll_symbol(symbol, now)
+        return published
+
+    async def _poll_symbol(self, symbol: str, now: float) -> int:
+        """Fetch → features → publish for one symbol (one span each when
+        tracing is on; the market_updates publish inherits the context)."""
+        with tracing.span("monitor.fetch", service="monitor",
+                          attributes={"symbol": symbol,
+                                      "interval": self.intervals[0]}):
             klines = self._fetch(symbol, self.intervals[0])
-            if klines is None:
-                continue
-            self._note_warmup(symbol, self.intervals[0], len(klines))
+        if klines is None:
+            return 0
+        self._note_warmup(symbol, self.intervals[0], len(klines))
+        with tracing.span("monitor.features", service="monitor",
+                          attributes={"symbol": symbol}):
             update = self._features_from_klines(klines[-self.kline_limit:],
                                                 with_combo_scores=True)
-            if update is None:
+        if update is None:
+            return 0
+        combo_last = update.pop("_combo_last", None)
+        if combo_last:
+            update.update(self._structure_view(combo_last))
+        self.bus.set(f"historical_data_{symbol}_{self.intervals[0]}",
+                     klines[-self.kline_limit:])
+        # The 0.6/0.4 trend blend pairs the primary frame with 5m
+        # specifically (`market_monitor_service.py:273` strength_1m*0.6
+        # + strength_5m*0.4); other frames contribute their per-interval
+        # columns (rsi_3m, macd_5m, …, :285-298) without re-blending.
+        blend_iv = "5m" if "5m" in self.intervals[1:] else (
+            self.intervals[1] if len(self.intervals) > 1 else None)
+        for iv in self.intervals[1:]:
+            res = self._fetch(symbol, iv)
+            if not res:
                 continue
-            combo_last = update.pop("_combo_last", None)
-            if combo_last:
-                update.update(self._structure_view(combo_last))
-            self.bus.set(f"historical_data_{symbol}_{self.intervals[0]}",
-                         klines[-self.kline_limit:])
-            # The 0.6/0.4 trend blend pairs the primary frame with 5m
-            # specifically (`market_monitor_service.py:273` strength_1m*0.6
-            # + strength_5m*0.4); other frames contribute their per-interval
-            # columns (rsi_3m, macd_5m, …, :285-298) without re-blending.
-            blend_iv = "5m" if "5m" in self.intervals[1:] else (
-                self.intervals[1] if len(self.intervals) > 1 else None)
-            for iv in self.intervals[1:]:
-                res = self._fetch(symbol, iv)
-                if not res:
-                    continue
-                res = res[-self.kline_limit:]
-                self.bus.set(f"historical_data_{symbol}_{iv}", res)
-                self._note_warmup(symbol, iv, len(res))
-                sec = self._features_from_klines(res)
-                if sec is not None:
-                    if iv == blend_iv:
-                        update["trend_strength"] = (
-                            0.6 * update["trend_strength"]
-                            + 0.4 * sec["trend_strength"])
-                    update[f"signal_{iv}"] = sec["signal"]
-                    update[f"rsi_{iv}"] = sec["rsi"]
-                    update[f"macd_{iv}"] = sec["macd"]
-            update["symbol"] = symbol
-            update["timestamp"] = now
-            self.bus.set(f"market_data_{symbol}", update)
-            await self.bus.publish("market_updates", update)
-            self._last_pub[symbol] = now
-            published += 1
-        return published
+            res = res[-self.kline_limit:]
+            self.bus.set(f"historical_data_{symbol}_{iv}", res)
+            self._note_warmup(symbol, iv, len(res))
+            sec = self._features_from_klines(res)
+            if sec is not None:
+                if iv == blend_iv:
+                    update["trend_strength"] = (
+                        0.6 * update["trend_strength"]
+                        + 0.4 * sec["trend_strength"])
+                update[f"signal_{iv}"] = sec["signal"]
+                update[f"rsi_{iv}"] = sec["rsi"]
+                update[f"macd_{iv}"] = sec["macd"]
+        update["symbol"] = symbol
+        update["timestamp"] = now
+        self.bus.set(f"market_data_{symbol}", update)
+        await self.bus.publish("market_updates", update)
+        self._last_pub[symbol] = now
+        return 1

@@ -27,6 +27,8 @@ import time
 from dataclasses import dataclass, field
 from typing import AsyncIterator
 
+from ai_crypto_trader_tpu.utils import tracing
+
 BINANCE_WS = "wss://stream.binance.com:9443/ws/!miniTicker@arr"
 
 
@@ -104,10 +106,18 @@ class MarketStream:
         the number of updates published."""
         published = 0
         async for frame in frames:
-            self.ingest_frame(frame)
-            published += await self.drain()
+            # one root span per frame: the stream is where a live tick's
+            # causal chain begins, so downstream monitor/analyzer/executor
+            # spans all hang off this trace
+            with tracing.span("stream.frame", service="stream") as sp:
+                marked = self.ingest_frame(frame)
+                n = await self.drain()
+                sp.set_attribute("marked", len(marked))
+                sp.set_attribute("published", n)
+                published += n
         while self._pending:
-            published += await self.drain()
+            with tracing.span("stream.drain", service="stream"):
+                published += await self.drain()
         return published
 
 

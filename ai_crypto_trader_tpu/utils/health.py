@@ -29,17 +29,40 @@ import jax.numpy as jnp
 
 @dataclass
 class HeartbeatRegistry:
+    """``stale_after_s`` is the default staleness threshold; services with
+    a different cadence (a 24 h-retrain model service vs. a 5 s monitor)
+    get per-service overrides via ``stale_after`` ({service: seconds}).
+    With a StructuredLogger attached (``log``), every healthy↔stale
+    transition emits one structured line naming the service."""
+
     stale_after_s: float = 30.0
+    stale_after: dict = field(default_factory=dict)   # per-service override
     now_fn: Callable[[], float] = time.time
+    log: object = None                                # StructuredLogger | None
     beats: dict = field(default_factory=dict)
+    _was_stale: set = field(default_factory=set)
 
     def beat(self, service: str) -> None:
         self.beats[service] = self.now_fn()
 
+    def _threshold(self, service: str) -> float:
+        return self.stale_after.get(service, self.stale_after_s)
+
     def stale(self) -> list[str]:
         now = self.now_fn()
-        return [s for s, t in self.beats.items()
-                if now - t > self.stale_after_s]
+        out = [s for s, t in self.beats.items()
+               if now - t > self._threshold(s)]
+        if self.log is not None:
+            cur = set(out)
+            for s in sorted(cur - self._was_stale):
+                self.log.warning("service went stale", service_name=s,
+                                 age_s=now - self.beats[s],
+                                 threshold_s=self._threshold(s))
+            for s in sorted(self._was_stale - cur):
+                if s in self.beats:
+                    self.log.info("service recovered", service_name=s)
+            self._was_stale = cur
+        return out
 
     def health(self) -> dict:
         """The `service_health` map the alert rules consume."""

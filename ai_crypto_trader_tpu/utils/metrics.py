@@ -17,7 +17,11 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 
-_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, float("inf"))
+# Sub-millisecond decades matter for in-process latencies (bus fanout is
+# ~1-50 µs: with a 1 ms floor every observation lands in the first bucket
+# and histogram_quantile has zero resolution on regressions).
+_BUCKETS = (0.00001, 0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+            float("inf"))
 
 
 @dataclass
@@ -43,11 +47,13 @@ class MetricsRegistry:
         h = self.histograms[self._key(name, labels)]
         h["sum"] += value
         h["count"] += 1
-        # store per-bucket (non-cumulative) counts; exposition() cumulates
+        # Prometheus histogram semantics: buckets are CUMULATIVE — every
+        # `le` bucket counts all observations ≤ its bound, so the +Inf
+        # bucket always equals `count`. Stored cumulatively so exposition
+        # is a plain read (histogram_quantile consumes this directly).
         for b in _BUCKETS:
             if value <= b:
                 h["buckets"][b] += 1
-                break
 
     @contextmanager
     def measure_time(self, name: str, **labels):
@@ -67,13 +73,10 @@ class MetricsRegistry:
         for k, h in sorted(self.histograms.items()):
             base, _, lbl = k.partition("{")
             lbl = ("{" + lbl) if lbl else ""
-            cum = 0
             for b in _BUCKETS:
-                cum += h["buckets"].get(b, 0)
                 le = "+Inf" if b == float("inf") else str(b)
-                sep = "," if lbl else ""
                 l2 = (lbl[:-1] + f',le="{le}"}}') if lbl else f'{{le="{le}"}}'
-                lines.append(f"{base}_bucket{l2} {cum}")
+                lines.append(f"{base}_bucket{l2} {h['buckets'].get(b, 0)}")
             lines.append(f"{base}_sum{lbl} {h['sum']}")
             lines.append(f"{base}_count{lbl} {h['count']}")
         return "\n".join(lines) + "\n"

@@ -19,7 +19,35 @@ import os
 import time
 from dataclasses import dataclass, field
 
+from ai_crypto_trader_tpu.utils import tracing
+
 LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
+
+
+def _default(value):
+    """json.dumps fallback: str(), then repr() if even str() raises."""
+    try:
+        return str(value)
+    except Exception:
+        return object.__repr__(value)
+
+
+def _safe_dumps(record: dict) -> str:
+    """Serialize a record without ever raising mid-hot-path: non-JSON
+    values fall back to str()/repr(), and pathological records (circular
+    refs, str() that raises) degrade field-by-field rather than dropping
+    the whole line."""
+    try:
+        return json.dumps(record, default=_default)
+    except Exception:
+        safe = {}
+        for k, v in record.items():
+            try:
+                json.dumps(v, default=_default)
+                safe[k] = v
+            except Exception:
+                safe[k] = object.__repr__(v)
+        return json.dumps(safe, default=_default)
 
 
 @dataclass
@@ -61,7 +89,13 @@ class StructuredLogger:
             return
         record = {"ts": self.now_fn(), "level": level,
                   "service": service or self.service, "msg": msg, **fields}
-        line = json.dumps(record, default=str)
+        # trace correlation: a log emitted inside a span carries its ids
+        if "trace_id" not in record:
+            sp = tracing.current()
+            if sp is not None:
+                record["trace_id"] = sp.trace_id
+                record["span_id"] = sp.span_id
+        line = _safe_dumps(record)
         if self.path:
             self._rotate_if_needed()
             fh = self._open()

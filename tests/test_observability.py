@@ -54,6 +54,109 @@ class TestStructuredLogger:
         assert rows[0]["service"] == "executor"
 
 
+class TestStructuredLoggerSafety:
+    def test_non_serializable_fields_fall_back_to_repr(self, tmp_path):
+        """A bad field value must never raise mid-hot-path (the log call
+        sits inside the trading loop): objects fall back to str()/repr()."""
+        class Unserializable:
+            def __str__(self):
+                raise RuntimeError("str() is broken too")
+
+        path = str(tmp_path / "svc.jsonl")
+        log = StructuredLogger("svc", path=path, now_fn=lambda: 1.0)
+        log.info("object field", obj=Unserializable(), fine=1)
+        circular = {}
+        circular["self"] = circular
+        log.info("circular field", loop=circular)
+        rows = [json.loads(line) for line in open(path)]
+        assert rows[0]["fine"] == 1
+        assert "Unserializable" in rows[0]["obj"]      # repr fallback
+        assert rows[1]["msg"] == "circular field"
+        assert "loop" in rows[1]                       # degraded, not lost
+
+    def test_ordinary_objects_stringified(self, tmp_path):
+        path = str(tmp_path / "svc.jsonl")
+        log = StructuredLogger("svc", path=path)
+        log.info("set field", vals={1, 2})              # sets aren't JSON
+        row = json.loads(open(path).read())
+        assert "1" in row["vals"] and "2" in row["vals"]
+
+
+class TestHistogramCumulativeBuckets:
+    def test_buckets_monotone_cumulative_and_inf_equals_count(self):
+        """Prometheus semantics: each `le` bucket includes every smaller
+        bucket's observations; +Inf == _count. histogram_quantile silently
+        mis-ranks on non-cumulative buckets, so this is pinned."""
+        import re
+
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        for v in (0.0005, 0.003, 0.003, 0.07, 0.3, 2.0, 100.0):
+            m.observe("lat_seconds", v, stage="x")
+        text = m.exposition()
+        buckets = []
+        for line in text.splitlines():
+            match = re.match(
+                r'crypto_trader_tpu_lat_seconds_bucket\{.*le="([^"]+)"\} '
+                r"(\d+)", line)
+            if match:
+                buckets.append((match.group(1), int(match.group(2))))
+        assert [b[0] for b in buckets][-1] == "+Inf"
+        counts = [b[1] for b in buckets]
+        assert counts == sorted(counts), f"non-monotone buckets: {buckets}"
+        # spot-check the cumulative property against the raw observations
+        by_le = dict(buckets)
+        assert by_le["0.001"] == 1          # 0.0005
+        assert by_le["0.005"] == 3          # + 2×0.003
+        assert by_le["0.1"] == 4            # + 0.07
+        assert by_le["0.5"] == 5            # + 0.3
+        assert by_le["5.0"] == 6            # + 2.0
+        assert by_le["+Inf"] == 7           # everything
+        count_line = [l for l in text.splitlines()
+                      if l.startswith("crypto_trader_tpu_lat_seconds_count")][0]
+        assert int(float(count_line.rsplit(" ", 1)[1])) == 7
+
+
+class TestHeartbeatRegistry:
+    def test_per_service_threshold_override(self):
+        from ai_crypto_trader_tpu.utils.health import HeartbeatRegistry
+
+        clock = {"t": 0.0}
+        hb = HeartbeatRegistry(stale_after_s=30.0,
+                               stale_after={"nn": 3600.0},
+                               now_fn=lambda: clock["t"])
+        hb.beat("monitor")
+        hb.beat("nn")
+        clock["t"] = 100.0            # past the default, inside nn's window
+        assert hb.stale() == ["monitor"]
+        assert hb.health() == {"monitor": False, "nn": True}
+        clock["t"] = 4000.0
+        assert sorted(hb.stale()) == ["monitor", "nn"]
+
+    def test_stale_transitions_logged_once_with_service_name(self, tmp_path):
+        from ai_crypto_trader_tpu.utils.health import HeartbeatRegistry
+
+        path = str(tmp_path / "health.jsonl")
+        clock = {"t": 0.0}
+        hb = HeartbeatRegistry(
+            stale_after_s=30.0, now_fn=lambda: clock["t"],
+            log=StructuredLogger("health", path=path,
+                                 now_fn=lambda: clock["t"]))
+        hb.beat("monitor")
+        clock["t"] = 100.0
+        hb.stale()
+        hb.stale()                    # steady-state: no duplicate lines
+        hb.beat("monitor")            # recovery
+        hb.stale()
+        rows = [json.loads(line) for line in open(path)]
+        assert [(r["msg"], r["service_name"]) for r in rows] == [
+            ("service went stale", "monitor"),
+            ("service recovered", "monitor")]
+        assert rows[0]["level"] == "warning"
+        assert rows[0]["threshold_s"] == 30.0
+
+
 class TestLauncherMetricSeries:
     @pytest.mark.slow
     def test_dashboard_series_emitted(self):
@@ -98,6 +201,34 @@ class TestLauncherMetricSeries:
         assert sys_.log.path == path
 
 
+class TestOutageGauges:
+    def test_alert_gauges_emitted_on_outage_tick(self):
+        """The gauges the alert rules watch (circuit_state, service_health,
+        last_market_update_timestamp, max_positions) must be emitted on the
+        ExchangeUnavailable tick path too — an open circuit is visible to
+        Prometheus exactly DURING the outage, not after recovery."""
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import (
+            ExchangeUnavailable, FakeExchange)
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        series = from_dict(generate_ohlcv(n=700, seed=5), symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series})
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: 1000.0)
+
+        async def down(*a, **kw):
+            raise ExchangeUnavailable("venue down")
+
+        system.monitor.poll = down
+        out = asyncio.run(system.tick())
+        assert "skipped" in out
+        text = system.metrics.exposition()
+        assert 'crypto_trader_tpu_circuit_state{breaker="exchange"}' in text
+        assert "crypto_trader_tpu_last_market_update_timestamp" in text
+        assert "crypto_trader_tpu_max_positions" in text
+
+
 class TestStackConfigCoherence:
     def emitted_series(self):
         """Series names the code can emit, from the instrumentation sites."""
@@ -130,6 +261,49 @@ class TestStackConfigCoherence:
                     queried.add(m.group(1))
         unknown = queried - emitted
         assert not unknown, f"dashboard queries unemitted series: {unknown}"
+
+    def test_prometheus_stack_configs_parse(self):
+        """prometheus.yml and every rule file it references are valid YAML
+        with the structure Prometheus expects (a broken rules file silently
+        disables ALL alerting at deploy time)."""
+        import yaml
+
+        prom = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/prometheus.yml")))
+        assert prom["scrape_configs"], "no scrape configs"
+        assert prom["rule_files"], "no rule files"
+        for rf in prom["rule_files"]:
+            rules = yaml.safe_load(
+                open(os.path.join(REPO, "monitoring", rf)))
+            assert rules["groups"], f"{rf}: no rule groups"
+            for group in rules["groups"]:
+                for rule in group["rules"]:
+                    assert "expr" in rule, (rf, rule)
+                    assert "alert" in rule or "record" in rule, (rf, rule)
+
+    def test_rule_files_reference_only_emitted_series(self):
+        """Every crypto_trader_tpu_* series named in an alert or recording
+        rule must be one the code can emit — a renamed metric otherwise
+        turns its alerts into silent no-data."""
+        import re
+
+        import yaml
+
+        emitted = self.emitted_series()
+        for fname in ("alert_rules.yml", "recording_rules.yml"):
+            rules = yaml.safe_load(
+                open(os.path.join(REPO, "monitoring", fname)))
+            referenced = set()
+            for group in rules["groups"]:
+                for rule in group["rules"]:
+                    for m in re.finditer(
+                            r"crypto_trader_tpu_([a-z_]+?)"
+                            r"(?:_bucket|_sum|_count)?(?![a-z_])",
+                            rule["expr"]):
+                        referenced.add(m.group(1))
+            unknown = referenced - emitted
+            assert not unknown, \
+                f"{fname} references unemitted series: {unknown}"
 
     def test_compose_mounts_exist(self):
         import re

@@ -1,0 +1,330 @@
+"""End-to-end tracing (utils/tracing.py): span propagation through the
+EventBus across chained subscribers, JSONL round-trip, the disabled-by-
+default hot path, JAX compile-vs-execute attribution, and the full
+launcher tick producing one trace from bus publish → subscriber handling
+→ model predict, served back by the dashboard's /traces endpoint."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.utils import tracing
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+from ai_crypto_trader_tpu.utils.tracing import (
+    JitCompileMonitor,
+    Span,
+    Tracer,
+    read_jsonl,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_global_tracer():
+    """Tracing is process-global state: never leak it across tests."""
+    yield
+    tracing.disable()
+
+
+class TestSpanBasics:
+    def test_nesting_parents_and_injected_clock(self):
+        clock = {"t": 100.0}
+        tr = Tracer(service="svc", now_fn=lambda: clock["t"])
+        with tracing.use(tr):
+            with tracing.span("outer") as outer:
+                clock["t"] += 1.0
+                with tracing.span("inner") as inner:
+                    clock["t"] += 0.5
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id is None
+        assert outer.duration == 1.5 and inner.duration == 0.5
+        assert [s.name for s in tr.finished] == ["inner", "outer"]
+
+    def test_error_status_and_attributes(self):
+        tr = Tracer()
+        with tracing.use(tr):
+            with pytest.raises(ValueError):
+                with tracing.span("boom", attributes={"k": 1}) as sp:
+                    sp.add_event("about-to-fail", detail="x")
+                    raise ValueError("nope")
+        done = tr.finished[-1]
+        assert done.status == "error"
+        assert done.attributes["k"] == 1 and "error" in done.attributes
+        assert done.events[0]["name"] == "about-to-fail"
+
+    def test_span_duration_feeds_metrics_histogram(self):
+        m = MetricsRegistry()
+        tr = Tracer(metrics=m, now_fn=lambda: 1.0)
+        with tracing.use(tr):
+            with tracing.span("stage_a"):
+                pass
+        assert 'span_duration_seconds{stage="stage_a"}' in "".join(
+            m.histograms.keys())
+
+
+class TestDisabledDefault:
+    def test_off_by_default_and_zero_allocation(self):
+        assert tracing.active() is None
+        # the disabled path returns the SAME pre-allocated no-op objects —
+        # no per-call span/contextmanager allocation on the hot path
+        c1, c2 = tracing.span("x"), tracing.span("y")
+        assert c1 is c2
+        with c1 as sp1:
+            pass
+        with tracing.span("z") as sp2:
+            sp2.set_attribute("ignored", 1)
+        assert sp1 is sp2
+        assert tracing.inject() is None
+
+    def test_bus_envelope_unstamped_when_disabled(self):
+        async def go():
+            bus = EventBus()
+            q = bus.subscribe("c")
+            await bus.publish("c", {"x": 1})
+            env = q.get_nowait()
+            assert "trace" not in env
+        asyncio.run(go())
+
+
+class TestBusPropagation:
+    def test_three_chained_subscribers_share_one_trace(self, tmp_path):
+        """market-tick shape: origin publish → svc_a → svc_b → svc_c, each
+        republishing on its own channel; one trace_id, correct parent
+        links, and the JSONL export round-trips."""
+        path = str(tmp_path / "spans.jsonl")
+        tr = Tracer(service="test", jsonl_path=path)
+
+        async def go():
+            bus = EventBus()
+            qa, qb, qc = (bus.subscribe("ch1"), bus.subscribe("ch2"),
+                          bus.subscribe("ch3"))
+            with tracing.span("origin") as origin:
+                await bus.publish("ch1", {"hop": 0})
+
+            env_a = qa.get_nowait()
+            with tracing.consumer_span(env_a, "svc_a.handle",
+                                       service="svc_a"):
+                await bus.publish("ch2", {"hop": 1})
+
+            env_b = qb.get_nowait()
+            with tracing.consumer_span(env_b, "svc_b.handle",
+                                       service="svc_b"):
+                await bus.publish("ch3", {"hop": 2})
+
+            env_c = qc.get_nowait()
+            with tracing.consumer_span(env_c, "svc_c.handle",
+                                       service="svc_c"):
+                pass
+            return origin
+
+        with tracing.use(tr):
+            origin = asyncio.run(go())
+
+        spans = {s.name: s for s in tr.finished}
+        assert len(spans) == 4
+        # one trace end to end
+        assert {s.trace_id for s in spans.values()} == {origin.trace_id}
+        # causal chain: each hop parents to the publisher's span
+        assert spans["svc_a.handle"].parent_id == spans["origin"].span_id
+        assert spans["svc_b.handle"].parent_id == spans["svc_a.handle"].span_id
+        assert spans["svc_c.handle"].parent_id == spans["svc_b.handle"].span_id
+        # JSONL export round-trips to the same spans
+        loaded = {s.span_id: s for s in read_jsonl(path)}
+        assert len(loaded) == 4
+        for s in spans.values():
+            r = loaded[s.span_id]
+            assert (r.name, r.trace_id, r.parent_id, r.service) == \
+                   (s.name, s.trace_id, s.parent_id, s.service)
+            assert r.start == s.start and r.end == s.end
+
+    def test_traces_view_groups_by_trace_id(self):
+        tr = Tracer(now_fn=lambda: 5.0)
+        with tracing.use(tr):
+            with tracing.span("t1_root"):
+                with tracing.span("t1_child"):
+                    pass
+            with tracing.span("t2_root"):
+                pass
+        traces = tr.traces()
+        assert len(traces) == 2
+        assert traces[0]["root"] == "t2_root"        # newest first
+        assert traces[1]["root"] == "t1_root"
+        assert traces[1]["n_spans"] == 2
+
+    def test_slow_subscriber_drop_logged_with_trace_id(self, tmp_path):
+        log_path = str(tmp_path / "log.jsonl")
+        from ai_crypto_trader_tpu.utils.structlog import StructuredLogger
+
+        tr = Tracer()
+
+        async def go():
+            bus = EventBus(max_queue=2,
+                           log=StructuredLogger("bus", path=log_path))
+            bus.subscribe("c")
+            with tracing.span("pub") as sp:
+                for i in range(4):
+                    await bus.publish("c", i)
+            return sp
+
+        with tracing.use(tr):
+            sp = asyncio.run(go())
+        rows = [json.loads(line) for line in open(log_path)]
+        drops = [r for r in rows if "slow subscriber" in r["msg"]]
+        assert drops and drops[0]["channel"] == "c"
+        assert drops[0]["trace_id"] == sp.trace_id
+        assert drops[0]["level"] == "warning"
+
+
+class TestJitCompileMonitor:
+    def test_compile_attribution_first_call_only(self):
+        import jax
+        import jax.numpy as jnp
+
+        mon = JitCompileMonitor.install()
+        f = jax.jit(lambda x: x * 3.0 + jnp.sin(x))
+        x = jnp.arange(17, dtype=jnp.float32)      # unique shape → compile
+        before = mon.sample()
+        jax.block_until_ready(f(x))
+        first = mon.since(before)
+        assert first["compiles"] >= 1 and first["compile_s"] > 0.0
+        before = mon.sample()
+        jax.block_until_ready(f(x))                # cached: no new compile
+        second = mon.since(before)
+        assert second["compiles"] == 0 and second["compile_s"] == 0.0
+
+    def test_backtest_entry_records_breakdown(self):
+        from ai_crypto_trader_tpu.backtest import prepare_inputs, run_backtest
+        from ai_crypto_trader_tpu.ops import compute_indicators
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        import jax.numpy as jnp
+
+        d = generate_ohlcv(n=300, seed=1)
+        arrays = {k: jnp.asarray(d[k]) for k in
+                  ("open", "high", "low", "close", "volume")}
+        inp = prepare_inputs(compute_indicators(arrays))
+        tr = Tracer()
+        with tracing.use(tr):
+            run_backtest(inp)
+        spans = [s for s in tr.finished if s.name == "backtest.run"]
+        assert spans, [s.name for s in tr.finished]
+        attrs = spans[-1].attributes
+        assert attrs["candles"] == 300
+        assert "total_s" in attrs and "compile_s" in attrs \
+               and "execute_s" in attrs
+
+
+class TestLauncherEndToEnd:
+    def test_one_trace_publish_to_predict_and_traces_endpoint(self, tmp_path):
+        """The acceptance path: a monitor run with tracing enabled yields a
+        JSONL trace where ONE trace_id spans bus publish → analyzer
+        handling → model predict (with compile-vs-execute attributes on the
+        model span), and /traces serves the same trace."""
+        import urllib.request
+
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.models.service import PredictionService
+        from ai_crypto_trader_tpu.shell.dashboard_server import DashboardServer
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        jsonl = str(tmp_path / "trace.jsonl")
+        clock = {"t": 1_000_000.0}
+        d = generate_ohlcv(n=900, seed=3)
+        series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                           symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000)
+        ex.advance("BTCUSDC", steps=600)
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: clock["t"],
+                               enable_tracing=True, trace_jsonl=jsonl)
+        system.monitor.intervals = ("1m",)         # keep the test lean
+        nn = PredictionService(system.bus, ["BTCUSDC"], intervals=("1m",),
+                               now_fn=lambda: clock["t"], seq_len=8,
+                               epochs=1, units=4)
+        system.extra_services.append(nn)
+        try:
+            async def go():
+                for _ in range(2):
+                    ex.advance("BTCUSDC")
+                    clock["t"] += 60.0
+                    await system.tick()
+            asyncio.run(go())
+
+            spans = read_jsonl(jsonl)
+            by_name = {}
+            for s in spans:
+                by_name.setdefault(s.name, []).append(s)
+            assert nn.predict_count >= 1
+            # every stage of the pipeline produced spans
+            for name in ("tick", "monitor.poll", "monitor.fetch",
+                         "analyzer.handle_update", "model.train",
+                         "model.predict"):
+                assert name in by_name, (name, sorted(by_name))
+            # ONE trace covers publish → handling → predict: the analyzer
+            # span parents to the monitor.poll span that published, and the
+            # model span shares the same tick-rooted trace
+            analyzer = by_name["analyzer.handle_update"][0]
+            poll = {s.span_id: s for s in by_name["monitor.poll"]}
+            assert analyzer.parent_id in poll
+            assert analyzer.trace_id == poll[analyzer.parent_id].trace_id
+            predict = by_name["model.predict"][0]
+            tick_traces = {s.trace_id for s in by_name["tick"]}
+            assert predict.trace_id in tick_traces
+            assert analyzer.trace_id in tick_traces
+            # compile-vs-execute breakdown on the model span
+            for key in ("total_s", "compile_s", "execute_s",
+                        "cache_hits", "cache_misses"):
+                assert key in predict.attributes, predict.attributes
+            # logs carry the same correlation id convention
+            server = DashboardServer(system, port=0).start()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{server.port}/traces",
+                        timeout=5) as r:
+                    served = json.loads(r.read().decode())
+            finally:
+                server.stop()
+            assert served, "no traces served"
+            served_ids = {t["trace_id"] for t in served}
+            assert predict.trace_id in served_ids
+            stage_names = {s["name"] for t in served for s in t["spans"]}
+            assert "model.predict" in stage_names
+            # and the registry carries the new histograms
+            expo = system.metrics.exposition()
+            assert "span_duration_seconds" in expo
+            assert "jit_compile_seconds" in expo
+            assert "bus_fanout_latency_seconds" in expo
+            assert "bus_queue_depth" in expo
+        finally:
+            system.shutdown()
+        # shutdown deactivates THIS system's tracer and closes the JSONL
+        assert tracing.active() is None
+        assert system.tracer._fh is None
+
+    def test_tracing_off_no_spans_no_envelope_overhead(self):
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        clock = {"t": 1_000_000.0}
+        d = generate_ohlcv(n=900, seed=3)
+        series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                           symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000)
+        ex.advance("BTCUSDC", steps=600)
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: clock["t"])
+        system.monitor.intervals = ("1m",)
+        assert system.tracer is None and tracing.active() is None
+        q = system.bus.subscribe("market_updates")
+
+        async def go():
+            ex.advance("BTCUSDC")
+            clock["t"] += 60.0
+            await system.tick()
+        asyncio.run(go())
+        env = q.get_nowait()
+        assert "trace" not in env
