@@ -182,7 +182,9 @@ def cmd_train(args):
                       ("close", "volume", "rsi", "macd", "bb_position",
                        "stoch_k", "atr")], axis=1)
     r = train_model(jax.random.PRNGKey(args.seed), feats, args.model,
-                    seq_len=args.seq_len, epochs=args.epochs, verbose=True)
+                    seq_len=args.seq_len, epochs=args.epochs,
+                    batch_size=args.batch_size, precision=args.precision,
+                    verbose=True)
     pred = predict_prices(r, feats, seq_len=args.seq_len)
     print(json.dumps({"model": args.model, "best_val_loss": r.best_val_loss,
                       "epochs_run": r.epochs_run,
@@ -433,6 +435,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--model", default="lstm")
     sp.add_argument("--epochs", type=int, default=5)
     sp.add_argument("--seq-len", type=int, default=60)
+    sp.add_argument("--batch-size", type=int, default=32)
+    sp.add_argument("--precision", choices=("f32", "bf16"), default="f32",
+                    help="matmul precision for the compiled training "
+                         "epoch (bf16 = MXU-native on TPU)")
     sp.set_defaults(fn=cmd_train)
     sp = sub.add_parser("evolve", help="GA-evolve strategy parameters")
     common(sp)

@@ -11,6 +11,10 @@ from ai_crypto_trader_tpu.models.train import (  # noqa: F401
     train_model,
 )
 from ai_crypto_trader_tpu.models.hpo import optimize_hyperparameters  # noqa: F401
+from ai_crypto_trader_tpu.models.train_loop import (  # noqa: F401
+    EpochTrainer,
+    snapshot_params,
+)
 from ai_crypto_trader_tpu.models.long_context import (  # noqa: F401
     LongContextTransformer,
     long_context_loss,

@@ -153,13 +153,19 @@ def optimize_hyperparameters(
     sampler: str = "tpe",
     n_startup: int = 5,
     target_col: int = 0,
+    precision: str | None = None,
 ) -> dict:
     """Returns {"best_params": ..., "best_val_loss": ..., "trials": [...]}.
 
     ``sampler="tpe"`` (default, the reference's Optuna behavior): the first
     ``n_startup`` rung-0 trials are random, the rest are proposed by the
     Parzen-estimator ratio over results so far. ``"random"`` disables the
-    surrogate."""
+    surrogate.
+
+    Every trial runs through train_model's compiled-epoch path — one
+    donated `lax.scan` program per epoch instead of re-entering the Python
+    batch loop per trial — and ``precision`` ("f32"/"bf16") is forwarded
+    to both rungs."""
     rng = np.random.default_rng(seed)
     results = []
 
@@ -174,7 +180,7 @@ def optimize_hyperparameters(
                         seq_len=seq_len, units=t["units"], dropout=t["dropout"],
                         learning_rate=t["learning_rate"], batch_size=t["batch_size"],
                         epochs=rung_epochs[0], early_stopping_patience=rung_epochs[0],
-                        target_col=target_col)
+                        target_col=target_col, precision=precision)
         results.append({"trial": t, "val_loss": r.best_val_loss, "rung": 0})
 
     # Survivors graduate to the full budget; the winner is chosen among
@@ -189,7 +195,7 @@ def optimize_hyperparameters(
                         t["model_type"], seq_len=seq_len, units=t["units"],
                         dropout=t["dropout"], learning_rate=t["learning_rate"],
                         batch_size=t["batch_size"], epochs=rung_epochs[-1],
-                        target_col=target_col)
+                        target_col=target_col, precision=precision)
         rec = {"trial": t, "val_loss": r.best_val_loss, "rung": 1}
         results[i] = rec
         finalists.append(rec)

@@ -64,6 +64,7 @@ class PredictionService:
     units: int = 32
     retrain_interval_s: float = 86_400.0     # model_checkpoint_interval
     hpo_trials: int = 4
+    precision: str | None = None             # f32 (default) | bf16 matmuls
     checkpoint_dir: str | None = None
     key: any = None
     name: str = "nn"
@@ -114,7 +115,8 @@ class PredictionService:
              "model_type": self.model_type},
             lambda: train_model(k, feats, self.model_type,
                                 seq_len=self.seq_len, epochs=self.epochs,
-                                units=self.units, target_col=3))
+                                units=self.units, target_col=3,
+                                precision=self.precision))
         self.models[(symbol, interval)] = result
         self.train_count += 1
         self._snapshot(symbol, interval, result)
@@ -158,7 +160,8 @@ class PredictionService:
             lambda: optimize_hyperparameters(
                 k, feats, n_trials=self.hpo_trials,
                 rung_epochs=(2, max(2, self.epochs // 2)),
-                seq_len=self.seq_len, target_col=3))
+                seq_len=self.seq_len, target_col=3,
+                precision=self.precision))
         best = hpo["best_params"]
         self.key, k2 = jax.random.split(self.key)
         result = train_model(
@@ -166,7 +169,7 @@ class PredictionService:
             units=best["units"], dropout=best["dropout"],
             learning_rate=best["learning_rate"],
             batch_size=best["batch_size"], epochs=self.epochs,
-            target_col=3)
+            target_col=3, precision=self.precision)
         self.models[(symbol, interval)] = result
         self.train_count += 1
         self._snapshot(symbol, interval, result)

@@ -8,6 +8,12 @@ as pure jitted train/eval steps under optax, with the LR-plateau logic
 implemented via `optax.inject_hyperparams` so the schedule is host-driven
 state, not a callback object.
 
+The default loop is the COMPILED EPOCH (models/train_loop.py): one
+`lax.scan` program per epoch over on-device batches with donated
+params/opt_state, fused validation loss, and exactly ONE host readback per
+epoch.  `compiled_epoch=False` keeps the legacy per-batch Python loop —
+tests assert the two produce the same loss trajectory from the same key.
+
 Multitask horizon losses are weighted 1.0/0.7/0.5
 (`neural_network_service.py:335-344`); the probabilistic head trains on
 Gaussian NLL (:381-391).
@@ -16,6 +22,7 @@ Gaussian NLL (:381-391).
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import Any, NamedTuple, Sequence
 
@@ -24,7 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ai_crypto_trader_tpu.models import train_loop
+from ai_crypto_trader_tpu.models.train_loop import EpochTrainer, snapshot_params
 from ai_crypto_trader_tpu.models.zoo import build_model
+from ai_crypto_trader_tpu.utils import tracing
 
 MULTITASK_WEIGHTS = (1.0, 0.7, 0.5)
 
@@ -114,12 +124,19 @@ def train_model(
     min_lr: float = 1e-6,
     verbose: bool = False,
     target_col: int = 0,
+    precision: str | None = None,
+    compiled_epoch: bool = True,
 ) -> TrainResult:
     """Fit one model; returns params + history + scaler.
 
     Chronological train/val split (no shuffle across the boundary — the
     reference shuffles windows, which leaks future data into training; we
-    split first, then shuffle within train)."""
+    split first, then shuffle within train).
+
+    ``precision``: matmul precision for the training program ("f32"
+    default, "bf16" for bf16-matmul).  ``compiled_epoch``: route through
+    the donated whole-epoch `lax.scan` (default) or the legacy per-batch
+    dispatch loop (kept for the loss-trajectory parity tests)."""
     if horizons is None:
         horizons = (1, 3, 5) if model_type == "multitask" else (1,)
 
@@ -148,48 +165,83 @@ def train_model(
     tx = optax.inject_hyperparams(optax.adam)(learning_rate=learning_rate)
     opt_state = tx.init(params)
 
-    @jax.jit
-    def train_step(params, opt_state, xb, yb, rng):
-        def loss(p):
-            out = model.apply(p, xb, True, rngs={"dropout": rng})
-            return _loss_fn(out, yb, model_type)
+    def train_loss(p, xb, yb, rng):
+        out = model.apply(p, xb, True, rngs={"dropout": rng})
+        return _loss_fn(out, yb, model_type)
 
-        l, grads = jax.value_and_grad(loss)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, l
-
-    @jax.jit
-    def eval_loss(params, xb, yb):
-        return _loss_fn(model.apply(params, xb, False), yb, model_type)
+    def eval_loss(p, xb, yb):
+        return _loss_fn(model.apply(p, xb, False), yb, model_type)
 
     X_val_j, y_val_j = jnp.asarray(X_val), jnp.asarray(y_val)
     n_batches = max(len(X_tr) // batch_size, 1)
 
-    best = TrainResult(params=params, model_type=model_type, scaler=scaler,
-                       target_col=target_col,
+    # Donation-safe snapshot: the raw `params` buffers are invalidated by
+    # the first donated epoch call, and a NaN-from-epoch-0 run must still
+    # return live best params.
+    best = TrainResult(params=snapshot_params(params), model_type=model_type,
+                       scaler=scaler, target_col=target_col,
                        model_kwargs=model_kwargs)
     patience = lr_patience = 0
     lr = learning_rate
 
+    if compiled_epoch:
+        trainer = EpochTrainer(train_loss, tx, eval_loss_fn=eval_loss,
+                               precision=precision)
+        # One host→device transfer for the whole dataset, up front.
+        X_tr_d, y_tr_d = jnp.asarray(X_tr), jnp.asarray(y_tr)
+        run_epoch = lambda params, opt_state, k_shuf, k_ep: trainer.epoch(
+            params, opt_state, X_tr_d, y_tr_d, k_shuf, k_ep,
+            X_val_j, y_val_j, batch_size=batch_size)
+    else:
+        train_step = jax.jit(
+            lambda params, opt_state, xb, yb, rng: _legacy_step(
+                train_loss, tx, params, opt_state, xb, yb, rng))
+        eval_loss_j = jax.jit(eval_loss)
+
+        def run_epoch(params, opt_state, k_shuf, k_ep):
+            # precision context must wrap the CALLS (tracing happens on
+            # first dispatch, not at jit() construction)
+            with train_loop.matmul_precision(precision):
+                perm = np.asarray(jax.random.permutation(k_shuf, len(X_tr)))
+                ep_loss = 0.0
+                for b in range(n_batches):
+                    sl = perm[b * batch_size: (b + 1) * batch_size]
+                    params, opt_state, l = train_step(
+                        params, opt_state, jnp.asarray(X_tr[sl]),
+                        jnp.asarray(y_tr[sl]), jax.random.fold_in(k_ep, b))
+                    ep_loss += float(l)
+                val = eval_loss_j(params, X_val_j, y_val_j)
+            return params, opt_state, jnp.stack(
+                [jnp.asarray(ep_loss / n_batches), val])
+
+    monitor = before = None
+    if tracing.active() is not None:
+        monitor = tracing.JitCompileMonitor.install()
+
     for epoch in range(epochs):
         key, k_shuf, k_ep = jax.random.split(key, 3)
-        perm = np.asarray(jax.random.permutation(k_shuf, len(X_tr)))
-        ep_loss = 0.0
-        for b in range(n_batches):
-            sl = perm[b * batch_size: (b + 1) * batch_size]
-            params, opt_state, l = train_step(
-                params, opt_state, jnp.asarray(X_tr[sl]), jnp.asarray(y_tr[sl]),
-                jax.random.fold_in(k_ep, b))
-            ep_loss += float(l)
-        val_loss = float(eval_loss(params, X_val_j, y_val_j))
-        best.history.append({"epoch": epoch, "loss": ep_loss / n_batches,
+        if monitor is not None:
+            before = monitor.sample()
+        t0 = time.perf_counter()
+        with tracing.span("train.epoch",
+                          attributes={"epoch": epoch,
+                                      "model_type": model_type,
+                                      "n_batches": n_batches}) as sp:
+            params, opt_state, metrics = run_epoch(params, opt_state,
+                                                   k_shuf, k_ep)
+            # THE one host sync per epoch: [train_loss, val_loss] together.
+            ep_loss, val_loss = (float(v) for v in train_loop.host_read(metrics))
+            tracing.attribute_dispatch(sp, monitor, before,
+                                       time.perf_counter() - t0)
+        best.history.append({"epoch": epoch, "loss": ep_loss,
                              "val_loss": val_loss, "lr": lr})
         if verbose:
-            print(f"epoch {epoch}: loss={ep_loss/n_batches:.5f} val={val_loss:.5f}")
+            print(f"epoch {epoch}: loss={ep_loss:.5f} val={val_loss:.5f}")
 
         if val_loss < best.best_val_loss - 1e-7:
             best.best_val_loss = val_loss
-            best.params = params
+            # copy, not alias: the live params are donated next epoch
+            best.params = snapshot_params(params)
             patience = lr_patience = 0
         else:
             patience += 1
@@ -202,6 +254,14 @@ def train_model(
                 break
     best.epochs_run = epoch + 1
     return best
+
+
+def _legacy_step(train_loss, tx, params, opt_state, xb, yb, rng):
+    """One per-batch update — the pre-compiled-epoch loop body, kept for
+    the loss-trajectory parity tests."""
+    l, grads = jax.value_and_grad(train_loss)(params, xb, yb, rng)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, l
 
 
 def predict_prices(result: TrainResult, features: np.ndarray,

@@ -1,0 +1,155 @@
+"""Shared compiled-epoch trainer: one XLA program per epoch, one host sync.
+
+Every training path in the repo (model zoo, HPO trials, chart-pattern
+classifier; the DQN has its own scan in rl/dqn.py) used to run the same
+Python minibatch loop: per step it paid a jit dispatch, a fresh
+`jnp.asarray` host→device copy of the batch, and a `float(loss)` that
+blocked the device — while params/opt_state round-tripped through XLA's
+copy-on-call semantics.  Podracer's Anakin pattern (PAPERS: arxiv
+2104.06272, 2206.08888) moves the whole epoch under `jit`:
+
+  * the dataset lives on device as one [N, ...] tensor; each epoch is a
+    `lax.scan` over `[n_batches, B, ...]` batches gathered on device via
+    `jax.random.permutation` + `take`;
+  * dropout keys are `fold_in`-ed per batch INSIDE the scan;
+  * `(params, opt_state)` are donated (`donate_argnums`), so XLA updates
+    them in place instead of copying;
+  * the epoch train loss is accumulated on device, the validation loss is
+    computed in the SAME program, and the host reads both back in ONE
+    [2]-vector transfer per epoch (`host_read`) — the only device sync in
+    the loop.  LR-plateau / early-stopping logic stays host-side.
+
+A `precision` knob selects the matmul precision for the whole epoch
+program ("f32" default; "bf16" routes matmuls through
+`jax.default_matmul_precision("bfloat16")` — on TPU that is the MXU's
+native mode, on CPU it maps to whatever the backend offers).
+
+CAUTION (donation): the params/opt_state pytrees PASSED to `epoch()` are
+invalidated by the call.  Hold `snapshot_params()` copies (not the donated
+inputs) for best-params bookkeeping.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+_PRECISIONS = {
+    # None = backend default (f32 on CPU; the MXU's default mode on TPU).
+    # "f32" must force FULL float32 — mapping it to None would silently
+    # leave TPU matmuls at the bf16-ish DEFAULT precision.
+    None: None,
+    "f32": "float32", "float32": "float32", "highest": "highest",
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "tf32": "tensorfloat32", "tensorfloat32": "tensorfloat32",
+}
+
+
+def canonical_precision(precision: str | None) -> str | None:
+    """Map user-facing knob values to `jax.default_matmul_precision` names
+    (None → backend default)."""
+    try:
+        return _PRECISIONS[precision]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision {precision!r}; one of {sorted(set(k for k in _PRECISIONS if k))}"
+        ) from None
+
+
+def matmul_precision(precision: str | None):
+    """Context manager applying the canonical precision (no-op for f32)."""
+    p = canonical_precision(precision)
+    return jax.default_matmul_precision(p) if p else contextlib.nullcontext()
+
+
+def host_read(x) -> np.ndarray:
+    """THE per-epoch host sync: device metrics → numpy.
+
+    Kept as a module-level seam so tests can wrap it with a counting
+    double and assert the loop performs exactly one sync per epoch."""
+    return np.asarray(x)
+
+
+def snapshot_params(tree):
+    """Device-side copy of a pytree — donation-safe best-params snapshot
+    (the donated originals are invalidated by the next epoch call)."""
+    return jax.tree.map(jnp.copy, tree)
+
+
+class EpochTrainer:
+    """Compiles `train_loss_fn` + `tx` into a donated whole-epoch program.
+
+    train_loss_fn(params, xb, yb, rng) -> scalar loss   (rng: dropout key)
+    eval_loss_fn(params, X_val, y_val) -> scalar loss   (optional; fused
+        into the same program so validation costs no extra dispatch)
+
+    `epoch(...)` returns (params, opt_state, metrics) where metrics is a
+    device [2]-vector [mean_train_loss, val_loss] (val repeats the train
+    loss when no eval_loss_fn was given).  Read it back with
+    `host_read(metrics)` — once per epoch.
+    """
+
+    def __init__(self, train_loss_fn: Callable, tx, *,
+                 eval_loss_fn: Callable | None = None,
+                 precision: str | None = None):
+        self.train_loss_fn = train_loss_fn
+        self.eval_loss_fn = eval_loss_fn
+        self.tx = tx
+        self.precision = canonical_precision(precision)
+        self._with_val = eval_loss_fn is not None
+
+        def body(carry, inp, k_drop):
+            params, opt_state, loss_sum = carry
+            i, xb, yb = inp
+            rng = jax.random.fold_in(k_drop, i)
+            loss, grads = jax.value_and_grad(self.train_loss_fn)(
+                params, xb, yb, rng)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, loss_sum + loss), None
+
+        def scan_epoch(params, opt_state, X, y, k_perm, k_drop, batch_size):
+            n = X.shape[0]
+            bs = min(batch_size, n)
+            nb = max(n // bs, 1)
+            perm = jax.random.permutation(k_perm, n)[: nb * bs]
+            idx = perm.reshape(nb, bs)
+            Xb = jnp.take(X, idx, axis=0)        # [nb, bs, ...] on device
+            yb = jnp.take(y, idx, axis=0)
+            (params, opt_state, loss_sum), _ = jax.lax.scan(
+                lambda c, i: body(c, i, k_drop),
+                (params, opt_state, jnp.zeros((), X.dtype)),
+                (jnp.arange(nb), Xb, yb))
+            return params, opt_state, loss_sum / nb
+
+        if self._with_val:
+            def _epoch(params, opt_state, X, y, k_perm, k_drop,
+                       X_val, y_val, *, batch_size):
+                params, opt_state, train_loss = scan_epoch(
+                    params, opt_state, X, y, k_perm, k_drop, batch_size)
+                val = self.eval_loss_fn(params, X_val, y_val)
+                return params, opt_state, jnp.stack([train_loss, val])
+        else:
+            def _epoch(params, opt_state, X, y, k_perm, k_drop,
+                       *, batch_size):
+                params, opt_state, train_loss = scan_epoch(
+                    params, opt_state, X, y, k_perm, k_drop, batch_size)
+                return params, opt_state, jnp.stack([train_loss, train_loss])
+
+        self._epoch = jax.jit(_epoch, static_argnames=("batch_size",),
+                              donate_argnums=(0, 1))
+
+    def epoch(self, params, opt_state, X, y, k_perm, k_drop,
+              X_val=None, y_val=None, *, batch_size: int):
+        """One compiled epoch.  DONATES params/opt_state (see module doc)."""
+        with matmul_precision(self.precision):
+            if self._with_val:
+                return self._epoch(params, opt_state, X, y, k_perm, k_drop,
+                                   X_val, y_val, batch_size=batch_size)
+            return self._epoch(params, opt_state, X, y, k_perm, k_drop,
+                               batch_size=batch_size)

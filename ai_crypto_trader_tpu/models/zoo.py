@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ai_crypto_trader_tpu.models.fused_lstm import FusedLSTM
 
 Dtype = Any
 
@@ -45,7 +46,11 @@ def sinusoidal_positions(seq_len: int, d_model: int) -> jnp.ndarray:
 
 
 class RecurrentEncoder(nn.Module):
-    """Stacked LSTM/GRU encoder with inter-layer dropout."""
+    """Stacked LSTM/GRU encoder with inter-layer dropout.
+
+    The LSTM path runs the fused custom-VJP layer (models/fused_lstm.py)
+    in time-major layout — one transpose at each encoder boundary instead
+    of per layer; GRU keeps the flax RNN cell."""
 
     units: int = 64
     num_layers: int = 2
@@ -55,12 +60,24 @@ class RecurrentEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        cell_cls = {"lstm": nn.OptimizedLSTMCell, "gru": nn.GRUCell}[self.cell]
+        if self.cell == "lstm":
+            h = x.swapaxes(0, 1)                       # [T, B, F]
+            for layer in range(self.num_layers):
+                fwd = FusedLSTM(self.units, name=f"rnn_{layer}")(h)
+                if self.bidirectional:
+                    bwd = jnp.flip(FusedLSTM(
+                        self.units, name=f"rnn_b_{layer}")(
+                            jnp.flip(h, axis=0)), axis=0)
+                    h = jnp.concatenate([fwd, bwd], axis=-1)
+                else:
+                    h = fwd
+                h = nn.Dropout(self.dropout, deterministic=not train)(h)
+            return h.swapaxes(0, 1)
         for layer in range(self.num_layers):
-            rnn = nn.RNN(cell_cls(self.units), name=f"rnn_{layer}")
+            rnn = nn.RNN(nn.GRUCell(self.units), name=f"rnn_{layer}")
             if self.bidirectional:
                 fwd = rnn(x)
-                bwd = jnp.flip(nn.RNN(cell_cls(self.units), name=f"rnn_b_{layer}")(
+                bwd = jnp.flip(nn.RNN(nn.GRUCell(self.units), name=f"rnn_b_{layer}")(
                     jnp.flip(x, axis=1)), axis=1)
                 x = jnp.concatenate([fwd, bwd], axis=-1)
             else:
@@ -99,8 +116,7 @@ class CNNLSTM(nn.Module):
         x = nn.max_pool(x, window_shape=(2,), strides=(2,))
         x = nn.Conv(self.units, kernel_size=(3,), padding="SAME")(x)
         x = nn.relu(x)
-        x = nn.RNN(nn.OptimizedLSTMCell(self.units))(x)
-        h = x[:, -1, :]
+        h = FusedLSTM(self.units)(x.swapaxes(0, 1))[-1]   # last hidden state
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return {"mean": nn.Dense(1)(h)}
 

@@ -26,6 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ai_crypto_trader_tpu.models import train_loop
+from ai_crypto_trader_tpu.models.fused_lstm import FusedLSTM
+from ai_crypto_trader_tpu.models.train_loop import EpochTrainer
 from ai_crypto_trader_tpu.patterns.synthetic import (
     N_CLASSES, PATTERN_CLASSES, generate_dataset,
 )
@@ -58,7 +61,7 @@ class PatternCNN(nn.Module):
 class PatternLSTM(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
-        h = nn.RNN(nn.OptimizedLSTMCell(64))(_center(x))[:, -1, :]
+        h = FusedLSTM(64)(_center(x).swapaxes(0, 1))[-1]
         h = nn.relu(nn.Dense(64)(h))
         h = nn.Dropout(0.3, deterministic=not train)(h)
         return nn.Dense(N_CLASSES)(h)
@@ -69,7 +72,7 @@ class PatternCNNLSTM(nn.Module):
     def __call__(self, x, train: bool = False):
         x = nn.relu(nn.Conv(32, (5,), padding="SAME")(_center(x)))
         x = nn.max_pool(x, (2,), strides=(2,))
-        h = nn.RNN(nn.OptimizedLSTMCell(64))(x)[:, -1, :]
+        h = FusedLSTM(64)(x.swapaxes(0, 1))[-1]
         h = nn.Dropout(0.3, deterministic=not train)(h)
         return nn.Dense(N_CLASSES)(h)
 
@@ -144,6 +147,9 @@ class PatternRecognizer:
     model_type: str = "cnn"
     params: Any = None
     history: list = field(default_factory=list)
+    # False marks a random-init recognizer (stack fallback): services tag
+    # everything it publishes "untrained" so downstream consumers can gate
+    trained: bool = True
 
     def logits(self, x, train=False, rngs=None):
         return _build(self.model_type).apply(self.params, x, train, rngs=rngs)
@@ -152,9 +158,12 @@ class PatternRecognizer:
 def train_pattern_model(key, model_type: str = "cnn", *, n_per_class: int = 64,
                         epochs: int = 10, batch_size: int = 64,
                         learning_rate: float = 1e-3, T: int = 60,
+                        precision: str | None = None,
                         verbose: bool = False) -> PatternRecognizer:
     """Train on the synthetic generators (the reference's only data source,
-    `pattern_recognition.py:813-1039`)."""
+    `pattern_recognition.py:813-1039`) — each epoch is one donated
+    compiled `lax.scan` program (models/train_loop.py), with a single
+    host readback per epoch."""
     k_data, k_init, key = jax.random.split(key, 3)
     X, y = generate_dataset(k_data, n_per_class, T)
     model = _build(model_type)
@@ -162,31 +171,20 @@ def train_pattern_model(key, model_type: str = "cnn", *, n_per_class: int = 64,
     tx = optax.adam(learning_rate)
     opt_state = tx.init(params)
 
-    @jax.jit
-    def step(params, opt_state, xb, yb, rng):
-        def loss_fn(p):
-            logits = model.apply(p, xb, True, rngs={"dropout": rng})
-            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+    def loss_fn(p, xb, yb, rng):
+        logits = model.apply(p, xb, True, rngs={"dropout": rng})
+        return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state)
-        return optax.apply_updates(params, updates), opt_state, loss
-
+    trainer = EpochTrainer(loss_fn, tx, precision=precision)
     rec = PatternRecognizer(model_type=model_type)
-    n = X.shape[0]
     for epoch in range(epochs):
         key, k_perm, k_ep = jax.random.split(key, 3)
-        perm = jax.random.permutation(k_perm, n)
-        ep_loss, nb = 0.0, 0
-        for b in range(0, n - batch_size + 1, batch_size):
-            sl = perm[b: b + batch_size]
-            params, opt_state, l = step(params, opt_state, X[sl], y[sl],
-                                        jax.random.fold_in(k_ep, b))
-            ep_loss += float(l)
-            nb += 1
-        rec.history.append({"epoch": epoch, "loss": ep_loss / max(nb, 1)})
+        params, opt_state, metrics = trainer.epoch(
+            params, opt_state, X, y, k_perm, k_ep, batch_size=batch_size)
+        ep_loss = float(train_loop.host_read(metrics)[0])   # one sync/epoch
+        rec.history.append({"epoch": epoch, "loss": ep_loss})
         if verbose:
-            print(f"pattern {model_type} epoch {epoch}: {ep_loss/max(nb,1):.4f}")
+            print(f"pattern {model_type} epoch {epoch}: {ep_loss:.4f}")
     rec.params = params
     return rec
 

@@ -110,6 +110,11 @@ class ChartPatternService:
         analysis = detect_patterns(
             self.recognizer, ohlcv, seq_len=self.seq_len, stride=self.stride,
             confidence_threshold=self.confidence_threshold)
+        untrained = not getattr(self.recognizer, "trained", True)
+        if untrained:
+            # random-init fallback recognizer (shell/stack.py): keep the
+            # cadence alive but mark every artifact so consumers can gate
+            analysis["model_status"] = "untrained"
         self.pattern_data[symbol] = analysis
         self.bus.set(f"pattern_analysis_{symbol}", analysis)
 
@@ -118,6 +123,8 @@ class ChartPatternService:
                 and signals["strength"] >= self.min_publish_strength):
             signals.update({"symbol": symbol, "timestamp": now,
                             "source": "pattern_recognition"})
+            if untrained:
+                signals["model_status"] = "untrained"
             await self.bus.publish("pattern_signals", signals)
             self.bus.set(f"pattern_signals_{symbol}", signals)
             return signals

@@ -13,4 +13,5 @@ from ai_crypto_trader_tpu.rl.dqn import (  # noqa: F401
     evaluate_policy,
     train_dqn,
     train_iteration,
+    train_iterations,
 )

@@ -27,6 +27,7 @@ from typing import NamedTuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ai_crypto_trader_tpu.rl.env import EnvParams, EnvState, OBS_SIZE, env_reset, env_step
@@ -104,7 +105,10 @@ def dqn_init(key, env_params: EnvParams, cfg: DQNConfig) -> DQNState:
     )
     env_states, obs = jax.vmap(lambda k: env_reset(env_params, k))(
         jax.random.split(k_env, cfg.num_envs))
-    return DQNState(params=params, target_params=params,
+    # target_params must be a distinct buffer: train_iterations donates the
+    # whole DQNState, and XLA rejects donating the same buffer twice
+    return DQNState(params=params,
+                    target_params=jax.tree.map(jnp.copy, params),
                     opt_state=_optimizer(cfg).init(params), replay=replay,
                     env_states=env_states, obs=obs,
                     epsilon=jnp.asarray(cfg.epsilon, jnp.float32),
@@ -155,10 +159,10 @@ def _learn(params, target_params, opt_state, rep: Replay, key, cfg: DQNConfig):
     return optax.apply_updates(params, updates), opt_state, loss
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def train_iteration(env_params: EnvParams, state: DQNState, cfg: DQNConfig):
-    """One compiled iteration: rollout_len vmapped env steps → replay writes
-    → learn_steps_per_iter updates → target sync / ε decay."""
+def _iteration(env_params: EnvParams, state: DQNState, cfg: DQNConfig):
+    """One iteration body: rollout_len vmapped env steps → replay writes
+    → learn_steps_per_iter updates → target sync / ε decay.  Shared by the
+    single-iteration jit and the multi-iteration scan below."""
 
     def rollout_step(carry, _):
         env_states, obs, eps, key = carry
@@ -210,17 +214,60 @@ def train_iteration(env_params: EnvParams, state: DQNState, cfg: DQNConfig):
     return new_state, metrics
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def train_iteration(env_params: EnvParams, state: DQNState, cfg: DQNConfig):
+    """One compiled iteration (kept for callers that need per-iteration
+    host control; the throughput path is `train_iterations`)."""
+    return _iteration(env_params, state, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_iters"),
+                   donate_argnums=(1,))
+def train_iterations(env_params: EnvParams, state: DQNState, cfg: DQNConfig,
+                     n_iters: int = 1):
+    """K iterations as ONE compiled `lax.scan` with the DQNState donated:
+    params, replay ring, env states and opt state update in place, and the
+    host reads metrics back once per K iterations instead of once per
+    iteration — metrics readback no longer serializes the device queue.
+    Returns (state, metrics) with each metric stacked to [n_iters]."""
+    return jax.lax.scan(lambda st, _: _iteration(env_params, st, cfg),
+                        state, None, length=n_iters)
+
+
 def train_dqn(key, env_params: EnvParams, cfg: DQNConfig,
-              iterations: int = 100, log_every: int = 0):
+              iterations: int = 100, log_every: int = 0,
+              iters_per_sync: int | None = None):
     """Host driver (`train`, `reinforcement_learning.py:421-503`): returns
-    (final DQNState, history)."""
+    (final DQNState, history).
+
+    Iterations run in chunks of ``iters_per_sync`` through the donated
+    multi-iteration scan, with one metrics readback per chunk; history rows
+    keep the old selection (every ``log_every``-th iteration plus the
+    last).  The default chunk is the largest divisor of ``iterations`` not
+    exceeding ``log_every`` (or 16): ``n_iters`` is a static argnum, so
+    equal chunks mean the scan program compiles exactly once — a ragged
+    remainder chunk would recompile the whole rollout+learn program just
+    to run a few leftover iterations."""
     state = dqn_init(key, env_params, cfg)
+    if iters_per_sync is None:
+        cap = max(min(log_every if log_every else 16, iterations), 1)
+        divisor = max(k for k in range(1, cap + 1) if iterations % k == 0)
+        # a divisor-poor count (e.g. prime iterations) would degenerate to
+        # per-iteration syncs — there, prefer full chunks plus one ragged
+        # remainder (a second scan compile) over hundreds of host syncs
+        iters_per_sync = divisor if divisor * 2 >= cap else cap
     history = []
-    for it in range(iterations):
-        state, m = train_iteration(env_params, state, cfg)
-        is_last = it == iterations - 1
-        if is_last or (log_every and it % log_every == 0):
-            history.append({k: float(v) for k, v in m.items()} | {"iter": it})
+    it0 = 0
+    while it0 < iterations:
+        k = min(max(iters_per_sync, 1), iterations - it0)
+        state, m = train_iterations(env_params, state, cfg, n_iters=k)
+        host = {name: np.asarray(v) for name, v in m.items()}  # one sync
+        for j in range(k):
+            it = it0 + j
+            if it == iterations - 1 or (log_every and it % log_every == 0):
+                history.append({name: float(v[j]) for name, v in host.items()}
+                               | {"iter": it})
+        it0 += k
     return state, history
 
 

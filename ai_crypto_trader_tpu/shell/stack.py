@@ -12,10 +12,75 @@ roster still lacked — a periodic evolver and a regime cadence — and
 
 from __future__ import annotations
 
+import logging
+import os
 import time
 from dataclasses import dataclass, field
 
 import numpy as np
+
+
+def _pattern_recognizer(seq_len: int, pat_kw: dict):
+    """Resolve the stack's PatternRecognizer, best source first:
+
+      1. a saved checkpoint (``checkpoint`` kwarg, default
+         models/pattern_<model_type>) — params trained by a previous run;
+      2. train on the synthetic generators at startup (the reference's
+         only data source) and persist the checkpoint for next time;
+      3. random init, marked ``trained=False`` — ChartPatternService tags
+         everything it publishes ``model_status: "untrained"`` so nothing
+         downstream mistakes noise for a signal.
+
+    Budget knobs ride the ``patterns`` cadence dict: ``checkpoint``
+    (None disables persistence), ``train_on_start`` (False skips 2),
+    ``train_kwargs`` (epochs/n_per_class/... for train_pattern_model)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ai_crypto_trader_tpu.patterns.model import (
+        PatternRecognizer, _build, train_pattern_model)
+    from ai_crypto_trader_tpu.utils.checkpoint import (
+        load_checkpoint, save_checkpoint)
+
+    model_type = pat_kw.pop("model_type", "cnn")
+    ckpt = pat_kw.pop("checkpoint", f"models/pattern_{model_type}")
+    train_on_start = pat_kw.pop("train_on_start", True)
+    train_kw = {"epochs": 4, "n_per_class": 16,
+                **pat_kw.pop("train_kwargs", {})}
+
+    if ckpt and os.path.isdir(ckpt):
+        try:
+            tree, meta = load_checkpoint(ckpt)
+            mt = meta.get("model_type", model_type)
+            if meta.get("seq_len") not in (None, seq_len):
+                raise ValueError("checkpoint seq_len mismatch")
+            # smoke apply: a checkpoint whose param tree no longer matches
+            # the current architecture (different seq_len flatten width, a
+            # pre-fused-LSTM cell layout, ...) must fall through to
+            # retraining now, not crash ChartPatternService at detect time
+            _build(mt).apply(tree, jnp.zeros((1, seq_len, 5), jnp.float32),
+                             False)
+            return PatternRecognizer(mt, params=tree, trained=True)
+        except Exception as e:                   # noqa: BLE001 — fall through
+            logging.getLogger(__name__).warning(
+                "pattern checkpoint %s unusable (%s: %s); falling back to "
+                "startup training", ckpt, type(e).__name__, e)
+    if train_on_start:
+        rec = train_pattern_model(jax.random.PRNGKey(0), model_type,
+                                  T=seq_len, **train_kw)
+        if ckpt:
+            try:
+                save_checkpoint(ckpt, rec.params,
+                                metadata={"model_type": model_type,
+                                          "seq_len": seq_len})
+            except Exception as e:               # noqa: BLE001 — best-effort
+                logging.getLogger(__name__).warning(
+                    "could not persist pattern checkpoint %s (%s: %s)",
+                    ckpt, type(e).__name__, e)
+        return rec
+    return PatternRecognizer(model_type, params=_build(model_type).init(
+        jax.random.PRNGKey(0), jnp.zeros((2, seq_len, 5), jnp.float32),
+        False), trained=False)
 
 
 @dataclass
@@ -117,7 +182,6 @@ def build_full_stack(system, *, registry=None, llm=None,
     ``system.extra_services``).  ``cadences`` overrides per-service kwargs
     by service name — the soak test shrinks training epochs and intervals
     through it; production uses the defaults."""
-    from ai_crypto_trader_tpu.patterns.model import PatternRecognizer, _build
     from ai_crypto_trader_tpu.patterns.service import ChartPatternService
     from ai_crypto_trader_tpu.regime.service import MarketRegimeService
     from ai_crypto_trader_tpu.social.news import NewsService
@@ -138,13 +202,8 @@ def build_full_stack(system, *, registry=None, llm=None,
     ]
 
     pat_kw = kw("patterns")
-    import jax
-    import jax.numpy as jnp
-
     seq_len = pat_kw.pop("seq_len", 60)
-    rec = PatternRecognizer("cnn", params=_build("cnn").init(
-        jax.random.PRNGKey(0), jnp.zeros((2, seq_len, 5), jnp.float32),
-        False))
+    rec = _pattern_recognizer(seq_len, pat_kw)
     services.append(ChartPatternService(bus, rec, symbols, seq_len=seq_len,
                                         now_fn=now_fn, **pat_kw))
 

@@ -285,24 +285,44 @@ class NewsService:
         for symbol in self.symbols:
             if now - self._last.get(symbol, -1e18) < self.poll_interval_s:
                 continue
+            # burn the poll slot BEFORE the empty-fetch continue: an empty
+            # provider response must still respect poll_interval_s instead
+            # of re-polling (and re-billing the upstream) every tick
+            self._last[symbol] = now
             articles = provider(self.bus, symbol)
             if not articles:
                 continue
-            self._last[symbol] = now
             agg = analyzer.aggregate(articles, base_asset(symbol))
             analyses = agg.pop("analyses", [])
             agg.update({"symbol": symbol, "timestamp": now})
             recent = self.bus.get(f"news_recent_{symbol}") or []
+            # dedup against the whole retained window, not just the tail:
+            # a provider that re-serves a BATCH of headlines would pass a
+            # tail-only check for every entry but the last one.  Articles
+            # without a published_at (optional field) can't key on the
+            # stored poll-time default (every re-serve would look fresh) —
+            # they dedup on title, but only against the last batch-width of
+            # entries: a re-served batch is caught, while a recurring
+            # headline (a daily wrap) re-enters once the feed has moved on.
+            seen = {(e.get("title"), e.get("published_at")) for e in recent}
+            seen_titles = {e.get("title") for e in recent[-len(articles):]}
             for article, analysis in zip(articles, analyses):
-                recent.append({
+                raw_pub = article.get("published_at")
+                entry = {
                     "title": article.get("title", ""),
                     "source": article.get("source", ""),
-                    "published_at": article.get("published_at", now),
+                    "published_at": now if raw_pub is None else raw_pub,
                     "direction": analysis["direction"],
                     "sentiment": analysis["sentiment"]["compound"],
                     "market_impact": analysis["market_impact"],
                     "topics": analysis["topics"],
-                })
+                }
+                if (raw_pub is None and entry["title"] in seen_titles) or \
+                        (entry["title"], raw_pub) in seen:
+                    continue
+                seen.add((entry["title"], entry["published_at"]))
+                seen_titles.add(entry["title"])
+                recent.append(entry)
             self.bus.set(f"news_analysis_{symbol}", agg)
             self.bus.set(f"news_recent_{symbol}", recent[-self.history_len:])
             await self.bus.publish("news_updates", agg)

@@ -111,11 +111,12 @@ def reference_cpu_candles_per_sec(inputs, n=200_000) -> float:
     return n / dt
 
 
-def emit(metric, value, unit, vs_baseline=None, engine=None):
+def emit(metric, value, unit, vs_baseline=None, engine=None, **extra):
     row = {"metric": metric, "value": round(value, 3), "unit": unit,
            "vs_baseline": vs_baseline, "backend": BACKEND}
     if engine is not None:
         row["engine"] = engine
+    row.update(extra)
     print(json.dumps(row), flush=True)
 
 
@@ -310,23 +311,24 @@ def bench_rl(ind):
     import jax
 
     from ai_crypto_trader_tpu.rl import (
-        DQNConfig, dqn_init, make_env_params, train_iteration)
+        DQNConfig, dqn_init, make_env_params, train_iterations)
 
     cfg = DQNConfig(num_envs=256, rollout_len=32)
     p = make_env_params(ind, episode_len=512)
     st = dqn_init(jax.random.PRNGKey(0), p, cfg)
-    st, _ = train_iteration(p, st, cfg)           # compile
-    fetch(st.params["params"]["Dense_0"]["kernel"])
     iters = 20
+    # K iterations per host round-trip: the donated scan entry, so metrics
+    # readback no longer serializes the iterations (ISSUE 3 / rl/dqn.py)
+    st, _ = train_iterations(p, st, cfg, n_iters=iters)       # compile
+    fetch(st.params["params"]["Dense_0"]["kernel"])
     t0 = time.perf_counter()
-    for _ in range(iters):
-        st, _ = train_iteration(p, st, cfg)
+    st, m = train_iterations(p, st, cfg, n_iters=iters)
     fetch(st.params["params"]["Dense_0"]["kernel"])
     dt = time.perf_counter() - t0
     steps_per_sec = iters * cfg.num_envs * cfg.rollout_len / dt
-    log(f"RL: {iters} iterations ({cfg.num_envs} envs × {cfg.rollout_len} "
-        f"steps + {cfg.learn_steps_per_iter} learns) in {dt:.3f}s → "
-        f"{steps_per_sec:,.0f} env steps/s")
+    log(f"RL: {iters} scanned iterations ({cfg.num_envs} envs × "
+        f"{cfg.rollout_len} steps + {cfg.learn_steps_per_iter} learns, "
+        f"donated) in {dt:.3f}s → {steps_per_sec:,.0f} env steps/s")
     # A100-with-host-env DQN is env-bound at ~1e5 steps/s (BASELINE.md §RL)
     emit("rl_env_steps_per_sec", steps_per_sec, "steps/s",
          round(steps_per_sec / 1e5, 1))
@@ -368,12 +370,25 @@ def bench_mc():
 
 
 def bench_nn():
-    """BASELINE row: NN train step time (batch 32 × seq 60, LSTM-64)."""
+    """BASELINE row: NN train step time (batch 32 × seq 60, LSTM-64).
+
+    Two measurements of the SAME zoo model (2-layer LSTM-64 + Dense head,
+    `models/zoo.py build_model("lstm")`):
+
+      per_step_ms      one jitted train step per dispatch — the loop shape
+                       the repo shipped before the compiled epoch;
+      value (headline) compiled-epoch amortized ms/step — a whole epoch as
+                       one donated `lax.scan` program over 32 on-device
+                       batches (`models/train_loop.py`), wall time divided
+                       by batch count.  This is the loop train_model/HPO/
+                       patterns actually run, so vs_baseline compares it.
+    """
     import jax
     import jax.numpy as jnp
     import optax
 
     from ai_crypto_trader_tpu.models import build_model
+    from ai_crypto_trader_tpu.models.train_loop import EpochTrainer
 
     model = build_model("lstm", units=64)
     B, T, F = 32, 60, 8
@@ -399,23 +414,77 @@ def bench_nn():
     for _ in range(iters):
         params, opt_state, loss = step(params, opt_state, x, y)
     fetch(loss)
-    ms = (time.perf_counter() - t0) / iters * 1e3
-    log(f"NN: LSTM-64 train step (batch 32 × seq 60): {ms:.3f} ms")
+    step_ms = (time.perf_counter() - t0) / iters * 1e3
+    log(f"NN: LSTM-64 train step (batch 32 × seq 60, per-dispatch): "
+        f"{step_ms:.3f} ms")
+
+    # Compiled-epoch amortized time at the same batch shape: 32 batches of
+    # 32 per epoch, params/opt_state donated, loss read once per epoch.
+    n_batches = 32
+    X = jnp.ones((n_batches * B, T, F), jnp.float32)
+    Y = jnp.zeros((n_batches * B, 1), jnp.float32)
+
+    def train_loss(p, xb, yb, rng):
+        return jnp.mean((model.apply(p, xb, False)["mean"] - yb) ** 2)
+
+    trainer = EpochTrainer(train_loss, tx)
+    params = model.init(jax.random.PRNGKey(0), x, False)
+    opt_state = tx.init(params)
+    params, opt_state, m = trainer.epoch(
+        params, opt_state, X, Y, jax.random.PRNGKey(1),
+        jax.random.PRNGKey(2), batch_size=B)                  # compile
+    fetch(m)
+
+    def measure_epochs(epochs=3):
+        nonlocal params, opt_state
+        t0 = time.perf_counter()
+        for i in range(epochs):
+            params, opt_state, m = trainer.epoch(
+                params, opt_state, X, Y, jax.random.PRNGKey(i),
+                jax.random.PRNGKey(i + 1), batch_size=B)
+            fetch(m)                 # the loop's one sync per epoch
+        return (time.perf_counter() - t0) / epochs / n_batches * 1e3
+
     # Reference-side number (VERDICT r3 weak#5): the reference trains its
     # Keras LSTM on CPU (no GPU anywhere in its deploy story,
-    # docker-compose.yml); the reproducible proxy is a torch-CPU LSTM-64
-    # train step at the identical (batch 32 × seq 60 × 8 → 1) shape.
+    # docker-compose.yml); the reproducible proxy is a torch-CPU step of
+    # the ARCHITECTURE-IDENTICAL model — the zoo "lstm" is a 2-layer
+    # stacked LSTM-64 with a Dense(32)→Dense(1) head, so the torch net
+    # mirrors exactly that (the old proxy's single LSTM layer + Linear
+    # under-counted the reference work by ~2×).  Both sides are measured
+    # THREE times, interleaved, and compared at the median — on a shared
+    # host a single sample of either side swings ±30%.
+    reps_jax, reps_ref = [], []
+    ref_fail = None
+    for _ in range(3):
+        reps_jax.append(measure_epochs())        # always 3 jax samples —
+        if ref_fail is not None:                 # a torch-less host must not
+            continue                             # degrade the headline to one
+        try:
+            reps_ref.append(_torch_cpu_lstm_step_ms(B, T, F, iters=10))
+        except Exception as e:                   # noqa: BLE001
+            ref_fail = e
+    ms = float(np.median(reps_jax))
+    log(f"NN: compiled-epoch amortized ({n_batches} batches/epoch, "
+        f"donated): {ms:.3f} ms/step (median of {[round(v, 2) for v in reps_jax]})")
     vs = None
-    try:
-        ref_ms = _torch_cpu_lstm_step_ms(B, T, F)
-        log(f"NN baseline (torch-CPU LSTM-64, same shape): {ref_ms:.3f} ms")
-        vs = round(ref_ms / ms, 1)
-    except Exception as e:                       # noqa: BLE001
-        log(f"nn baseline unavailable ({type(e).__name__}: {e})")
-    emit("nn_train_step_ms", ms, "ms", vs)
+    if reps_ref:                                 # median of whatever landed
+        ref_ms = float(np.median(reps_ref))
+        log(f"NN baseline (torch-CPU 2-layer LSTM-64 + head, same shape): "
+            f"{ref_ms:.3f} ms (median of {[round(v, 2) for v in reps_ref]})")
+        vs = round(ref_ms / ms, 2)
+    else:
+        log(f"nn baseline unavailable ({type(ref_fail).__name__}: {ref_fail})")
+    emit("nn_train_step_ms", ms, "ms", vs, engine="compiled-epoch",
+         per_step_ms=round(step_ms, 3),
+         torch_ref_ms=None if vs is None else round(ref_ms, 3))
 
 
 def _torch_cpu_lstm_step_ms(B, T, F, iters=30):
+    """Torch-CPU proxy of the zoo "lstm" model: num_layers=2 LSTM-64 +
+    Dense(32)/ReLU/Dense(1) head, Adam — the identical architecture the
+    jax side times (build_model("lstm", units=64) → RecurrentEncoder
+    num_layers=2 + SingleHead)."""
     import torch
 
     torch.manual_seed(0)
@@ -423,12 +492,13 @@ def _torch_cpu_lstm_step_ms(B, T, F, iters=30):
     class Net(torch.nn.Module):
         def __init__(self):
             super().__init__()
-            self.lstm = torch.nn.LSTM(F, 64, batch_first=True)
-            self.head = torch.nn.Linear(64, 1)
+            self.lstm = torch.nn.LSTM(F, 64, num_layers=2, batch_first=True)
+            self.h1 = torch.nn.Linear(64, 32)
+            self.h2 = torch.nn.Linear(32, 1)
 
         def forward(self, x):
             out, _ = self.lstm(x)
-            return self.head(out[:, -1])
+            return self.h2(torch.relu(self.h1(out[:, -1])))
 
     net = Net()
     opt = torch.optim.Adam(net.parameters(), lr=1e-3)

@@ -45,29 +45,39 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 # warm run on a 1-CPU box. Opt out with TEST_XLA_CACHE=0; recovery from a
 # kill-mid-write is `rm -rf .jax_cache_test`.
 _TEST_CACHE_DIR = None
+_CACHE_LOCK_FH = None                    # held open for the process lifetime
 
 
 def _acquire_cache_lock(cache_dir: str) -> bool:
     """One WRITER per cache dir: a second same-tier run that starts while
     the first is alive must not share the directory (torn entries segfault
-    jax on read-back). The lock is a pidfile; a dead owner's lock is
-    reclaimed, so a kill-mid-run doesn't disable caching forever."""
+    jax on read-back).
+
+    The lock is an OS advisory lock (``flock`` LOCK_EX|LOCK_NB) held on a
+    long-lived fd, not a pidfile: the kernel releases it the instant the
+    owner dies, so there is no "stale lock" state at all and therefore no
+    reclaim step to race on.  (The previous pidfile scheme — and even its
+    remove-then-`open('x')` repair — had a TOCTOU window where a second
+    racer's remove could delete the winner's freshly created lock and make
+    both processes writers; ADVICE r5.)  The pid is written into the file
+    purely as a debugging breadcrumb."""
+    global _CACHE_LOCK_FH
+    import fcntl
+
     lock = os.path.join(cache_dir, ".writer.pid")
     os.makedirs(cache_dir, exist_ok=True)
+    fh = open(lock, "a+")
     try:
-        with open(lock, "x") as f:
-            f.write(str(os.getpid()))
-        return True
-    except FileExistsError:
-        try:
-            with open(lock) as f:
-                owner = int(f.read().strip() or 0)
-            os.kill(owner, 0)            # raises if the owner is gone
-            return False                 # live concurrent run — back off
-        except (OSError, ValueError):
-            with open(lock, "w") as f:   # stale lock: reclaim
-                f.write(str(os.getpid()))
-            return True
+        fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        fh.close()
+        return False                     # live concurrent run — back off
+    fh.seek(0)
+    fh.truncate()
+    fh.write(str(os.getpid()))
+    fh.flush()
+    _CACHE_LOCK_FH = fh                  # keep the fd (and the lock) alive
+    return True
 
 
 def pytest_configure(config):
@@ -88,11 +98,13 @@ def pytest_configure(config):
 
 
 def pytest_unconfigure(config):
-    if _TEST_CACHE_DIR:
-        try:
-            os.remove(os.path.join(_TEST_CACHE_DIR, ".writer.pid"))
-        except OSError:
-            pass
+    global _CACHE_LOCK_FH
+    if _CACHE_LOCK_FH is not None:
+        # closing the fd releases the flock; the pidfile itself stays as a
+        # breadcrumb — removing it could hand a NEW inode to a late-starting
+        # run while an even later one still sees the old, splitting the lock
+        _CACHE_LOCK_FH.close()
+        _CACHE_LOCK_FH = None
 
 
 @pytest.fixture(autouse=True)

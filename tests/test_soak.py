@@ -59,7 +59,9 @@ def test_full_stack_soak(tmp_path):
             "social": {"cache_ttl_s": 120.0},
             "news": {"poll_interval_s": 300.0},
             "patterns": {"update_interval_s": 300.0,
-                         "report_interval_s": 600.0},
+                         "report_interval_s": 600.0,
+                         "checkpoint": str(tmp_path / "pattern_cnn"),
+                         "train_kwargs": {"epochs": 1, "n_per_class": 4}},
             "regime": {"interval_s": 600.0, "retrain_interval_s": 1e9},
             "nn": {"epochs": 1, "units": 8, "hpo_trials": 0,
                    "retrain_interval_s": 1e9, "intervals": ("1m",),

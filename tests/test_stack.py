@@ -95,9 +95,14 @@ def test_build_full_stack_registers_roster():
 
     ex = FakeExchange({"BTCUSDC": _series()})
     system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: 0.0)
-    services = build_full_stack(system, grid_symbol="BTCUSDC",
-                                dca_symbol="BTCUSDC")
+    services = build_full_stack(
+        system, grid_symbol="BTCUSDC", dca_symbol="BTCUSDC",
+        # fast tier: skip the startup pattern training — the untrained
+        # fallback path is itself under test (signals must carry the tag)
+        cadences={"patterns": {"checkpoint": None, "train_on_start": False}})
     names = [s.name for s in services]
     assert names == ["social", "news", "patterns", "regime", "nn",
                      "evolver", "generator", "grid", "dca"]
     assert system.extra_services == services
+    patterns = services[names.index("patterns")]
+    assert patterns.recognizer.trained is False

@@ -1,0 +1,381 @@
+"""Compiled-epoch trainer (models/train_loop.py), fused LSTM custom-VJP
+(models/fused_lstm.py), and the PR's satellite fixes: donation actually
+enabled, exactly one host sync per epoch, loss-trajectory parity with the
+legacy per-batch loop, the RL multi-iteration scan, pattern-recognizer
+sourcing in the full stack, news poll/dedup, and the XLA-cache lock
+reclaim race."""
+
+import asyncio
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _features(n=160, f=4, seed=0):
+    rng = np.random.default_rng(seed)
+    t = np.arange(n)
+    base = 100 + 10 * np.sin(t / 20) + rng.normal(0, 0.5, n)
+    cols = [base] + [rng.normal(0, 1, n) for _ in range(f - 1)]
+    return np.stack(cols, axis=1).astype(np.float32)
+
+
+class TestFusedLSTM:
+    """The fused layer must compute the SAME function (and gradients) as
+    the textbook split/sigmoid LSTM cell it replaced."""
+
+    @staticmethod
+    def _reference_scan(zx, wh):
+        T, B, H4 = zx.shape
+        H = H4 // 4
+
+        def step(carry, z):
+            c, h = carry
+            g = z + h @ wh
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (c, h), h
+
+        init = (jnp.zeros((B, H)), jnp.zeros((B, H)))
+        return jax.lax.scan(step, init, zx)[1]
+
+    def test_forward_and_gradient_parity(self):
+        from ai_crypto_trader_tpu.models.fused_lstm import lstm_scan
+
+        rng = np.random.default_rng(0)
+        zx = jnp.asarray(rng.normal(size=(7, 3, 32)).astype(np.float32))
+        wh = jnp.asarray(rng.normal(size=(8, 32)).astype(np.float32) * 0.3)
+
+        f_fused = lambda zx, wh: jnp.sum(jnp.sin(lstm_scan(zx, wh)))
+        f_ref = lambda zx, wh: jnp.sum(jnp.sin(self._reference_scan(zx, wh)))
+        np.testing.assert_allclose(np.asarray(f_fused(zx, wh)),
+                                   np.asarray(f_ref(zx, wh)), rtol=1e-5)
+        g_fused = jax.grad(f_fused, argnums=(0, 1))(zx, wh)
+        g_ref = jax.grad(f_ref, argnums=(0, 1))(zx, wh)
+        for a, b in zip(g_fused, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+
+
+class TestCompiledEpoch:
+    def test_loss_trajectory_parity_with_legacy_loop(self):
+        """Same key → same per-epoch train/val losses, LR schedule, and
+        early-stop point as the per-batch dispatch loop it replaced."""
+        from ai_crypto_trader_tpu.models import train_model
+
+        f = _features(160)
+        kw = dict(seq_len=8, units=8, epochs=5, batch_size=32,
+                  reduce_lr_patience=1, early_stopping_patience=5)
+        r_new = train_model(KEY, f, "lstm", **kw)
+        r_old = train_model(KEY, f, "lstm", compiled_epoch=False, **kw)
+
+        assert r_new.epochs_run == r_old.epochs_run
+        for h_new, h_old in zip(r_new.history, r_old.history):
+            np.testing.assert_allclose(h_new["loss"], h_old["loss"],
+                                       rtol=1e-4, atol=1e-6)
+            np.testing.assert_allclose(h_new["val_loss"], h_old["val_loss"],
+                                       rtol=1e-4, atol=1e-6)
+            assert h_new["lr"] == h_old["lr"]
+        np.testing.assert_allclose(r_new.best_val_loss, r_old.best_val_loss,
+                                   rtol=1e-4)
+
+    def test_exactly_one_host_sync_per_epoch(self, monkeypatch):
+        """The loop's only device→host readback is train_loop.host_read —
+        one call per epoch, metrics vector [train_loss, val_loss]."""
+        from ai_crypto_trader_tpu.models import train_model
+        from ai_crypto_trader_tpu.models import train_loop
+
+        calls = []
+        real = train_loop.host_read
+        monkeypatch.setattr(train_loop, "host_read",
+                            lambda x: calls.append(1) or real(x))
+        r = train_model(KEY, _features(120), "lstm", seq_len=8, units=8,
+                        epochs=3, batch_size=32, early_stopping_patience=10)
+        assert len(calls) == r.epochs_run == 3
+
+    def test_donation_enabled_no_unused_buffer_warnings(self):
+        """donate_argnums must actually alias params/opt_state: the donated
+        input buffers are invalidated, and XLA emits no 'donated buffer
+        was not usable' warning on the steady-state call."""
+        from ai_crypto_trader_tpu.models.train_loop import EpochTrainer
+
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(4, 1)),
+                        jnp.float32)
+        params = {"w": w}
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(params)
+        X = jnp.asarray(np.random.default_rng(1).normal(size=(64, 4)),
+                        jnp.float32)
+        y = X @ w + 0.1
+
+        trainer = EpochTrainer(
+            lambda p, xb, yb, rng: jnp.mean((xb @ p["w"] - yb) ** 2), tx)
+        params, opt_state, _ = trainer.epoch(       # compile call
+            params, opt_state, X, y, KEY, KEY, batch_size=16)
+        donated_leaf = params["w"]
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            params, opt_state, m = trainer.epoch(
+                params, opt_state, X, y, KEY, KEY, batch_size=16)
+            float(m[0])
+        assert donated_leaf.is_deleted()            # buffer really donated
+        assert not [w_ for w_ in caught
+                    if "donated" in str(w_.message).lower()]
+        assert not params["w"].is_deleted()
+
+    def test_bf16_precision_smoke(self):
+        from ai_crypto_trader_tpu.models import train_model
+
+        r = train_model(KEY, _features(120), "lstm", seq_len=8, units=8,
+                        epochs=2, batch_size=32, precision="bf16")
+        assert np.isfinite([h["loss"] for h in r.history]).all()
+        assert np.isfinite(r.best_val_loss)
+
+    def test_unknown_precision_rejected(self):
+        from ai_crypto_trader_tpu.models.train_loop import canonical_precision
+
+        with pytest.raises(ValueError):
+            canonical_precision("f16")
+        assert canonical_precision("bf16") == "bfloat16"
+        # "f32" must force FULL float32 (on TPU the backend default is the
+        # MXU's bf16-ish DEFAULT — None would silently keep it)
+        assert canonical_precision("f32") == "float32"
+        assert canonical_precision(None) is None
+
+
+class TestPatternTrainingCompiled:
+    def test_loss_decreases_and_trained_flag(self):
+        from ai_crypto_trader_tpu.patterns.model import train_pattern_model
+
+        rec = train_pattern_model(KEY, "cnn", n_per_class=8, epochs=3,
+                                  T=24, batch_size=32)
+        losses = [h["loss"] for h in rec.history]
+        assert len(losses) == 3 and np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        assert rec.trained is True
+
+
+@pytest.mark.slow
+class TestRLMultiIterationScan:
+    def test_matches_per_iteration_loop(self):
+        from ai_crypto_trader_tpu.rl import (
+            DQNConfig, dqn_init, make_env_params, train_iteration,
+            train_iterations)
+
+        rng = np.random.default_rng(0)
+        ind = {k: jnp.asarray(rng.normal(50, 10, 256).astype(np.float32))
+               for k in ("close", "rsi", "macd", "bb_position", "stoch_k",
+                         "atr", "volume", "williams_r", "signal", "ema_12",
+                         "ema_26", "sma_20")}
+        p = make_env_params(ind, episode_len=32)
+        cfg = DQNConfig(num_envs=4, replay_capacity=256, batch_size=8,
+                        rollout_len=2, learn_steps_per_iter=1)
+
+        st_loop = dqn_init(KEY, p, cfg)
+        for _ in range(3):
+            st_loop, m_loop = train_iteration(p, st_loop, cfg)
+
+        st_scan = dqn_init(KEY, p, cfg)
+        st_scan, m_scan = train_iterations(p, st_scan, cfg, n_iters=3)
+
+        np.testing.assert_allclose(
+            np.asarray(st_loop.params["params"]["Dense_0"]["kernel"]),
+            np.asarray(st_scan.params["params"]["Dense_0"]["kernel"]),
+            rtol=1e-5, atol=1e-6)
+        assert m_scan["loss"].shape == (3,)
+        np.testing.assert_allclose(float(m_loop["loss"]),
+                                   float(m_scan["loss"][-1]), rtol=1e-5)
+
+    def test_train_dqn_history_selection_unchanged(self):
+        from ai_crypto_trader_tpu.rl import (
+            DQNConfig, make_env_params, train_dqn)
+
+        rng = np.random.default_rng(0)
+        ind = {k: jnp.asarray(rng.normal(50, 10, 256).astype(np.float32))
+               for k in ("close", "rsi", "macd", "bb_position", "stoch_k",
+                         "atr", "volume", "williams_r", "signal", "ema_12",
+                         "ema_26", "sma_20")}
+        p = make_env_params(ind, episode_len=32)
+        cfg = DQNConfig(num_envs=4, replay_capacity=256, batch_size=8,
+                        rollout_len=2, learn_steps_per_iter=1)
+        _, hist = train_dqn(KEY, p, cfg, iterations=5, log_every=2)
+        assert [h["iter"] for h in hist] == [0, 2, 4]
+        assert all(np.isfinite(h["loss"]) for h in hist)
+
+
+class TestStackPatternSources:
+    def test_checkpoint_roundtrip_and_untrained_fallback(self, tmp_path):
+        from ai_crypto_trader_tpu.patterns.model import train_pattern_model
+        from ai_crypto_trader_tpu.shell.stack import _pattern_recognizer
+        from ai_crypto_trader_tpu.utils.checkpoint import save_checkpoint
+
+        ckpt = str(tmp_path / "pattern_cnn")
+        rec = train_pattern_model(KEY, "cnn", n_per_class=4, epochs=1, T=24)
+        save_checkpoint(ckpt, rec.params, metadata={"model_type": "cnn"})
+
+        loaded = _pattern_recognizer(24, {"checkpoint": ckpt})
+        assert loaded.trained is True
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(loaded.params)[0]),
+            np.asarray(jax.tree.leaves(rec.params)[0]))
+
+        fallback = _pattern_recognizer(
+            24, {"checkpoint": None, "train_on_start": False})
+        assert fallback.trained is False
+        assert fallback.params is not None
+
+        # an incompatible checkpoint (different seq_len → different flatten
+        # width) must fall through, not crash detect-time
+        mismatched = _pattern_recognizer(
+            48, {"checkpoint": ckpt, "train_on_start": False})
+        assert mismatched.trained is False
+
+    def test_startup_training_persists_checkpoint(self, tmp_path):
+        from ai_crypto_trader_tpu.shell.stack import _pattern_recognizer
+
+        ckpt = str(tmp_path / "pattern_cnn")
+        rec = _pattern_recognizer(
+            24, {"checkpoint": ckpt,
+                 "train_kwargs": {"epochs": 1, "n_per_class": 4}})
+        assert rec.trained is True and rec.history
+        assert os.path.isdir(ckpt)          # persisted for the next start
+        again = _pattern_recognizer(24, {"checkpoint": ckpt})
+        assert again.trained is True and not again.history  # loaded, not re-trained
+
+    def test_untrained_recognizer_tags_published_signals(self):
+        from ai_crypto_trader_tpu.patterns.service import ChartPatternService
+        from ai_crypto_trader_tpu.shell.bus import EventBus
+        from ai_crypto_trader_tpu.shell.stack import _pattern_recognizer
+
+        rec = _pattern_recognizer(
+            24, {"checkpoint": None, "train_on_start": False})
+        bus = EventBus()
+        rng = np.random.default_rng(0)
+        base = 100 + np.cumsum(rng.normal(0, 0.3, 80))
+        klines = [[i * 60_000.0, c, c + 0.5, c - 0.5, c + 0.1, 10.0]
+                  for i, c in enumerate(base)]
+        bus.set("historical_data_BTCUSDC_1m", klines)
+        svc = ChartPatternService(bus, rec, ["BTCUSDC"], seq_len=24,
+                                  confidence_threshold=0.0,
+                                  min_publish_strength=0.0,
+                                  now_fn=lambda: 1000.0)
+        asyncio.run(svc.run_once())
+        analysis = bus.get("pattern_analysis_BTCUSDC")
+        assert analysis["model_status"] == "untrained"
+        signals = bus.get("pattern_signals_BTCUSDC")
+        if signals is not None:             # published only when non-neutral
+            assert signals["model_status"] == "untrained"
+
+
+class TestNewsSatellites:
+    def _service(self, provider, now):
+        from ai_crypto_trader_tpu.shell.bus import EventBus
+        from ai_crypto_trader_tpu.social.news import NewsService
+
+        bus = EventBus()
+        return NewsService(bus, ["BTCUSDC"], provider=provider,
+                           poll_interval_s=600.0,
+                           now_fn=lambda: now["t"]), bus
+
+    def test_empty_fetch_respects_poll_interval(self):
+        calls = []
+        now = {"t": 0.0}
+        svc, _ = self._service(
+            lambda bus, symbol: calls.append(symbol) or [], now)
+        asyncio.run(svc.run_once())
+        assert len(calls) == 1
+        now["t"] = 100.0                    # inside the 600 s interval
+        asyncio.run(svc.run_once())
+        assert len(calls) == 1              # empty fetch burned the slot
+        now["t"] = 700.0
+        asyncio.run(svc.run_once())
+        assert len(calls) == 2
+
+    def test_recent_feed_dedups_repeated_headline(self):
+        article = {"title": "BTC steady", "body": "BTC (BTC) moved 0.0%.",
+                   "published_at": 42.0, "source": "wire"}
+        now = {"t": 0.0}
+        svc, bus = self._service(lambda bus, symbol: [dict(article)], now)
+        asyncio.run(svc.run_once())
+        now["t"] = 700.0                    # provider re-serves the headline
+        asyncio.run(svc.run_once())
+        recent = bus.get("news_recent_BTCUSDC")
+        assert len(recent) == 1
+        assert recent[0]["title"] == "BTC steady"
+        # a genuinely new headline still appends
+        article["title"] = "BTC breaks out"
+        now["t"] = 1400.0
+        asyncio.run(svc.run_once())
+        assert [e["title"] for e in bus.get("news_recent_BTCUSDC")] == \
+            ["BTC steady", "BTC breaks out"]
+
+    def test_recent_feed_dedups_reserved_batches(self):
+        """A provider that re-serves a BATCH of headlines must not grow the
+        feed — tail-only comparison would re-append every entry but one."""
+        batch = [{"title": t, "body": f"{t}.", "published_at": i,
+                  "source": "wire"} for i, t in enumerate(["A", "B", "C"])]
+        now = {"t": 0.0}
+        svc, bus = self._service(
+            lambda bus, symbol: [dict(a) for a in batch], now)
+        asyncio.run(svc.run_once())
+        now["t"] = 700.0
+        asyncio.run(svc.run_once())
+        assert [e["title"] for e in bus.get("news_recent_BTCUSDC")] == \
+            ["A", "B", "C"]
+
+    def test_dedup_without_published_at_keys_on_title(self):
+        """published_at is optional; the stored field defaults to poll time,
+        so re-served timestamp-less headlines must dedup on title alone."""
+        article = {"title": "BTC steady", "body": "BTC (BTC) moved.",
+                   "source": "wire"}          # no published_at
+        now = {"t": 0.0}
+        svc, bus = self._service(lambda bus, symbol: [dict(article)], now)
+        asyncio.run(svc.run_once())
+        now["t"] = 700.0
+        asyncio.run(svc.run_once())
+        assert len(bus.get("news_recent_BTCUSDC")) == 1
+
+
+class TestCacheLockReclaim:
+    """flock-based writer lock: dead owners release automatically (the
+    kernel drops the lock with the fd), live owners exclude atomically —
+    no stale-pidfile reclaim step left to race on."""
+
+    def test_stale_pidfile_is_not_a_lock(self, tmp_path):
+        import conftest
+
+        session_fh = conftest._CACHE_LOCK_FH    # don't disturb the session's lock
+        cache_dir = str(tmp_path / "cache")
+        lock = os.path.join(cache_dir, ".writer.pid")
+        os.makedirs(cache_dir)
+        with open(lock, "w") as f:
+            f.write("999999999")            # dead owner's breadcrumb, no flock
+        try:
+            assert conftest._acquire_cache_lock(cache_dir) is True
+            with open(lock) as f:
+                assert int(f.read()) == os.getpid()
+        finally:
+            conftest._CACHE_LOCK_FH.close()
+            conftest._CACHE_LOCK_FH = session_fh
+
+    def test_held_lock_excludes_second_acquirer(self, tmp_path):
+        import fcntl
+
+        import conftest
+
+        cache_dir = str(tmp_path / "cache")
+        lock = os.path.join(cache_dir, ".writer.pid")
+        os.makedirs(cache_dir)
+        holder = open(lock, "a+")           # a concurrent run's open fd
+        fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        try:
+            assert conftest._acquire_cache_lock(cache_dir) is False
+        finally:
+            holder.close()
