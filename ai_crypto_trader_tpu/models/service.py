@@ -29,7 +29,12 @@ from typing import Sequence
 import jax
 import numpy as np
 
-from ai_crypto_trader_tpu.models.train import TrainResult, predict_prices, train_model
+from ai_crypto_trader_tpu.models.train import (
+    TrainResult,
+    predict_prices,
+    predict_prices_batched,
+    train_model,
+)
 from ai_crypto_trader_tpu.shell.bus import EventBus
 from ai_crypto_trader_tpu.utils import tracing
 from ai_crypto_trader_tpu.utils.checkpoint import save_checkpoint
@@ -74,6 +79,12 @@ class PredictionService:
     # stall the trading event loop; bus reads/writes stay on the loop either
     # way. Default False keeps tests single-threaded and deterministic.
     offload: bool = False
+
+    # All due (symbol × interval) pairs sharing a model architecture predict
+    # as ONE stacked vmapped program (train.predict_prices_batched) instead
+    # of a Python loop of per-pair dispatches — the serving-side twin of the
+    # monitor's fused tick engine. False restores per-pair dispatches.
+    batched_predict: bool = True
 
     models: dict = field(default_factory=dict)       # (sym, iv) -> TrainResult
     train_count: int = 0
@@ -215,7 +226,9 @@ class PredictionService:
                     self._last_training[(symbol, interval)] = now
                     out["trained"] += 1
 
-        # staleness-gated predictions (:1366-1401)
+        # staleness-gated predictions (:1366-1401); pairs sharing a model
+        # architecture run as one stacked predict dispatch (_predict_jobs)
+        jobs = []
         for symbol in self.symbols:
             for interval in self.intervals:
                 if not self._needs_prediction(symbol, interval, now):
@@ -226,25 +239,54 @@ class PredictionService:
                 feats = self._features(symbol, interval)
                 if feats is None:
                     continue
-                # denormalization column comes from the TrainResult (the
-                # close column the service trains on)
-                pred = self._traced_jax(
-                    "model.predict",
-                    {"symbol": symbol, "interval": interval,
-                     "model_type": result.model_type},
-                    lambda result=result, feats=feats: predict_prices(
-                        result, feats, seq_len=self.seq_len))
-                payload = {
-                    "symbol": symbol, "interval": interval,
-                    "predicted_price": float(np.ravel(pred["predicted_price"])[0]),
-                    "confidence": pred["confidence"],
-                    "reference_time": now,
-                }
-                out["kv"].append((f"nn_prediction_{symbol}_{interval}", payload))
-                out["events"].append({"type": "prediction", **payload})
-                self.predict_count += 1
-                out["predicted"] += 1
+                jobs.append((symbol, interval, result, feats))
+        for (symbol, interval, result, feats), pred in zip(
+                jobs, self._predict_jobs(jobs)):
+            payload = {
+                "symbol": symbol, "interval": interval,
+                "predicted_price": float(np.ravel(pred["predicted_price"])[0]),
+                "confidence": pred["confidence"],
+                "reference_time": now,
+            }
+            out["kv"].append((f"nn_prediction_{symbol}_{interval}", payload))
+            out["events"].append({"type": "prediction", **payload})
+            self.predict_count += 1
+            out["predicted"] += 1
         return out
+
+    def _predict_jobs(self, jobs: list) -> list:
+        """Predictions for the due (symbol, interval, result, feats) jobs,
+        in job order.  Architecture groups of ≥2 run as ONE stacked
+        program; singletons keep the per-model cached jit.  The
+        denormalization column comes from each TrainResult (the close
+        column the service trains on)."""
+        preds: list = [None] * len(jobs)
+        groups: dict = {}
+        for i, (_, _, result, _) in enumerate(jobs):
+            key = (result.model_type,
+                   tuple(sorted(result.model_kwargs.items())))
+            groups.setdefault(key, []).append(i)
+        for key, idxs in groups.items():
+            if len(idxs) == 1 or not self.batched_predict:
+                for i in idxs:
+                    symbol, interval, result, feats = jobs[i]
+                    preds[i] = self._traced_jax(
+                        "model.predict",
+                        {"symbol": symbol, "interval": interval,
+                         "model_type": result.model_type},
+                        lambda result=result, feats=feats: predict_prices(
+                            result, feats, seq_len=self.seq_len))
+            else:
+                rs = [jobs[i][2] for i in idxs]
+                fs = [jobs[i][3] for i in idxs]
+                outs = self._traced_jax(
+                    "model.predict_batch",
+                    {"model_type": key[0], "lanes": len(idxs)},
+                    lambda rs=rs, fs=fs: predict_prices_batched(
+                        rs, fs, seq_len=self.seq_len))
+                for i, o in zip(idxs, outs):
+                    preds[i] = o
+        return preds
 
     async def run_once(self) -> dict:
         now = self.now_fn()

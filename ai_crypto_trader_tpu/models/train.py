@@ -300,3 +300,60 @@ def predict_prices(result: TrainResult, features: np.ndarray,
         rng = np.asarray(result.scaler.max[target_col] - result.scaler.min[target_col])
         res["predicted_std"] = sigma_scaled * rng
     return res
+
+
+# One batched-predict program per ARCHITECTURE (model_type + kwargs), shared
+# by every model instance of that architecture; jit retraces per lane count,
+# so steady-state prediction cadences hit the cache every cycle.
+_BATCHED_PREDICT_FNS: dict = {}
+
+
+def _batched_predict_fn(model_type: str, kwargs_key: tuple):
+    fn = _BATCHED_PREDICT_FNS.get((model_type, kwargs_key))
+    if fn is None:
+        model = build_model(model_type, **dict(kwargs_key))
+
+        def one(params, smin, smax, window):
+            rng = smax - smin
+            scaled = (window - smin) / jnp.where(rng == 0.0, 1.0, rng)
+            return model.apply(params, scaled[None], False)
+
+        fn = jax.jit(jax.vmap(one))
+        _BATCHED_PREDICT_FNS[(model_type, kwargs_key)] = fn
+    return fn
+
+
+def predict_prices_batched(results: Sequence[TrainResult], features_list,
+                           seq_len: int = 60) -> list[dict]:
+    """predict_prices for N models sharing ONE architecture, as ONE stacked
+    dispatch: params/scalers stack into a leading lane axis, the per-lane
+    MinMax transform runs in-program, and the host reads all lanes back in
+    a single device_get.  Per-lane scaling/denormalization is the exact
+    math of `predict_prices`, so the outputs are interchangeable — the
+    parity tests pin them equal.  All ``results`` must share
+    (model_type, model_kwargs); the caller groups by architecture."""
+    r0 = results[0]
+    kwargs_key = tuple(sorted(r0.model_kwargs.items()))
+    windows = jnp.asarray(np.stack(
+        [np.asarray(f, np.float32)[-seq_len:] for f in features_list]))
+    params = jax.tree.map(lambda *xs: jnp.stack(xs),
+                          *[r.params for r in results])
+    smin = jnp.stack([r.scaler.min for r in results])
+    smax = jnp.stack([r.scaler.max for r in results])
+    out = _batched_predict_fn(r0.model_type, kwargs_key)(
+        params, smin, smax, windows)
+    out, mins, maxs = jax.device_get((out, smin, smax))   # one pull, all lanes
+    preds = []
+    for lane, r in enumerate(results):
+        tc = r.target_col
+        rng_t = maxs[lane, tc] - mins[lane, tc]
+        rng_t = rng_t if rng_t != 0.0 else np.float32(1.0)
+        mean_scaled = out["mean"][lane, 0]
+        res = {"predicted_price": np.asarray(mean_scaled * rng_t
+                                             + mins[lane, tc]),
+               "confidence": float(1.0 / (1.0 + r.best_val_loss * 100.0))}
+        if "log_sigma" in out:
+            res["predicted_std"] = np.exp(
+                np.asarray(out["log_sigma"][lane, 0])) * rng_t
+        preds.append(res)
+    return preds

@@ -1,0 +1,272 @@
+"""Fused live-tick engine: one device program per poll for the whole universe.
+
+PR 2 compiled the training loop; this compiles the SERVING path.  The
+per-symbol monitor ran one jitted indicator program per (symbol × frame) —
+O(S·F) dispatches per poll — then ~40 scalar device→host pulls per symbol
+and re-uploaded the full kline window on every tick.  Podracer
+(arXiv:2104.06272) and JAX-LOB (arXiv:2308.13289) both land on the same
+shape for hot loops: keep state resident on device, batch the step across
+the population, cross the host boundary once per step.  Three pieces:
+
+  * a **device-resident ring buffer** `[S, F, T, 5]` holding the candle
+    windows of the whole universe, donated through every step so XLA
+    updates it in place.  Per tick the host uploads only the new/changed
+    candle rows (a fixed-capacity scatter list; position ``T`` = dropped
+    write), never whole windows: window ORDER lives in a per-(s, f) ring
+    base pointer, so a window that advanced by k candles costs k row
+    writes instead of a T-row roll;
+  * **one jitted program** (`_tick_program`): scatter the row updates,
+    gather time-ordered windows, then indicators → signal features →
+    reference signal → volume profile → the 15 combination families →
+    confluence for every (symbol, frame) lane at once.  The kernels in
+    ops.indicators / ops.combinations / backtest.signals are written
+    against the trailing time axis, so the whole table batches with no
+    explicit vmap; volume_profile vmaps internally.  Warm-up is a traced
+    ``valid`` mask — cold frames NaN their outputs in-program instead of
+    changing the program shape, so a symbol crossing warming→full (or a
+    venue hiccup shrinking a window) triggers ZERO recompiles;
+  * a single `host_read` (jax.device_get) of the last-candle feature
+    pytree — the only device→host sync per poll, kept as a module seam so
+    tests can count it (the models/train_loop.host_read pattern).
+
+Symbol count is padded up to a power-of-two bucket (min 8) and frame
+count up to 4 so monitors with nearby universe sizes share one compiled
+program; dead lanes are masked invalid and cost only device FLOPs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.backtest import compute_signal_features, reference_signal
+from ai_crypto_trader_tpu.ops.combinations import (
+    combination_signal,
+    combined_indicators,
+)
+from ai_crypto_trader_tpu.ops.volume_profile import volume_profile
+
+
+def host_read(tree):
+    """THE per-poll device→host sync: output pytree → numpy pytree.
+
+    Module-level seam (like models/train_loop.host_read) so tests can wrap
+    it with a counting double and assert one sync per poll."""
+    return jax.device_get(tree)
+
+
+def _pad_symbols(n: int) -> int:
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
+def _pad_frames(n: int) -> int:
+    return max(n, 4)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _tick_program(ring, base, rows, s_ix, f_ix, pos, valid):
+    """Scatter row updates into the donated ring, then compute the whole
+    last-candle feature table for every (symbol, frame) lane.
+
+    ring  [S, F, T, 5]  donated candle ring buffer (OHLCV rows)
+    base  [S, F]        ring base pointer: window index i lives at ring
+                        position (base + i) % T
+    rows  [W, 5]        new/changed candle rows (W = fixed capacity)
+    s_ix, f_ix, pos [W] scatter coordinates; pos == T marks an unused
+                        slot (dropped by mode="drop")
+    valid [S, F]        warm frames; cold lanes get NaN outputs in-program
+                        (int outputs 0) so warm-up never changes the shape
+    """
+    S, F, T, _ = ring.shape
+    ring = ring.at[s_ix, f_ix, pos].set(rows, mode="drop")
+    idx = (base[:, :, None] + jnp.arange(T, dtype=jnp.int32)) % T
+    win = jnp.take_along_axis(ring, idx[..., None], axis=2)
+    names = ("open", "high", "low", "close", "volume")
+    ohlcv = {k: win[..., i] for i, k in enumerate(names)}
+
+    ind = ops.compute_indicators(ohlcv)
+    feats = compute_signal_features(ind)
+    signal, strength = reference_signal(feats)
+    vp = volume_profile(ohlcv["high"], ohlcv["low"], ohlcv["close"],
+                        ohlcv["volume"])
+    combos = combined_indicators(ind)
+    confluence = combination_signal(combos)
+    close = ohlcv["close"]
+
+    def chg(n):
+        # same guard as the host-side chg(): windows shorter than n → 0.0
+        if T <= n:
+            return jnp.zeros(close.shape[:-1], close.dtype)
+        prev = close[..., -1 - n]
+        return (close[..., -1] - prev) / prev * 100.0
+
+    fm = lambda x: jnp.where(valid, x, jnp.nan)             # noqa: E731
+    im = lambda x: jnp.where(valid, x, 0).astype(jnp.int32)  # noqa: E731
+    out = {
+        "current_price": fm(close[..., -1]),
+        "rsi": fm(ind["rsi"][..., -1]),
+        "stoch_k": fm(ind["stoch_k"][..., -1]),
+        "macd": fm(ind["macd"][..., -1]),
+        "williams_r": fm(ind["williams_r"][..., -1]),
+        "bb_position": fm(ind["bb_position"][..., -1]),
+        "atr": fm(ind["atr"][..., -1]),
+        "volatility": fm(feats.volatility[..., -1]),
+        "trend": im(feats.trend[..., -1]),
+        "trend_strength": fm(feats.trend_strength[..., -1]),
+        "avg_volume": fm(feats.volume[..., -1]),
+        "signal": im(signal[..., -1]),
+        "signal_strength": fm(strength[..., -1]),
+        "chg_1": fm(chg(1)), "chg_3": fm(chg(3)),
+        "chg_5": fm(chg(5)), "chg_15": fm(chg(15)),
+        "poc_price": fm(vp["poc_price"]),
+        "value_area_low": fm(vp["value_area_low"]),
+        "value_area_high": fm(vp["value_area_high"]),
+        "confluence": fm(confluence[..., -1]),
+        "combo": {k: fm(v[..., -1]) for k, v in combos.items()},
+    }
+    return ring, out
+
+
+class TickEngine:
+    """Host-side driver of the fused program: kline diffing, the ring
+    mirrors, and the one-dispatch/one-sync step.
+
+    ``ingest(symbol, interval, klines)`` queues the delta between the new
+    window and the device ring (typically 1-2 rows: the freshly closed
+    candle plus the updated in-progress bar).  A slot whose delta exceeds
+    ``max_new`` rows (cold start, reconnect gap, venue correction storm)
+    is re-seeded: the whole buffer re-uploads once via device_put — a
+    transfer, not a compile.  ``step()`` then runs ONE jitted dispatch for
+    every (symbol, frame) lane and performs ONE host_read.
+    """
+
+    def __init__(self, symbols, intervals, window: int = 256,
+                 max_new: int = 8):
+        self.symbols = list(symbols)
+        self.intervals = tuple(intervals)
+        self.window = int(window)
+        self.max_new = int(max_new)
+        self.sym_index = {s: i for i, s in enumerate(self.symbols)}
+        self.iv_index = {iv: i for i, iv in enumerate(self.intervals)}
+        S = _pad_symbols(len(self.symbols))
+        F = _pad_frames(len(self.intervals))
+        T = self.window
+        # time-ordered window mirror + timestamps (diffing) and the
+        # ring-layout mirror (reseed source; always current)
+        self._win = np.zeros((S, F, T, 5), np.float32)
+        self._ts = np.zeros((S, F, T), np.int64)
+        self._ring_np = np.zeros((S, F, T, 5), np.float32)
+        self._base = np.zeros((S, F), np.int32)
+        self._count = np.zeros((S, F), np.int32)
+        self._ring = None                      # device buffer, donated
+        # queued writes this poll, keyed (s, f, pos) so a second ingest of
+        # the same slot between steps overwrites rather than duplicates —
+        # duplicate scatter indices pick an implementation-defined winner
+        # in XLA, which could desync the device ring from the host mirror
+        self._pending: dict = {}               # (s, f, pos) -> row
+        self._need_seed = True
+        self.dispatch_count = 0
+        self.full_seeds = 0
+        self.last_valid = np.zeros((S, F), bool)
+        self.last_stats: dict = {}
+
+    # -- ingest ---------------------------------------------------------------
+    def _seed_slot(self, s: int, f: int, ts: np.ndarray, arr: np.ndarray):
+        self._win[s, f] = arr
+        self._ts[s, f] = ts
+        self._base[s, f] = 0
+        self._ring_np[s, f] = arr
+        self._count[s, f] = self.window
+        self._need_seed = True
+        self.full_seeds += 1
+        # queued incremental writes for this slot are superseded
+        self._pending = {k: v for k, v in self._pending.items()
+                         if not (k[0] == s and k[1] == f)}
+
+    def ingest(self, symbol: str, interval: str, klines: list) -> None:
+        """Diff one (symbol, frame) kline window against the device ring and
+        queue only the new/changed rows for the next step()."""
+        s = self.sym_index[symbol]
+        f = self.iv_index[interval]
+        T = self.window
+        rows = klines[-T:]
+        if len(rows) < T:
+            self._count[s, f] = len(rows)      # warming: lane stays invalid
+            return
+        arr = np.asarray([r[1:6] for r in rows], np.float32)
+        ts = np.asarray([int(r[0]) for r in rows], np.int64)
+        if self._count[s, f] < T:
+            self._seed_slot(s, f, ts, arr)     # warming → full transition
+            return
+        old_ts = self._ts[s, f]
+        j = int(np.searchsorted(old_ts, ts[0]))
+        if j >= T or old_ts[j] != ts[0] \
+                or not np.array_equal(old_ts[j:], ts[:T - j]):
+            self._seed_slot(s, f, ts, arr)     # gap/misalignment: re-seed
+            return
+        k = j                                  # window advanced by k candles
+        changed = np.flatnonzero(
+            (arr[:T - k] != self._win[s, f, k:]).any(axis=1))
+        writes = list(changed) + list(range(T - k, T))
+        if len(writes) > self.max_new:
+            self._seed_slot(s, f, ts, arr)
+            return
+        base = (int(self._base[s, f]) + k) % T
+        self._base[s, f] = base
+        for i in writes:
+            pos = (base + i) % T
+            self._ring_np[s, f, pos] = arr[i]
+            self._pending[(s, f, pos)] = arr[i]   # latest write wins
+        self._win[s, f] = arr
+        self._ts[s, f] = ts
+
+    # -- step -----------------------------------------------------------------
+    def step(self) -> dict:
+        """ONE fused dispatch over every (symbol, frame) lane + ONE host
+        readback.  Returns the numpy output pytree ([S, F] per feature);
+        per-step transfer/dispatch accounting lands in ``last_stats``."""
+        S, F, T = self._ring_np.shape[:3]
+        W = S * F * self.max_new               # scatter capacity
+        if len(self._pending) > W:             # paranoia: spilled capacity
+            self._need_seed = True
+        rows = np.zeros((W, 5), np.float32)
+        s_ix = np.zeros((W,), np.int32)
+        f_ix = np.zeros((W,), np.int32)
+        pos = np.full((W,), T, np.int32)       # T = dropped write
+        upload_bytes = 0
+        seeded = self._ring is None or self._need_seed
+        if seeded:
+            self._ring = jnp.asarray(self._ring_np)   # transfer, no compile
+            upload_bytes += self._ring_np.nbytes
+            n_writes = 0
+            self._pending.clear()              # already inside the seed
+        else:
+            n_writes = len(self._pending)
+            for w, ((ps, pf, p), row) in enumerate(self._pending.items()):
+                s_ix[w] = ps
+                f_ix[w] = pf
+                pos[w] = p
+                rows[w] = row
+            self._pending.clear()
+            upload_bytes += (rows.nbytes + s_ix.nbytes + f_ix.nbytes
+                             + pos.nbytes)
+        valid = self._count >= T
+        self._ring, out = _tick_program(self._ring, self._base, rows, s_ix,
+                                        f_ix, pos, valid)
+        self.dispatch_count += 1
+        self._need_seed = False
+        self.last_valid = valid
+        host = host_read(out)
+        self.last_stats = {
+            "dispatches": 1, "upload_rows": int(n_writes),
+            "upload_bytes": int(upload_bytes), "full_seed": bool(seeded),
+            "lanes": int(S * F), "valid_lanes": int(valid.sum()),
+        }
+        return host

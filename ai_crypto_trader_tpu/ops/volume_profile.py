@@ -6,6 +6,12 @@ volume histogram, point of control (POC), value area (the minimal
 POC-centered band holding 70 % of volume), and high/low-volume nodes — all
 as one jit over the candle arrays (the typical price of each candle books
 its volume into a fixed price grid via a segment-sum).
+
+Like the ops.indicators kernels, the public entry accepts leading batch
+dims (`[..., T]`) — the profile is computed per trailing-axis series
+(vmapped internally, since the histogram/value-area math reduces over the
+whole series), which is what lets the fused tick engine profile every
+(symbol × frame) lane in one program.
 """
 
 from __future__ import annotations
@@ -16,9 +22,8 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("n_bins",))
-def volume_profile(high, low, close, volume, n_bins: int = 50,
-                   value_area_frac: float = 0.70) -> dict:
+def _volume_profile_1d(high, low, close, volume, n_bins: int,
+                       value_area_frac: float) -> dict:
     tp = (high + low + close) / 3.0
     lo = jnp.min(tp)
     hi = jnp.max(tp)
@@ -60,3 +65,18 @@ def volume_profile(high, low, close, volume, n_bins: int = 50,
         "lvn_mask": hist < 0.5 * mean_vol,     # low-volume nodes
         "total_volume": total,
     }
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins",))
+def volume_profile(high, low, close, volume, n_bins: int = 50,
+                   value_area_frac: float = 0.70) -> dict:
+    high, low, close, volume = (jnp.asarray(x)
+                                for x in (high, low, close, volume))
+    if high.ndim == 1:
+        return _volume_profile_1d(high, low, close, volume, n_bins,
+                                  value_area_frac)
+    batch = high.shape[:-1]
+    flat = [x.reshape((-1, x.shape[-1])) for x in (high, low, close, volume)]
+    out = jax.vmap(lambda h, l, c, v: _volume_profile_1d(
+        h, l, c, v, n_bins, value_area_frac))(*flat)
+    return {k: v.reshape(batch + v.shape[1:]) for k, v in out.items()}

@@ -78,7 +78,10 @@ class DashboardServer:
                         # when tracing is disabled): the JSON twin of the
                         # span JSONL export, grouped by trace_id
                         try:
-                            limit = int(q.get("limit", [20])[0])
+                            # floor at 0: a negative value would invert the
+                            # ring slice and return everything BUT the
+                            # newest traces
+                            limit = max(int(q.get("limit", [20])[0]), 0)
                         except ValueError:
                             limit = 20
                         self._send(json.dumps(outer.traces(limit),

@@ -1,4 +1,4 @@
-"""Market monitor service: klines → jitted indicator table → market_updates.
+"""Market monitor service: klines → fused tick engine → market_updates.
 
 Capability parity with MarketMonitorService
 (`services/market_monitor_service.py`): per-symbol throttle (:374-401),
@@ -8,8 +8,16 @@ exchange access (:96-115).  The WebSocket firehose becomes an explicit
 `poll()` driven by the host loop (or a ws callback in live deployments) —
 same data flow, testable with a virtual clock.
 
-The indicator math runs as ONE jit call over the whole kline window per
-symbol — the reference recomputes a pandas pipeline per update.
+The indicator math runs through the FUSED TICK ENGINE
+(ops/tick_engine.py): the whole universe's poll — indicators, signal
+features, volume profile, the 15 combination families, confluence, for
+every (symbol × frame) — is ONE jitted dispatch against a device-resident
+candle ring buffer (only new/changed rows upload per tick) and ONE host
+readback, regardless of universe size.  The reference recomputes a pandas
+pipeline per update; the previous revision here ran one jit per
+(symbol × frame) plus ~40 scalar device pulls per symbol.  The per-symbol
+path (`_features_from_klines`) is kept for off-universe symbols,
+`fused=False`, and the golden parity tests that pin the two paths equal.
 """
 
 from __future__ import annotations
@@ -23,13 +31,23 @@ import numpy as np
 
 from ai_crypto_trader_tpu import ops
 from ai_crypto_trader_tpu.backtest import compute_signal_features, reference_signal
+from ai_crypto_trader_tpu.ops.combinations import (
+    combination_signal,
+    combined_indicators,
+)
+from ai_crypto_trader_tpu.ops.tick_engine import TickEngine
+from ai_crypto_trader_tpu.ops.volume_profile import volume_profile
 from ai_crypto_trader_tpu.shell.bus import EventBus
 from ai_crypto_trader_tpu.shell.exchange import (
     ExchangeInterface,
     ResilientExchange,
 )
+from ai_crypto_trader_tpu.strategy.generator import StrategyStructure
 from ai_crypto_trader_tpu.utils import tracing
 from ai_crypto_trader_tpu.utils.circuit_breaker import CircuitBreaker
+
+TREND_LABELS = {1: "uptrend", 0: "sideways", -1: "downtrend"}
+SIGNAL_LABELS = {1: "BUY", 0: "NEUTRAL", -1: "SELL"}
 
 
 @dataclass
@@ -47,6 +65,12 @@ class MarketMonitor:
     breaker: CircuitBreaker | None = field(
         default_factory=lambda: CircuitBreaker("exchange", failure_threshold=3,
                                                reset_timeout_s=30.0))
+    # Fused path: one tick-engine dispatch + one host sync per poll for the
+    # whole configured universe.  False = the pre-engine per-symbol loop
+    # (kept as the parity oracle and for ad-hoc off-universe polls).
+    fused: bool = True
+    max_new: int = 8                    # ring rows per (s, f) before re-seed
+    _engine: TickEngine | None = field(default=None, repr=False)
     _last_pub: dict = field(default_factory=dict)
     _warming: set = field(default_factory=set)
 
@@ -81,6 +105,7 @@ class MarketMonitor:
         if isinstance(self.exchange, ResilientExchange):
             self.breaker = None
 
+    # -- the per-symbol path (parity oracle / off-universe fallback) ---------
     def _features_from_klines(self, klines: list,
                               with_combo_scores: bool = False) -> dict | None:
         # Fixed-shape discipline: the indicator program is compiled for
@@ -97,10 +122,6 @@ class MarketMonitor:
         feats = compute_signal_features(ind)
         signal, strength = reference_signal(feats)
         # volume profile (reference cadence: market_monitor_service.py:303-372)
-        from ai_crypto_trader_tpu.ops.volume_profile import volume_profile
-        from ai_crypto_trader_tpu.ops.combinations import (
-            combination_signal, combined_indicators,
-        )
         vp = volume_profile(arrays["high"], arrays["low"], arrays["close"],
                             arrays["volume"])
         combos = combined_indicators(ind)
@@ -119,11 +140,10 @@ class MarketMonitor:
             "bb_position": float(np.asarray(ind["bb_position"])[i]),
             "atr": float(np.asarray(ind["atr"])[i]),
             "volatility": float(np.asarray(feats.volatility)[i]),
-            "trend": {1: "uptrend", 0: "sideways", -1: "downtrend"}[
-                int(np.asarray(feats.trend)[i])],
+            "trend": TREND_LABELS[int(np.asarray(feats.trend)[i])],
             "trend_strength": float(np.asarray(feats.trend_strength)[i]),
             "avg_volume": float(np.asarray(feats.volume)[i]),
-            "signal": {1: "BUY", 0: "NEUTRAL", -1: "SELL"}[int(np.asarray(signal)[i])],
+            "signal": SIGNAL_LABELS[int(np.asarray(signal)[i])],
             "signal_strength": float(np.asarray(strength)[i]),
             "price_change_1m": chg(1), "price_change_3m": chg(3),
             "price_change_5m": chg(5), "price_change_15m": chg(15),
@@ -141,6 +161,58 @@ class MarketMonitor:
                if with_combo_scores else {}),
         }
 
+    # -- the fused path ------------------------------------------------------
+    def _get_engine(self) -> TickEngine:
+        """Lazy engine keyed to the current universe config; rebuilt when
+        symbols/intervals/window change (each is a compiled-shape input)."""
+        eng = self._engine
+        if (eng is None or eng.symbols != list(self.symbols)
+                or eng.intervals != tuple(self.intervals)
+                or eng.window != self.kline_limit
+                or eng.max_new != self.max_new):
+            self._engine = eng = TickEngine(
+                self.symbols, self.intervals, window=self.kline_limit,
+                max_new=self.max_new)
+        return eng
+
+    def _extract_features(self, out: dict, s: int,
+                          with_combo_scores: bool = False) -> dict | None:
+        """Host-side slice of the engine's output pytree for one symbol's
+        PRIMARY frame — the same payload `_features_from_klines` builds,
+        with zero additional device syncs (`out` is already numpy)."""
+        eng = self._engine
+        f = 0                                   # primary frame lane
+        if not eng.last_valid[s, f]:
+            return None                         # warming (window < limit)
+        def g(key):
+            return float(out[key][s, f])
+        return {
+            "current_price": g("current_price"),
+            "rsi": g("rsi"),
+            "stoch_k": g("stoch_k"),
+            "macd": g("macd"),
+            "williams_r": g("williams_r"),
+            "bb_position": g("bb_position"),
+            "atr": g("atr"),
+            "volatility": g("volatility"),
+            "trend": TREND_LABELS[int(out["trend"][s, f])],
+            "trend_strength": g("trend_strength"),
+            "avg_volume": g("avg_volume"),
+            "signal": SIGNAL_LABELS[int(out["signal"][s, f])],
+            "signal_strength": g("signal_strength"),
+            "price_change_1m": g("chg_1"), "price_change_3m": g("chg_3"),
+            "price_change_5m": g("chg_5"), "price_change_15m": g("chg_15"),
+            "volume_profile": {
+                "poc_price": g("poc_price"),
+                "value_area_low": g("value_area_low"),
+                "value_area_high": g("value_area_high"),
+            },
+            "confluence": g("confluence"),
+            **({"_combo_last": {n: float(c[s, f])
+                                for n, c in out["combo"].items()}}
+               if with_combo_scores else {}),
+        }
+
     def _structure_view(self, combo_last: dict) -> dict:
         """Live evaluation of the ADOPTED strategy structure (the
         generator's hot-swap surface, strategy/generator.py
@@ -151,8 +223,6 @@ class MarketMonitor:
         payload = self.bus.get("strategy_structure")
         if not payload:
             return {}
-        from ai_crypto_trader_tpu.strategy.generator import StrategyStructure
-
         s = StrategyStructure.from_payload(payload)
         if s is None:
             return {}
@@ -180,22 +250,156 @@ class MarketMonitor:
         shell/stream.py marks symbols dirty and refreshes just those);
         None = the full configured universe (the polling path).
 
-        Multi-timeframe: features are computed per interval and the trend
-        strength published is the reference's 0.6·primary + 0.4·secondary
-        blend (`market_monitor_service.py:219-301`)."""
+        Fused mode batches every due in-universe symbol through ONE tick-
+        engine dispatch; symbols outside the configured universe (possible
+        with ``restrict_to_universe=False`` streams) ride the per-symbol
+        path.  Multi-timeframe semantics are identical either way: trend
+        strength is the reference's 0.6·primary + 0.4·5m blend, secondary
+        frames contribute rsi_/macd_/signal_ columns
+        (`market_monitor_service.py:219-301`)."""
         published = 0
         now = self.now_fn()
+        due, seen = [], set()
         for symbol in (symbols if symbols is not None else self.symbols):
-            if not force and now - self._last_pub.get(symbol, -1e18) < self.throttle_s:
+            if symbol in seen:
                 continue
+            seen.add(symbol)
+            if force or now - self._last_pub.get(symbol, -1e18) >= self.throttle_s:
+                due.append(symbol)
+        if not due:
+            return 0
+        rest = due
+        if self.fused:
+            eng = self._get_engine()
+            batch = [s for s in due if s in eng.sym_index]
+            rest = [s for s in due if s not in eng.sym_index]
+            if batch:
+                published += await self._poll_fused(batch, now)
+        for symbol in rest:
             with tracing.span("monitor.poll", service="monitor",
                               attributes={"symbol": symbol}):
                 published += await self._poll_symbol(symbol, now)
         return published
 
+    async def _poll_fused(self, due: list, now: float) -> int:
+        """Fetch → ingest deltas → ONE dispatch + ONE readback → publish.
+
+        Fetching stays per (symbol × frame) — a real venue serves native
+        frames — but ALL device work for the batch is a single program and
+        the only device→host sync is the engine's host_read."""
+        eng = self._get_engine()
+        iv0 = self.intervals[0]
+        fetched: dict = {}
+        # Same failure semantics as the per-symbol loop: a raising fetch
+        # (ResilientExchange's ExchangeUnavailable after exhausted retries)
+        # stops fetching FURTHER symbols, but the symbols already fetched
+        # still compute and publish this poll, and the exception re-raises
+        # after the batch so the launcher's skip-and-alert path still fires.
+        fetch_error: Exception | None = None
+        for symbol in due:
+            # unlike the per-symbol path's primary-only fetch span, this one
+            # covers ALL the symbol's frames + ring ingest (hence "frames",
+            # not "interval" — see docs/OBSERVABILITY.md)
+            with tracing.span("monitor.fetch", service="monitor",
+                              attributes={"symbol": symbol,
+                                          "frames": len(self.intervals)}):
+                try:
+                    kl = self._fetch(symbol, iv0)
+                    if kl is None:
+                        fetched[(symbol, iv0)] = None
+                        continue
+                    kl = kl[-self.kline_limit:]
+                    fetched[(symbol, iv0)] = kl
+                    self._note_warmup(symbol, iv0, len(kl))
+                    if kl:
+                        eng.ingest(symbol, iv0, kl)
+                    if len(kl) < self.kline_limit:
+                        continue        # warming: no publish, like the
+                        #                 per-symbol path — skip secondaries
+                    for iv in self.intervals[1:]:
+                        res = self._fetch(symbol, iv)
+                        if res:
+                            res = res[-self.kline_limit:]
+                            eng.ingest(symbol, iv, res)
+                        fetched[(symbol, iv)] = res
+                except Exception as e:   # noqa: BLE001 — re-raised below
+                    fetch_error = e
+                    fetched[(symbol, iv0)] = None   # this symbol: no publish
+                    break
+        ready = [s for s in due
+                 if len(fetched.get((s, iv0)) or []) >= self.kline_limit]
+        if not ready:
+            # outage (every fetch None) or universe-wide cold start: nothing
+            # can publish, so skip the dispatch + readback entirely — the
+            # per-symbol path did zero device work here too.  Queued ingest
+            # deltas stay pending and ride the next poll's step.
+            if fetch_error is not None:
+                raise fetch_error
+            return 0
+        with tracing.span("monitor.tick_engine", service="monitor") as sp:
+            out = eng.step()
+            sp.set_attribute("symbols", len(due))
+            for k, v in eng.last_stats.items():
+                sp.set_attribute(k, v)
+        blend_iv = self._blend_iv()
+        published = 0
+        for symbol in due:
+            kl = fetched.get((symbol, iv0))
+            if not kl:
+                continue
+            with tracing.span("monitor.poll", service="monitor",
+                              attributes={"symbol": symbol}):
+                s = eng.sym_index[symbol]
+                update = self._extract_features(out, s,
+                                                with_combo_scores=True)
+                if update is None:
+                    continue
+                combo_last = update.pop("_combo_last", None)
+                if combo_last:
+                    update.update(self._structure_view(combo_last))
+                self.bus.set(f"historical_data_{symbol}_{iv0}", kl)
+                # The 0.6/0.4 trend blend pairs the primary frame with 5m
+                # specifically (`market_monitor_service.py:273`); other
+                # frames contribute their per-interval columns (:285-298).
+                for iv in self.intervals[1:]:
+                    res = fetched.get((symbol, iv))
+                    if not res:
+                        continue
+                    self.bus.set(f"historical_data_{symbol}_{iv}", res)
+                    self._note_warmup(symbol, iv, len(res))
+                    if len(res) < self.kline_limit:
+                        continue               # frame still warming
+                    f = eng.iv_index[iv]
+                    if iv == blend_iv:
+                        update["trend_strength"] = (
+                            0.6 * update["trend_strength"]
+                            + 0.4 * float(out["trend_strength"][s, f]))
+                    update[f"signal_{iv}"] = SIGNAL_LABELS[
+                        int(out["signal"][s, f])]
+                    update[f"rsi_{iv}"] = float(out["rsi"][s, f])
+                    update[f"macd_{iv}"] = float(out["macd"][s, f])
+                update["symbol"] = symbol
+                update["timestamp"] = now
+                self.bus.set(f"market_data_{symbol}", update)
+                await self.bus.publish("market_updates", update)
+                self._last_pub[symbol] = now
+                published += 1
+        if fetch_error is not None:
+            raise fetch_error
+        return published
+
+    def _blend_iv(self) -> str | None:
+        """The secondary frame the 0.6/0.4 trend blend pairs with: 5m when
+        configured (`market_monitor_service.py:273`), else the first
+        secondary frame — shared by both poll paths so the rule cannot
+        drift between them."""
+        return "5m" if "5m" in self.intervals[1:] else (
+            self.intervals[1] if len(self.intervals) > 1 else None)
+
     async def _poll_symbol(self, symbol: str, now: float) -> int:
-        """Fetch → features → publish for one symbol (one span each when
-        tracing is on; the market_updates publish inherits the context)."""
+        """Fetch → features → publish for one symbol — the per-symbol path
+        (one jit per frame + scalar pulls); the fused engine replaces this
+        for in-universe polls, and the parity tests pin the two equal."""
         with tracing.span("monitor.fetch", service="monitor",
                           attributes={"symbol": symbol,
                                       "interval": self.intervals[0]}):
@@ -218,8 +422,7 @@ class MarketMonitor:
         # specifically (`market_monitor_service.py:273` strength_1m*0.6
         # + strength_5m*0.4); other frames contribute their per-interval
         # columns (rsi_3m, macd_5m, …, :285-298) without re-blending.
-        blend_iv = "5m" if "5m" in self.intervals[1:] else (
-            self.intervals[1] if len(self.intervals) > 1 else None)
+        blend_iv = self._blend_iv()
         for iv in self.intervals[1:]:
             res = self._fetch(symbol, iv)
             if not res:

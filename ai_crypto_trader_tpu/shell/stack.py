@@ -181,7 +181,11 @@ def build_full_stack(system, *, registry=None, llm=None,
     Returns the list of services added (also appended to
     ``system.extra_services``).  ``cadences`` overrides per-service kwargs
     by service name — the soak test shrinks training epochs and intervals
-    through it; production uses the defaults."""
+    through it; production uses the defaults.  A ``"monitor"`` entry is
+    applied as attribute overrides on the system's already-constructed
+    MarketMonitor (``fused``/``max_new``/``throttle_s``/``kline_limit``…) —
+    the knobs of the fused tick engine ride the same config seam as every
+    other service."""
     from ai_crypto_trader_tpu.patterns.service import ChartPatternService
     from ai_crypto_trader_tpu.regime.service import MarketRegimeService
     from ai_crypto_trader_tpu.social.news import NewsService
@@ -193,6 +197,15 @@ def build_full_stack(system, *, registry=None, llm=None,
 
     def kw(name, **defaults):
         return {**defaults, **cadences.get(name, {})}
+
+    import dataclasses
+
+    monitor_fields = {f.name for f in dataclasses.fields(system.monitor)
+                      if not f.name.startswith("_")}
+    for k, v in cadences.get("monitor", {}).items():
+        if k not in monitor_fields:    # fields only — never methods/privates
+            raise TypeError(f"unknown monitor override {k!r}")
+        setattr(system.monitor, k, v)
 
     bus, symbols, now_fn = system.bus, system.symbols, system.now_fn
     services = [

@@ -14,9 +14,13 @@ the transport seam, exactly like data/fetchers.py's injectable transport.
 dirty, and drains them in batches through `MarketMonitor.poll(symbols=…)`
 (klines + indicators + publication ride the existing, tested path; the
 stream only decides WHICH symbols refresh and WHEN — the same division of
-labor as the reference's handler).  Tests inject recorded miniTicker
-frames; zero egress.  `BinanceStreamSource` is the real-network source,
-gated on an installed websocket client library.
+labor as the reference's handler).  With the fused monitor, one drained
+batch is ONE tick-engine dispatch: each dirty symbol's refresh lands as a
+handful of changed candle rows in the device ring buffer
+(ops/tick_engine.py), so the per-drain device cost is flat in batch size —
+the frame span carries the engine's upload/dispatch stats.  Tests inject
+recorded miniTicker frames; zero egress.  `BinanceStreamSource` is the
+real-network source, gated on an installed websocket client library.
 """
 
 from __future__ import annotations
@@ -114,6 +118,14 @@ class MarketStream:
                 n = await self.drain()
                 sp.set_attribute("marked", len(marked))
                 sp.set_attribute("published", n)
+                # fused-monitor drains: how many candle rows this batch
+                # actually moved host→device (the ring-buffer delta)
+                eng = getattr(self.monitor, "_engine", None)
+                if n and eng is not None and eng.last_stats:
+                    sp.set_attribute("engine_upload_rows",
+                                     eng.last_stats.get("upload_rows"))
+                    sp.set_attribute("engine_upload_bytes",
+                                     eng.last_stats.get("upload_bytes"))
                 published += n
         while self._pending:
             with tracing.span("stream.drain", service="stream"):

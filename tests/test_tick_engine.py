@@ -1,0 +1,383 @@
+"""Fused tick engine (ops/tick_engine.py): parity with the per-symbol
+monitor path, the one-dispatch/one-sync contract, ring-buffer delta
+uploads, recompile-freedom, and the batched prediction path.
+
+The parity class is the tentpole's safety net: the fused engine must
+publish byte-for-byte the same market_updates payload the per-symbol
+`_features_from_klines` path produced (all fields, warm-up and
+full-window cases).  The contract class is the tier-1 regression guard:
+a change that reintroduces per-symbol dispatches or extra host syncs on
+the poll path fails here, not in a quarterly bench run.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.data.ingest import OHLCV
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.ops import tick_engine
+from ai_crypto_trader_tpu.ops.tick_engine import TickEngine
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
+
+LIMIT = 128          # same compiled shape bucket as tests/test_stream.py
+
+
+def _series(n=900, seed=7, symbol="BTCUSDC"):
+    d = generate_ohlcv(n=n, seed=seed)
+    return OHLCV(timestamp=np.arange(n, dtype=np.int64) * 60_000,
+                 open=d["open"], high=d["high"], low=d["low"],
+                 close=d["close"], volume=d["volume"] * 1000, symbol=symbol)
+
+
+def _exchange(symbols=("BTCUSDC", "ETHUSDC"), n=900, advance=700):
+    ex = FakeExchange({s: _series(n=n, seed=7 + i, symbol=s)
+                       for i, s in enumerate(symbols)})
+    ex.advance(steps=advance)
+    return ex
+
+
+def _monitors(ex, symbols, clock, structure=False):
+    pair = []
+    for fused in (True, False):
+        bus = EventBus()
+        if structure:
+            bus.set("strategy_structure", {
+                "rules": {"oscillator_consensus": 1.0,
+                          "trend_confirmation": 1.0},
+                "buy_threshold": 0.05, "sell_threshold": 0.05,
+                "version": "v9"})
+        pair.append(MarketMonitor(bus, ex, symbols=list(symbols),
+                                  now_fn=lambda: clock["t"],
+                                  kline_limit=LIMIT, fused=fused))
+    return pair
+
+
+def _assert_payload_equal(fused: dict, legacy: dict, where: str):
+    assert set(fused) == set(legacy), \
+        (where, set(fused) ^ set(legacy))
+    for k, b in legacy.items():
+        a = fused[k]
+        if isinstance(b, float):
+            assert a == pytest.approx(b, rel=1e-4, abs=1e-6), (where, k, a, b)
+        elif isinstance(b, dict):
+            for kk, bv in b.items():
+                assert a[kk] == pytest.approx(bv, rel=1e-4, abs=1e-6), \
+                    (where, k, kk, a[kk], bv)
+        else:
+            assert a == b, (where, k, a, b)
+
+
+class TestParity:
+    def test_fused_matches_per_symbol_path_all_fields(self):
+        """Full-window 1m/3m/5m + WARMING 15m (47/128 candles): every
+        published field — scalars, labels, per-interval columns, volume
+        profile, confluence, structure view — identical on both paths,
+        and warming frames contribute no columns on either."""
+        async def go():
+            symbols = ("BTCUSDC", "ETHUSDC")
+            ex = _exchange(symbols)
+            clock = {"t": 0.0}
+            mf, ml = _monitors(ex, symbols, clock, structure=True)
+            assert await mf.poll(force=True) == 2
+            assert await ml.poll(force=True) == 2
+            for s in symbols:
+                uf = mf.bus.get(f"market_data_{s}")
+                ul = ml.bus.get(f"market_data_{s}")
+                # warming 15m frame: no columns, both paths
+                assert "rsi_15m" not in ul and "rsi_15m" not in uf
+                assert "rsi_3m" in uf and "signal_5m" in uf
+                assert "structure_version" in uf
+                _assert_payload_equal(uf, ul, s)
+                # historical data stored for every non-warming frame
+                for iv in ("1m", "3m", "5m"):
+                    assert (mf.bus.get(f"historical_data_{s}_{iv}")
+                            == ml.bus.get(f"historical_data_{s}_{iv}"))
+            # warmup bookkeeping matches
+            for s in symbols:
+                assert (mf.bus.get(f"monitor_warmup_{s}")
+                        == ml.bus.get(f"monitor_warmup_{s}"))
+
+        asyncio.run(go())
+
+    def test_parity_holds_across_incremental_ticks(self):
+        """After the seed poll, subsequent polls ride the ring-buffer
+        delta path — values must still match a from-scratch compute."""
+        async def go():
+            symbols = ("BTCUSDC",)
+            ex = _exchange(symbols)
+            clock = {"t": 0.0}
+            mf, ml = _monitors(ex, symbols, clock)
+            await mf.poll(force=True)
+            await ml.poll(force=True)
+            for _ in range(4):
+                ex.advance(steps=1)
+                clock["t"] += 60.0
+                assert await mf.poll() == 1
+                assert await ml.poll() == 1
+                _assert_payload_equal(mf.bus.get("market_data_BTCUSDC"),
+                                      ml.bus.get("market_data_BTCUSDC"),
+                                      f"t={clock['t']}")
+                assert not mf._engine.last_stats["full_seed"]
+
+        asyncio.run(go())
+
+    def test_primary_warming_publishes_nothing(self):
+        async def go():
+            ex = _exchange(("BTCUSDC",), n=900, advance=50)  # < LIMIT candles
+            clock = {"t": 0.0}
+            mf, ml = _monitors(ex, ("BTCUSDC",), clock)
+            assert await mf.poll(force=True) == 0
+            assert await ml.poll(force=True) == 0
+            assert mf.bus.get("market_data_BTCUSDC") is None
+            # neither path stores primary history for an unpublished symbol
+            assert mf.bus.get("historical_data_BTCUSDC_1m") is None
+            assert (mf.bus.get("monitor_warmup_BTCUSDC")
+                    == ml.bus.get("monitor_warmup_BTCUSDC"))
+
+        asyncio.run(go())
+
+    def test_fetch_failure_still_publishes_earlier_symbols(self):
+        """Per-symbol-loop failure parity: a raising fetch (the resilient
+        adapter's ExchangeUnavailable) must not blank the whole batch —
+        symbols fetched before the failure still publish, and the
+        exception re-raises for the launcher's skip-and-alert path."""
+        async def go():
+            symbols = ("BTCUSDC", "ETHUSDC")
+            ex = _exchange(symbols)
+            clock = {"t": 0.0}
+            bus = EventBus()
+            mon = MarketMonitor(bus, ex, symbols=list(symbols),
+                                now_fn=lambda: clock["t"],
+                                kline_limit=LIMIT, fused=True)
+            boom = RuntimeError("venue down")
+            real = ex.get_klines
+
+            def flaky(symbol, interval="1m", limit=100):
+                if symbol == "ETHUSDC":
+                    raise boom
+                return real(symbol, interval, limit)
+
+            ex.get_klines = flaky
+            mon.breaker = None          # surface the raise (resilient seam)
+            with pytest.raises(RuntimeError, match="venue down"):
+                await mon.poll(force=True)
+            assert bus.get("market_data_BTCUSDC") is not None
+            assert bus.get("market_data_ETHUSDC") is None
+
+        asyncio.run(go())
+
+    def test_off_universe_symbol_rides_per_symbol_path(self):
+        async def go():
+            ex = _exchange(("BTCUSDC", "DOGEUSDC"))
+            clock = {"t": 0.0}
+            bus = EventBus()
+            mon = MarketMonitor(bus, ex, symbols=["BTCUSDC"],
+                                now_fn=lambda: clock["t"],
+                                kline_limit=LIMIT, fused=True)
+            # a stream with restrict_to_universe=False can request symbols
+            # the engine has no lane for — they fall back, still publish
+            assert await mon.poll(force=True,
+                                  symbols=["BTCUSDC", "DOGEUSDC"]) == 2
+            assert bus.get("market_data_DOGEUSDC") is not None
+
+        asyncio.run(go())
+
+
+class TestPollContract:
+    """The acceptance contract: one jitted dispatch + one host readback per
+    poll at S symbols × F frames, no recompiles at steady state, delta-only
+    uploads.  Tier-1 so a regression fails fast, and time-budgeted."""
+
+    def test_one_dispatch_one_sync_no_recompile(self, monkeypatch):
+        from ai_crypto_trader_tpu.utils.tracing import JitCompileMonitor
+
+        async def go():
+            symbols = ("BTCUSDC", "ETHUSDC")
+            ex = _exchange(symbols)
+            clock = {"t": 0.0}
+            bus = EventBus()
+            mon = MarketMonitor(bus, ex, symbols=list(symbols),
+                                now_fn=lambda: clock["t"],
+                                kline_limit=LIMIT, fused=True)
+            syncs = {"n": 0}
+            real_read = tick_engine.host_read
+
+            def counting_read(tree):
+                syncs["n"] += 1
+                return real_read(tree)
+
+            monkeypatch.setattr(tick_engine, "host_read", counting_read)
+            assert await mon.poll(force=True) == 2     # seed + compile
+            assert syncs["n"] == 1
+            eng = mon._engine
+            assert eng.dispatch_count == 1
+            assert eng.last_stats["full_seed"]
+
+            jit_mon = JitCompileMonitor.install()
+            before = jit_mon.sample()
+            ex.advance(steps=1)
+            clock["t"] += 60.0
+            import time as _time
+            t0 = _time.perf_counter()
+            assert await mon.poll() == 2               # steady state
+            steady_s = _time.perf_counter() - t0
+            since = jit_mon.since(before)
+            assert since["compiles"] == 0, since       # zero new compiles
+            assert syncs["n"] == 2                     # ONE more host sync
+            assert eng.dispatch_count == 2             # ONE more dispatch
+            stats = eng.last_stats
+            assert stats["dispatches"] == 1
+            assert not stats["full_seed"]
+            # delta upload: the fixed scatter list (rows + 3 index arrays),
+            # independent of the window length T — never whole windows
+            assert 0 < stats["upload_rows"] <= eng.max_new * stats["lanes"]
+            cap = stats["lanes"] * eng.max_new * (5 * 4 + 3 * 4)
+            assert stats["upload_bytes"] <= cap < eng._ring_np.nbytes
+            # budget: a steady poll that recompiles takes tens of seconds;
+            # this bound fails on any per-poll compile while staying far
+            # above honest scheduling noise
+            assert steady_s < 2.0, f"steady fused poll took {steady_s:.2f}s"
+
+        asyncio.run(go())
+
+    def test_ring_delta_matches_fresh_seed(self):
+        """Drive the engine through incremental updates, then compare its
+        outputs to a FRESH engine seeded directly on the same klines —
+        pins the ring base-pointer/scatter bookkeeping."""
+        symbols = ["BTCUSDC", "ETHUSDC"]
+        ex = _exchange(tuple(symbols))
+        frames = ("1m", "3m", "5m")
+        eng = TickEngine(symbols, frames, window=LIMIT)
+
+        def snap():
+            return {(s, iv): ex.get_klines(s, iv, LIMIT)[-LIMIT:]
+                    for s in symbols for iv in frames}
+
+        for _ in range(5):
+            for (s, iv), kl in snap().items():
+                eng.ingest(s, iv, kl)
+            out_inc = eng.step()
+            ex.advance(steps=1)
+        assert not eng.last_stats["full_seed"]
+
+        fresh = TickEngine(symbols, frames, window=LIMIT)
+        ex.advance(steps=0)  # same cursor
+        for (s, iv), kl in {(s, iv): ex.get_klines(s, iv, LIMIT)[-LIMIT:]
+                            for s in symbols for iv in frames}.items():
+            fresh.ingest(s, iv, kl)
+        # note: the incremental engine last stepped BEFORE the final
+        # advance; re-ingest the current snapshot to align both
+        for (s, iv), kl in snap().items():
+            eng.ingest(s, iv, kl)
+        out_inc = eng.step()
+        out_fresh = fresh.step()
+        for key in out_fresh:
+            if key == "combo":
+                for n, v in out_fresh["combo"].items():
+                    np.testing.assert_allclose(
+                        out_inc["combo"][n], v, rtol=1e-5, atol=1e-6,
+                        err_msg=f"combo.{n}")
+            else:
+                np.testing.assert_allclose(
+                    out_inc[key], out_fresh[key], rtol=1e-5, atol=1e-6,
+                    err_msg=key)
+
+    def test_gap_triggers_reseed_not_garbage(self):
+        """A window jump larger than max_new (reconnect gap) re-seeds the
+        slot instead of scattering a bounded delta."""
+        symbols = ["BTCUSDC"]
+        ex = _exchange(("BTCUSDC",))
+        eng = TickEngine(symbols, ("1m",), window=LIMIT, max_new=4)
+        eng.ingest("BTCUSDC", "1m", ex.get_klines("BTCUSDC", "1m", LIMIT))
+        eng.step()
+        seeds_before = eng.full_seeds
+        ex.advance(steps=50)                    # >> max_new candles
+        eng.ingest("BTCUSDC", "1m", ex.get_klines("BTCUSDC", "1m", LIMIT))
+        out = eng.step()
+        assert eng.full_seeds == seeds_before + 1
+        assert eng.last_stats["full_seed"]
+        c = ex.get_klines("BTCUSDC", "1m", 1)[-1][4]
+        assert float(out["current_price"][0, 0]) == pytest.approx(c)
+
+
+class TestBatchedPredict:
+    def test_batched_matches_single_predict(self):
+        """predict_prices_batched == predict_prices per lane, for models
+        with distinct params/scalers sharing one architecture (the
+        PredictionService grouping)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ai_crypto_trader_tpu.models import build_model
+        from ai_crypto_trader_tpu.models.train import (
+            Scaler, TrainResult, predict_prices, predict_prices_batched)
+
+        rng = np.random.default_rng(5)
+        seq_len, F = 12, 5
+        results, feats = [], []
+        for lane in range(3):
+            model = build_model("lstm", units=4)
+            series = np.cumsum(
+                rng.normal(1.0, 0.1, (seq_len + 6, F)), axis=0
+            ).astype(np.float32) + 10.0 * (lane + 1)
+            params = model.init(jax.random.PRNGKey(lane),
+                                jnp.zeros((1, seq_len, F)), False)
+            scaler = Scaler(jnp.asarray(series.min(axis=0)),
+                            jnp.asarray(series.max(axis=0)))
+            results.append(TrainResult(
+                params=params, model_type="lstm", scaler=scaler,
+                model_kwargs={"units": 4}, best_val_loss=0.01 * (lane + 1),
+                target_col=3))
+            feats.append(series)
+        batched = predict_prices_batched(results, feats, seq_len=seq_len)
+        for r, f, b in zip(results, feats, batched):
+            single = predict_prices(r, f, seq_len=seq_len)
+            assert float(np.ravel(b["predicted_price"])[0]) == pytest.approx(
+                float(np.ravel(single["predicted_price"])[0]), rel=1e-5)
+            assert b["confidence"] == pytest.approx(single["confidence"])
+
+    def test_service_groups_by_architecture(self):
+        """The service's _predict_jobs runs one stacked program for an
+        architecture group and per-pair programs for singletons, and
+        preserves job order."""
+        from ai_crypto_trader_tpu.models.service import PredictionService
+
+        calls = []
+
+        class FakeResult:
+            def __init__(self, mt, kw):
+                self.model_type = mt
+                self.model_kwargs = kw
+
+        svc = PredictionService(EventBus(), ["A", "B", "C"],
+                                now_fn=lambda: 0.0)
+        jobs = [("A", "1m", FakeResult("lstm", {"units": 4}), "fa"),
+                ("B", "1m", FakeResult("lstm", {"units": 4}), "fb"),
+                ("C", "1m", FakeResult("gru", {"units": 4}), "fc")]
+
+        import ai_crypto_trader_tpu.models.service as service_mod
+
+        def fake_batched(rs, fs, seq_len):
+            calls.append(("batch", len(rs)))
+            return [{"p": f} for f in fs]
+
+        def fake_single(r, f, seq_len):
+            calls.append(("single", f))
+            return {"p": f}
+
+        orig_b = service_mod.predict_prices_batched
+        orig_s = service_mod.predict_prices
+        service_mod.predict_prices_batched = fake_batched
+        service_mod.predict_prices = fake_single
+        try:
+            preds = svc._predict_jobs(jobs)
+        finally:
+            service_mod.predict_prices_batched = orig_b
+            service_mod.predict_prices = orig_s
+        assert preds == [{"p": "fa"}, {"p": "fb"}, {"p": "fc"}]
+        assert ("batch", 2) in calls
+        assert ("single", "fc") in calls
