@@ -288,11 +288,13 @@ def cmd_trade(args):
         series={args.symbol: series}, quote_balance=10_000.0)
     ex.advance(args.symbol, steps=600)   # warm history so the monitor has a
     #                                      full fixed-shape indicator window
+    resume = bool(args.journal) and os.path.exists(args.journal)
     system = TradingSystem(ex, [args.symbol], now_fn=lambda: clock["t"],
                            dashboard_path=args.dashboard,
                            log_path=os.environ.get("LOG_PATH"),
                            enable_tracing=bool(args.trace_jsonl),
-                           trace_jsonl=args.trace_jsonl)
+                           trace_jsonl=args.trace_jsonl,
+                           journal_path=args.journal)
     if args.full_stack:
         from ai_crypto_trader_tpu.shell.stack import build_full_stack
         from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
@@ -315,6 +317,13 @@ def cmd_trade(args):
 
     async def go():
         msrv = None
+        if resume:
+            # crash/restart recovery: replay the write-ahead journal, then
+            # reconcile the books against the exchange before trading
+            report = await system.recover()
+            print(json.dumps({"recovered": {
+                k: v for k, v in report.items() if k != "journal"}},
+                default=str), flush=True)
         if metrics_port:
             # Prometheus scrape target (compose: prometheus → trader:9091)
             msrv = await system.metrics.serve("0.0.0.0", metrics_port)
@@ -475,6 +484,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="enable end-to-end tracing and append every "
                          "finished span to this JSONL file "
                          "(utils/tracing.py; /traces on --serve)")
+    sp.add_argument("--journal", default=None, metavar="PATH",
+                    help="crash-safe state: write-ahead journal every "
+                         "order intent/ack/closure to PATH; if the file "
+                         "already exists, replay + reconcile it against "
+                         "the exchange before trading (utils/journal.py)")
     sp.add_argument("--serve-hold-s", type=float, default=0.0,
                     help="keep serving this many seconds after the ticks")
     sp.set_defaults(fn=cmd_trade)

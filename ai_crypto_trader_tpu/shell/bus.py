@@ -33,25 +33,50 @@ from typing import Any, AsyncIterator
 from ai_crypto_trader_tpu.utils import tracing
 
 
+#: Channels where silently losing a message is NOT acceptable telemetry
+#: behavior: a dropped alert hides an incident, a dropped trading signal
+#: silently skips a trade.  Default policy "grow": their queues are
+#: unbounded (backlog is surfaced as a warning past the soft limit instead
+#: of discarded).  The other policy is "alert_on_drop": bounded, but every
+#: overflow publishes a MessageLoss alert naming the channel.
+CRITICAL_CHANNELS = {"alerts": "grow", "trading_signals": "grow"}
+
+
 class EventBus:
     """Channels + KV store. Subscribers get bounded asyncio queues; slow
     consumers drop oldest (the reference's fire-and-forget pub/sub has no
-    delivery guarantee either — parity, but explicit)."""
+    delivery guarantee either — parity, but explicit).  Critical channels
+    carry a per-channel overflow policy instead (see CRITICAL_CHANNELS /
+    the ``overflow`` ctor arg): "grow" or "alert_on_drop"."""
 
     def __init__(self, max_queue: int = 1024, now_fn=time.time,
-                 metrics=None, log=None):
+                 metrics=None, log=None, overflow: dict | None = None):
         self._subs: dict[str, list[asyncio.Queue]] = defaultdict(list)
         self._kv: dict[str, Any] = {}
         self._max_queue = max_queue
         self._now = now_fn
         self.metrics = metrics            # MetricsRegistry | None
         self.log = log                    # StructuredLogger | None
+        self.overflow = {**CRITICAL_CHANNELS, **(overflow or {})}
         self.published_counts: dict[str, int] = defaultdict(int)
         self.dropped_counts: dict[str, int] = defaultdict(int)
+        self._grow_warned: dict[str, int] = {}
+
+    def _policy(self, channel: str) -> str:
+        pol = self.overflow.get(channel)
+        if pol is None:
+            for pattern, p in self.overflow.items():
+                if fnmatch.fnmatch(channel, pattern):
+                    return p
+            return "drop_oldest"
+        return pol
 
     # --- pub/sub -----------------------------------------------------------
     def subscribe(self, channel: str) -> asyncio.Queue:
-        q: asyncio.Queue = asyncio.Queue(self._max_queue)
+        # "grow" channels get an unbounded queue: a slow subscriber backlog
+        # on alerts/trading_signals must never silently discard
+        maxsize = 0 if self._policy(channel) == "grow" else self._max_queue
+        q: asyncio.Queue = asyncio.Queue(maxsize)
         self._subs[channel].append(q)
         return q
 
@@ -101,6 +126,24 @@ class EventBus:
                     total_dropped=self.dropped_counts[channel],
                     queue_depth=depth,
                     trace_id=ctx.get("trace_id") if ctx else None)
+            if (self._policy(channel) == "alert_on_drop"
+                    and channel != "alerts"):
+                # loss on a critical bounded channel is an INCIDENT, not
+                # telemetry: surface it on the alerts channel (itself
+                # "grow", so this publish cannot recurse into a drop)
+                await self.publish("alerts", {
+                    "name": "MessageLoss", "severity": "warning",
+                    "channel": channel, "dropped": dropped,
+                    "at": self._now()})
+        elif (self._policy(channel) == "grow" and depth > self._max_queue
+              and self.log is not None
+              and depth >= 2 * self._grow_warned.get(channel, 0)):
+            # unbounded critical channel growing past the soft limit:
+            # warn at doubling thresholds, not every publish
+            self._grow_warned[channel] = depth
+            self.log.warning("critical channel backlog growing",
+                             channel=channel, queue_depth=depth,
+                             soft_limit=self._max_queue)
         if self.metrics is not None:
             self.metrics.observe("bus_fanout_latency_seconds", fanout_s,
                                  channel=channel)

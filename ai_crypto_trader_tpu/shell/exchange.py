@@ -62,7 +62,8 @@ class ExchangeInterface(ABC):
     @abstractmethod
     def place_order(self, symbol: str, side: str, order_type: str,
                     quantity: float, price: float | None = None,
-                    stop_price: float | None = None) -> dict: ...
+                    stop_price: float | None = None,
+                    client_order_id: str | None = None) -> dict: ...
 
     @abstractmethod
     def cancel_order(self, symbol: str, order_id: int) -> dict: ...
@@ -104,6 +105,23 @@ class ExchangeInterface(ABC):
         the discovery surface `CryptoScanner.scan_market` builds from
         exchange info (`binance_ml_strategy.py:293-340`). Default empty for
         adapters without discovery."""
+        return []
+
+    def find_order_by_client_id(self, symbol: str,
+                                client_order_id: str) -> dict | None:
+        """Look an order up by the caller-chosen client id.
+
+        This is how an AMBIGUOUS mutation failure ("place_order raised —
+        but did the request reach the venue?") is resolved after a crash:
+        the reconciler re-derives the deterministic client id from the
+        journaled intent and asks the venue whether it knows the order.
+        Default None = adapter cannot answer (callers must then treat the
+        intent as unresolved and stand down, never re-enter blindly)."""
+        return None
+
+    def list_open_orders(self, symbol: str | None = None) -> list[dict]:
+        """All resting orders (optionally one symbol) — the reconciler's
+        orphan sweep. Default empty for adapters without order state."""
         return []
 
 
@@ -222,11 +240,26 @@ class FakeExchange(ExchangeInterface):
 
     def place_order(self, symbol: str, side: str, order_type: str,
                     quantity: float, price: float | None = None,
-                    stop_price: float | None = None) -> dict:
+                    stop_price: float | None = None,
+                    client_order_id: str | None = None) -> dict:
+        if not (np.isfinite(quantity) and quantity > 0.0):
+            # a real venue rejects NaN/zero/negative quantities at the
+            # filter layer — booking one here would poison the balances
+            return {"symbol": symbol, "side": side.upper(),
+                    "type": order_type.upper(), "status": "REJECTED",
+                    "reason": "invalid_quantity"}
+        if client_order_id is not None:
+            # venue-side idempotency (Binance rejects duplicate
+            # newClientOrderId): a retried/replayed placement returns the
+            # original order instead of double-entering
+            existing = self.find_order_by_client_id(symbol, client_order_id)
+            if existing is not None:
+                return {**existing, "duplicate": True}
         oid = next(self._order_ids)
         order = {"order_id": oid, "symbol": symbol, "side": side.upper(),
                  "type": order_type.upper(), "quantity": float(quantity),
-                 "limit_price": price, "stop_price": stop_price}
+                 "limit_price": price, "stop_price": stop_price,
+                 "client_order_id": client_order_id}
         if order["type"] == "MARKET":
             return self._fill(order, self._candle(symbol)["close"])
         order["status"] = "OPEN"
@@ -269,6 +302,21 @@ class FakeExchange(ExchangeInterface):
 
     def order_is_open(self, symbol: str, order_id: int) -> bool:
         return order_id in self.open_orders
+
+    def find_order_by_client_id(self, symbol, client_order_id):
+        for o in self.open_orders.values():
+            if (o.get("client_order_id") == client_order_id
+                    and o["symbol"] == symbol):
+                return dict(o)
+        for f in reversed(self.fills):
+            if (f.get("client_order_id") == client_order_id
+                    and f["symbol"] == symbol):
+                return dict(f)
+        return None
+
+    def list_open_orders(self, symbol: str | None = None) -> list[dict]:
+        return [dict(o) for o in self.open_orders.values()
+                if symbol is None or o["symbol"] == symbol]
 
     def last_fill(self, order_id: int) -> dict | None:
         for f in reversed(self.fills):
@@ -316,16 +364,60 @@ class BinanceExchange(ExchangeInterface):
         return self.client.get_klines(symbol=symbol, interval=interval, limit=limit)
 
     def place_order(self, symbol, side, order_type, quantity, price=None,
-                    stop_price=None):
+                    stop_price=None, client_order_id=None):
         kw = dict(symbol=symbol, side=side, type=order_type, quantity=quantity)
         if price is not None:
             kw["price"] = price
         if stop_price is not None:
             kw["stopPrice"] = stop_price
+        if client_order_id is not None:
+            # venue-enforced idempotency key: a deterministic id makes an
+            # ambiguous failure ("raised — did it reach Binance?")
+            # resolvable via get_order(origClientOrderId=...) instead of a
+            # silent double-order hazard
+            kw["newClientOrderId"] = client_order_id
         return self.client.create_order(**kw)
 
     def cancel_order(self, symbol, order_id):
         return self.client.cancel_order(symbol=symbol, orderId=order_id)
+
+    def find_order_by_client_id(self, symbol, client_order_id):
+        try:
+            o = self.client.get_order(symbol=symbol,
+                                      origClientOrderId=client_order_id)
+        except Exception as exc:                       # noqa: BLE001
+            # ONLY "unknown order" means the venue never saw this id.
+            # Anything else (timeout, rate limit, 5xx) must PROPAGATE —
+            # ResilientExchange wraps it and the reconciler keeps the
+            # intent parked; returning None here would make a network
+            # blip indistinguishable from not-placed and unblock the
+            # exact double-entry the client id exists to prevent.
+            msg = str(exc).lower()
+            if (getattr(exc, "code", None) == -2013     # binance NO_SUCH_ORDER
+                    or "does not exist" in msg or "unknown order" in msg):
+                return None
+            raise
+        executed = float(o.get("executedQty", 0.0) or 0.0)
+        price = float(o.get("price", 0.0) or 0.0)
+        if price <= 0.0 and executed > 0.0:
+            # MARKET orders report price=0; the real average fill price
+            # is cumulative quote volume over executed base
+            price = float(o.get("cummulativeQuoteQty", 0.0) or 0.0) / executed
+        return {"order_id": o.get("orderId"), "symbol": symbol,
+                "status": o.get("status"), "side": o.get("side"),
+                "quantity": float(o.get("origQty", 0.0)),
+                "executed_qty": executed,
+                "price": price,
+                "client_order_id": client_order_id}
+
+    def list_open_orders(self, symbol=None):
+        kw = {"symbol": symbol} if symbol else {}
+        return [{"order_id": o.get("orderId"), "symbol": o.get("symbol"),
+                 "status": o.get("status"), "side": o.get("side"),
+                 "type": o.get("type"),
+                 "quantity": float(o.get("origQty", 0.0)),
+                 "client_order_id": o.get("clientOrderId")}
+                for o in self.client.get_open_orders(**kw)]
 
     def order_is_open(self, symbol, order_id):
         o = self.client.get_order(symbol=symbol, orderId=order_id)
@@ -364,6 +456,10 @@ class ExchangeUnavailable(RuntimeError):
     has exhausted its retries — the caller's cycle should skip/abort."""
 
 
+class _BlockingBudgetExceeded(RuntimeError):
+    """Internal: a sleep would exceed ResilientExchange.max_block_s."""
+
+
 class ResilientExchange(ExchangeInterface):
     """Resilience decorator around any ExchangeInterface.
 
@@ -386,7 +482,16 @@ class ResilientExchange(ExchangeInterface):
       every Binance call, business errors included:
       `market_monitor_service.py:96-115`);
     - an open circuit or a final failure raises ExchangeUnavailable
-      (executor cycles fail loudly instead of silently trading on None).
+      (executor cycles fail loudly instead of silently trading on None);
+    - total BLOCKING time per public call is bounded by ``max_block_s``:
+      backoff and token-bucket deficits sleep on the caller's thread —
+      on the one shared event loop a retry storm would otherwise freeze
+      every service, alert evaluation and heartbeat for up to
+      ``max_delay_s``.  When the budget is exhausted the call fails as
+      ExchangeUnavailable (a breaker failure) instead of sleeping on;
+    - loop callers that cannot afford ANY blocking await ``acall(...)``,
+      which runs the same protected call on a worker thread — the
+      async-aware seam (sleeps happen off-loop, heartbeats keep beating).
 
     Deterministic: clock, sleep and jitter rng are injectable.
     """
@@ -396,6 +501,7 @@ class ResilientExchange(ExchangeInterface):
                  rate_per_s: float = 20.0, burst: float = 40.0,
                  max_read_retries: int = 2, base_delay_s: float = 0.25,
                  max_delay_s: float = 30.0,
+                 max_block_s: float | None = 30.0,
                  now_fn: Callable[[], float] = time.time,
                  sleep: Callable[[float], None] = time.sleep,
                  rng: random.Random | None = None):
@@ -412,6 +518,7 @@ class ResilientExchange(ExchangeInterface):
         self.max_read_retries = max_read_retries
         self.base_delay_s = base_delay_s
         self.max_delay_s = max_delay_s
+        self.max_block_s = max_block_s
         self._sleep = sleep
         self._rng = rng or random.Random(0)
 
@@ -428,9 +535,21 @@ class ResilientExchange(ExchangeInterface):
             raise ExchangeUnavailable(
                 f"exchange circuit {self.breaker.state.value}")
 
-    def _acquire_token(self):
+    def _budget(self) -> list:
+        """Per-public-call blocking allowance, consumed by every sleep."""
+        return [float("inf") if self.max_block_s is None else self.max_block_s]
+
+    def _budgeted_sleep(self, seconds: float, budget: list) -> None:
+        if seconds > budget[0]:
+            raise _BlockingBudgetExceeded(
+                f"sleep of {seconds:.2f}s would exceed the per-call "
+                f"blocking budget ({self.max_block_s}s)")
+        budget[0] -= seconds
+        self._sleep(seconds)
+
+    def _acquire_token(self, budget: list):
         while not self.bucket.try_acquire():
-            self._sleep(max(self.bucket.wait_time(), 1e-3))
+            self._budgeted_sleep(max(self.bucket.wait_time(), 1e-3), budget)
 
     def _read(self, fn: Callable, *args, **kw):
         from ai_crypto_trader_tpu.utils.circuit_breaker import backoff_delays
@@ -439,16 +558,23 @@ class ResilientExchange(ExchangeInterface):
         self.breaker.stats["calls"] += 1
         delays = backoff_delays(self.max_read_retries, self.base_delay_s,
                                 self.max_delay_s, rng=self._rng)
+        budget = self._budget()
         last_exc: Exception | None = None
         for _attempt in range(self.max_read_retries + 1):
-            self._acquire_token()       # every physical attempt pays a token
             try:
+                self._acquire_token(budget)   # every physical attempt pays
                 out = fn(*args, **kw)
+            except _BlockingBudgetExceeded as exc:
+                last_exc = exc
+                break                         # no budget left to retry with
             except Exception as exc:                       # noqa: BLE001
                 last_exc = exc
                 delay = next(delays, None)
                 if delay is not None:
-                    self._sleep(delay)
+                    try:
+                        self._budgeted_sleep(delay, budget)
+                    except _BlockingBudgetExceeded:
+                        break
                 continue
             self.breaker.record_success()
             return out
@@ -459,7 +585,12 @@ class ResilientExchange(ExchangeInterface):
 
     def _write(self, fn: Callable, *args, **kw):
         self._gate()
-        self._acquire_token()
+        try:
+            self._acquire_token(self._budget())
+        except _BlockingBudgetExceeded as exc:
+            self.breaker.record_failure()
+            raise ExchangeUnavailable(
+                f"order operation blocked on rate limit: {exc}") from exc
         self.breaker.stats["calls"] += 1
         try:
             out = fn(*args, **kw)
@@ -468,6 +599,17 @@ class ResilientExchange(ExchangeInterface):
             raise ExchangeUnavailable(f"order operation failed: {exc}") from exc
         self.breaker.record_success()
         return out
+
+    async def acall(self, method: str, *args, **kw):
+        """Async-aware seam for event-loop callers: run one protected
+        call (``await ex.acall("get_klines", sym, "1m", 100)``) on a
+        worker thread, so backoff/rate-limit sleeps never block the shared
+        loop.  The inner adapter must be thread-compatible for the call
+        (true of BinanceExchange's HTTP client; FakeExchange callers
+        should keep using the sync surface on the loop)."""
+        import asyncio
+
+        return await asyncio.to_thread(getattr(self, method), *args, **kw)
 
     # --- reads: retried ----------------------------------------------------
     def get_ticker(self, symbol):
@@ -493,11 +635,20 @@ class ResilientExchange(ExchangeInterface):
         return self._read(self.inner.order_state, symbol, order_id,
                           assumed_total)
 
+    def find_order_by_client_id(self, symbol, client_order_id):
+        return self._read(self.inner.find_order_by_client_id, symbol,
+                          client_order_id)
+
+    def list_open_orders(self, symbol=None):
+        return self._read(self.inner.list_open_orders, symbol)
+
     # --- mutations: single attempt -----------------------------------------
     def place_order(self, symbol, side, order_type, quantity, price=None,
-                    stop_price=None):
+                    stop_price=None, client_order_id=None):
+        kw = ({"client_order_id": client_order_id}
+              if client_order_id is not None else {})
         return self._write(self.inner.place_order, symbol, side, order_type,
-                           quantity, price, stop_price)
+                           quantity, price, stop_price, **kw)
 
     def cancel_order(self, symbol, order_id):
         return self._write(self.inner.cancel_order, symbol, order_id)

@@ -54,6 +54,18 @@ class TradingSystem:
     # enable_tracing).
     enable_tracing: bool = False
     trace_jsonl: str | None = None
+    # Crash-safe trading state (utils/journal.py): when set, the executor
+    # write-ahead-journals every order intent/ack/closure here, and
+    # `recover()` replays + reconciles it after a restart.
+    journal_path: str | None = None
+    # Stage supervision (utils/supervision.py): a non-ExchangeUnavailable
+    # exception inside monitor/analyzer/executor is isolated with
+    # exponential backoff; N consecutive failures quarantine the stage
+    # (heartbeat withheld, ServiceCrashLoop alert) while the rest of the
+    # system keeps ticking.
+    stage_max_failures: int = 3
+    stage_backoff_s: float = 2.0
+    stage_quarantine_s: float = 300.0
 
     @classmethod
     def with_discovery(cls, exchange, scanner=None, **kw):
@@ -100,15 +112,60 @@ class TradingSystem:
         self.analyzer = SignalAnalyzer(
             self.bus, now_fn=self.now_fn,
             analysis_interval_s=self.config.trading.ai_analysis_interval)
+        self.journal = None
+        if self.journal_path:
+            from ai_crypto_trader_tpu.utils.journal import WriteAheadJournal
+
+            self.journal = WriteAheadJournal(self.journal_path,
+                                             now_fn=self.now_fn)
         self.executor = TradeExecutor(self.bus, self.exchange,
                                       trading=self.config.trading,
                                       trailing=self.config.risk.trailing_stop,
-                                      now_fn=self.now_fn)
+                                      now_fn=self.now_fn,
+                                      journal=self.journal)
+        from ai_crypto_trader_tpu.utils.supervision import StageBreaker
+
+        self.stage_breakers = {
+            name: StageBreaker(name,
+                               max_failures=self.stage_max_failures,
+                               base_backoff_s=self.stage_backoff_s,
+                               quarantine_s=self.stage_quarantine_s)
+            for name in ("monitor", "analyzer", "executor")}
+        # register every core stage up front: a stage that crashes before
+        # its FIRST beat still shows (unhealthy) in service_health
+        for name in self.stage_breakers:
+            self.heartbeats.expect(name)
         # subscribe before any publish so tick-0 messages aren't missed
         self.analyzer._queue()
         self.executor._queue()
         self._last_market_update = self.now_fn()
         self._logged_closures = 0
+
+    async def recover(self, journal_path: str | None = None) -> dict:
+        """Restart recovery: replay the write-ahead journal into the
+        executor's books, reconcile against exchange ground truth
+        (re-adopt live protective orders, finalize positions that closed
+        while we were down, cancel orphans), and compact the journal.
+        Call once after construction, before the first tick."""
+        journal = self.journal
+        if journal_path is not None and (journal is None
+                                         or journal.path != journal_path):
+            from ai_crypto_trader_tpu.utils.journal import WriteAheadJournal
+
+            journal = WriteAheadJournal(journal_path, now_fn=self.now_fn)
+            self.journal = self.executor.journal = journal
+        if journal is None:
+            raise ValueError("recover() needs a journal_path (ctor or arg)")
+        report = await self.executor.recover_from_journal(journal)
+        # replayed closures were logged by the previous process — only NEW
+        # closures from here on produce structured trade-closed lines
+        self._logged_closures = len(self.executor.closed_trades)
+        self.log.info("recovered trading state from journal",
+                      journal=journal.path, **{
+                          k: v for k, v in report.items() if k != "journal"})
+        self.metrics.set_gauge("open_positions",
+                               len(self.executor.active_trades))
+        return report
 
     async def tick(self) -> dict:
         """One full pass of the live signal path + observability.
@@ -130,6 +187,62 @@ class TradingSystem:
             sp.set_attribute("executed", out.get("executed", 0))
         return out
 
+    async def _run_stage(self, name: str, fn):
+        """Supervised stage execution: success beats the heartbeat;
+        ExchangeUnavailable propagates (the skip-tick path); any OTHER
+        exception is isolated here — backoff, then quarantine after N
+        consecutive failures — so one crash-looping stage can never kill
+        `run()` while the rest of the system stays alive."""
+        from ai_crypto_trader_tpu.shell.exchange import ExchangeUnavailable
+
+        br = self.stage_breakers[name]
+        now = self.now_fn()
+        if not br.should_run(now):
+            return None                    # backoff/quarantine window
+        try:
+            out = await fn()
+        except ExchangeUnavailable:
+            raise                          # outage semantics unchanged
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:           # noqa: BLE001 — stage isolation
+            tripped = br.record_failure(self.now_fn(), error=str(exc))
+            self.metrics.inc("errors_total", kind=f"stage_{name}")
+            self.metrics.set_gauge("stage_consecutive_failures", br.failures,
+                                   stage=name)
+            self.log.error("stage failure isolated", stage=name,
+                           error=f"{type(exc).__name__}: {exc}",
+                           consecutive=br.failures, quarantined=br.quarantined)
+            await self.bus.publish("alerts", {
+                "name": "StageError", "severity": "warning", "service": name,
+                "message": f"{type(exc).__name__}: {exc}",
+                "at": self.now_fn()})
+            if tripped:
+                self.metrics.inc("stage_quarantines_total", stage=name)
+                await self.bus.publish("alerts", {
+                    "name": "ServiceCrashLoop", "severity": "critical",
+                    "service": name, "failures": br.failures,
+                    "message": f"stage {name} quarantined after "
+                               f"{br.failures} consecutive failures",
+                    "at": self.now_fn()})
+            return None
+        if br.record_success(self.now_fn()):
+            self.log.info("stage recovered from crash loop", stage=name)
+            await self.bus.publish("alerts", {
+                "name": "ServiceCrashLoopRecovered", "severity": "info",
+                "service": name, "at": self.now_fn()})
+        self.metrics.set_gauge("stage_consecutive_failures", 0, stage=name)
+        self.heartbeats.beat(name)
+        return out
+
+    async def _executor_stage(self):
+        executed = await self.executor.run_once()
+        for symbol in self.symbols:
+            md = self.bus.get(f"market_data_{symbol}")
+            if md and symbol in self.executor.active_trades:
+                await self.executor.on_price(symbol, md["current_price"])
+        return executed
+
     async def _tick_inner(self) -> dict:
         from ai_crypto_trader_tpu.shell.exchange import ExchangeUnavailable
 
@@ -138,18 +251,14 @@ class TradingSystem:
         #                               clock in paper mode, and the latency
         #                               panel must show real compute time
         try:
-            published = await self.monitor.poll()
-            self.heartbeats.beat("monitor")
+            published = await self._run_stage("monitor",
+                                              self.monitor.poll) or 0
             if published:
                 self._last_market_update = self.now_fn()
-            analyzed = await self.analyzer.run_once()
-            self.heartbeats.beat("analyzer")
-            executed = await self.executor.run_once()
-            self.heartbeats.beat("executor")
-            for symbol in self.symbols:
-                md = self.bus.get(f"market_data_{symbol}")
-                if md and symbol in self.executor.active_trades:
-                    await self.executor.on_price(symbol, md["current_price"])
+            analyzed = await self._run_stage("analyzer",
+                                             self.analyzer.run_once) or 0
+            executed = await self._run_stage("executor",
+                                             self._executor_stage) or 0
             balances = self.exchange.get_balances()
         except ExchangeUnavailable as exc:
             self.metrics.inc("errors_total", kind="exchange_unavailable")
@@ -197,7 +306,7 @@ class TradingSystem:
         self.metrics.inc("market_updates_total", published)
         self.metrics.inc("trading_signals_total", analyzed)
         self.metrics.inc("signals_processed_total", executed)
-        self.metrics.set_gauge("closed_trades", len(self.executor.closed_trades))
+        self.metrics.set_gauge("closed_trades", self.executor.closed_count())
         self.metrics.observe("tick_duration_seconds",
                              time.perf_counter() - t0)
         self._emit_health_gauges()
@@ -311,6 +420,8 @@ class TradingSystem:
             "open_positions": len(self.executor.active_trades),
             "max_positions": self.config.trading.max_positions,
             "service_health": self.heartbeats.health(),
+            "crash_looped_services": [n for n, b in self.stage_breakers.items()
+                                      if b.quarantined],
         }
         confidences = [
             s.get("confidence", 0.0)
@@ -366,8 +477,8 @@ class TradingSystem:
             "balances": balances,
             "active_trades": {s: t.entry_price
                               for s, t in self.executor.active_trades.items()},
-            "closed_trades": len(self.executor.closed_trades),
-            "total_pnl": sum(t["pnl"] for t in self.executor.closed_trades),
+            "closed_trades": self.executor.closed_count(),
+            "total_pnl": self.executor.closed_pnl(),
             "alerts": list(self.alerts.active),
             "channels": dict(self.bus.published_counts),
         }
@@ -399,6 +510,8 @@ class TradingSystem:
                 # discarded registry (listener registration is permanent)
                 monitor.metrics = None
             self.tracer.close()
+        if self.journal is not None:
+            self.journal.close()           # flush the buffered tail
 
     async def run(self, duration_s: float | None = None,
                   tick_interval_s: float = 5.0):

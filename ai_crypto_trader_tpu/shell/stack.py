@@ -261,4 +261,9 @@ def build_full_stack(system, *, registry=None, llm=None,
             bus=bus, now_fn=now_fn, **dca_kw))
 
     system.extra_services.extend(services)
+    # register every service's heartbeat up front: one that crashes before
+    # its FIRST beat must still appear (unhealthy) in service_health, or
+    # ServiceDown can never fire for it (utils/health.py expect())
+    for svc in services:
+        system.heartbeats.expect(getattr(svc, "name", type(svc).__name__))
     return services

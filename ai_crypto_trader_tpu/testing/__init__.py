@@ -1,0 +1,10 @@
+"""Deterministic fault-injection harnesses (testing/chaos.py)."""
+
+from ai_crypto_trader_tpu.testing.chaos import (  # noqa: F401
+    ChaosBus,
+    ChaosExchange,
+    FaultSchedule,
+    SimulatedCrash,
+    inject_bus_faults,
+    torn_tail,
+)

@@ -1,0 +1,255 @@
+"""Deterministic chaos harness: seed-scheduled fault injection for the
+exchange seam and the event bus.
+
+The reference never tests failure paths (its tests hit live Binance —
+SURVEY §4).  This module makes failure behavior a FIRST-CLASS test input:
+
+  * ``FaultSchedule`` — a seeded RNG + scripted overrides deciding, per
+    adapter call, which fault (if any) fires.  Same seed → same fault
+    sequence, so a chaos soak failure replays exactly;
+  * ``ChaosExchange`` — wraps any ExchangeInterface: raises connection
+    errors, injects latency spikes (through an injectable sleep — virtual
+    clocks stay virtual), serves stale/partial/malformed klines, and can
+    crash MID-ORDER (after the venue accepted it — the ambiguous failure
+    the write-ahead journal + client-id reconciliation exist for);
+  * ``ChaosBus`` — EventBus with publish-side drop/duplicate/delay;
+  * ``torn_tail`` — truncates a journal file mid-record (the crash-during-
+    write(2) signature replay must tolerate).
+
+Everything here is deterministic and wall-clock free; the kill-and-restart
+chaos soak in tests/test_chaos.py drives the full stack through a scripted
+fault schedule and asserts the recovery invariants.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
+
+#: fault kinds ChaosExchange understands, and the calls they apply to
+READ_FAULTS = ("error", "latency", "stale", "partial", "malformed")
+ORDER_FAULTS = ("error", "crash_after_order")
+
+
+class SimulatedCrash(BaseException):
+    """The process 'died' here — the harness unwinds the tick and
+    restarts the system from its journal.
+
+    Deliberately a BaseException: process death must NOT be catchable by
+    the resilience layers under test (ResilientExchange wraps Exception
+    into ExchangeUnavailable, the stage supervisor isolates Exception) —
+    it unwinds everything, like a real SIGKILL."""
+
+
+class FaultSchedule:
+    """Seed-deterministic fault decisions.
+
+    ``rates`` maps fault kind → probability per eligible call; ``script``
+    maps an absolute call index (the Nth adapter call overall) → fault
+    kind, overriding the dice for that call.  One shared call counter
+    covers all methods so a schedule is a total order of events.
+    """
+
+    def __init__(self, seed: int = 0, rates: dict | None = None,
+                 script: dict | None = None):
+        self.rng = random.Random(seed)
+        self.rates = dict(rates or {})
+        self.script = dict(script or {})
+        self.calls = 0
+        self.injected: list = []          # (call_index, method, fault) log
+
+    def next_fault(self, method: str, eligible: tuple) -> str | None:
+        idx = self.calls
+        self.calls += 1
+        fault = self.script.get(idx)
+        if fault is None:
+            # one draw per call regardless of eligibility → the fault
+            # sequence is stable when eligibility sets differ per method
+            draw = self.rng.random()
+            acc = 0.0
+            for kind, p in sorted(self.rates.items()):
+                acc += p
+                if draw < acc:
+                    fault = kind
+                    break
+        if fault is None or fault not in eligible:
+            return None
+        self.injected.append((idx, method, fault))
+        return fault
+
+
+class ChaosExchange(ExchangeInterface):
+    """Fault-injecting decorator for any ExchangeInterface.
+
+    Sits UNDER ResilientExchange in the stack (chaos is what the breaker
+    and retries are being tested against):
+
+        FakeExchange → ChaosExchange → ResilientExchange → TradingSystem
+    """
+
+    def __init__(self, inner: ExchangeInterface, schedule: FaultSchedule,
+                 sleep: Callable[[float], None] = lambda s: None,
+                 latency_s: float = 2.0):
+        self.inner = inner
+        self.schedule = schedule
+        self._sleep = sleep
+        self.latency_s = latency_s
+        self._kline_cache: dict = {}
+
+    def __getattr__(self, name):
+        if name == "inner":
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    # --- fault plumbing ----------------------------------------------------
+    def _fault(self, method: str, eligible: tuple = READ_FAULTS):
+        fault = self.schedule.next_fault(method, eligible)
+        if fault == "latency":
+            self._sleep(self.latency_s)   # spike, then the call succeeds
+            return None
+        if fault == "error":
+            raise ConnectionError(f"chaos: injected {method} failure")
+        return fault
+
+    # --- reads -------------------------------------------------------------
+    def get_ticker(self, symbol):
+        self._fault("get_ticker", ("error", "latency"))
+        return self.inner.get_ticker(symbol)
+
+    def get_order_book(self, symbol, limit=20):
+        self._fault("get_order_book", ("error", "latency"))
+        return self.inner.get_order_book(symbol, limit)
+
+    def get_klines(self, symbol, interval="1m", limit=100):
+        fault = self._fault("get_klines")
+        key = (symbol, interval, limit)
+        if fault == "stale" and key in self._kline_cache:
+            return self._kline_cache[key]          # yesterday's answer
+        rows = self.inner.get_klines(symbol, interval, limit)
+        self._kline_cache[key] = rows
+        if fault == "partial":
+            return rows[: max(len(rows) // 2, 1)]  # truncated window
+        if fault == "malformed":
+            # a poisoned payload: NaN close and a short row — consumers
+            # must reject/contain it, not trade on it
+            bad = [list(r) for r in rows]
+            if bad:
+                bad[-1][4] = float("nan")
+                bad[len(bad) // 2] = bad[len(bad) // 2][:3]
+            return bad
+        return rows
+
+    def get_balances(self):
+        self._fault("get_balances", ("error", "latency"))
+        return self.inner.get_balances()
+
+    def order_is_open(self, symbol, order_id):
+        self._fault("order_is_open", ("error",))
+        return self.inner.order_is_open(symbol, order_id)
+
+    def executed_qty(self, symbol, order_id, assumed_total, is_open):
+        self._fault("executed_qty", ("error",))
+        return self.inner.executed_qty(symbol, order_id, assumed_total,
+                                       is_open)
+
+    def order_state(self, symbol, order_id, assumed_total):
+        self._fault("order_state", ("error",))
+        return self.inner.order_state(symbol, order_id, assumed_total)
+
+    def find_order_by_client_id(self, symbol, client_order_id):
+        self._fault("find_order_by_client_id", ("error",))
+        return self.inner.find_order_by_client_id(symbol, client_order_id)
+
+    def list_open_orders(self, symbol=None):
+        self._fault("list_open_orders", ("error",))
+        return self.inner.list_open_orders(symbol)
+
+    def list_symbols(self, quote=None):
+        return self.inner.list_symbols(quote)
+
+    # --- mutations ---------------------------------------------------------
+    def place_order(self, symbol, side, order_type, quantity, price=None,
+                    stop_price=None, client_order_id=None):
+        fault = self.schedule.next_fault("place_order", ORDER_FAULTS)
+        if fault == "error":
+            # clean failure: the request never reached the venue
+            raise ConnectionError("chaos: order lost before the venue")
+        out = self.inner.place_order(symbol, side, order_type, quantity,
+                                     price, stop_price,
+                                     client_order_id=client_order_id)
+        if fault == "crash_after_order":
+            # the AMBIGUOUS failure: the venue accepted the order but the
+            # caller sees an exception — resolvable only by client id
+            raise SimulatedCrash(
+                f"chaos: died after {side} {order_type} reached the venue")
+        return out
+
+    def cancel_order(self, symbol, order_id):
+        fault = self.schedule.next_fault("cancel_order", ("error",))
+        if fault == "error":
+            raise ConnectionError("chaos: injected cancel failure")
+        return self.inner.cancel_order(symbol, order_id)
+
+
+BUS_FAULTS = ("bus_drop", "bus_dup", "bus_delay")
+
+
+def inject_bus_faults(bus: EventBus, schedule: FaultSchedule,
+                      exempt: tuple = ("alerts",)) -> EventBus:
+    """Wrap an EventBus instance's publish with drop/duplicate/delay
+    fault injection (transport loss the reference's Redis pub/sub can
+    exhibit).  Delayed messages are delivered ahead of the next publish.
+    ``exempt`` channels are never touched (alerts must stay observable —
+    they are how the soak ASSERTS what happened)."""
+    orig = bus.publish
+    delayed: list = []
+
+    async def publish(channel, message):
+        delivered = 0
+        if delayed:
+            backlog = delayed[:]
+            delayed.clear()
+            for ch, msg in backlog:
+                delivered += await orig(ch, msg)
+        if channel in exempt:
+            return delivered + await orig(channel, message)
+        fault = schedule.next_fault(f"bus:{channel}", BUS_FAULTS)
+        if fault == "bus_drop":
+            bus.dropped_counts[channel] += 1
+            return delivered
+        if fault == "bus_delay":
+            delayed.append((channel, message))
+            return delivered
+        delivered += await orig(channel, message)
+        if fault == "bus_dup":
+            delivered += await orig(channel, message)
+        return delivered
+
+    bus.publish = publish
+    return bus
+
+
+class ChaosBus(EventBus):
+    """EventBus with publish-side fault injection built in (the standalone
+    variant of inject_bus_faults for tests that construct their own bus)."""
+
+    def __init__(self, *args, schedule: FaultSchedule | None = None,
+                 exempt: tuple = ("alerts",), **kw):
+        super().__init__(*args, **kw)
+        inject_bus_faults(self, schedule or FaultSchedule(), exempt)
+
+
+def torn_tail(path: str, keep_bytes: int = 17) -> None:
+    """Truncate the file's final line mid-record — the on-disk signature
+    of a crash during ``write(2)`` that journal replay must tolerate."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    body = raw.rstrip(b"\n")
+    cut = body.rfind(b"\n")
+    last = body[cut + 1:]
+    keep = body[: cut + 1] + last[: min(keep_bytes, max(len(last) - 5, 0))]
+    with open(path, "wb") as f:
+        f.write(keep)

@@ -54,6 +54,9 @@ def default_rules() -> list[AlertRule]:
         AlertRule("ExchangeCircuitOpen", "critical",
                   lambda s: s.get("exchange_circuit_state", "closed") == "open",
                   "exchange circuit breaker is open"),
+        AlertRule("ServiceCrashLoop", "critical",
+                  lambda s: bool(s.get("crash_looped_services")),
+                  "a pipeline stage is quarantined after repeated crashes"),
         AlertRule("MaxPositionsReached", "info",
                   lambda s: s.get("open_positions", 0) >= s.get("max_positions", 5),
                   "position slots exhausted"),

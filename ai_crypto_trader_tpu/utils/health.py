@@ -40,10 +40,19 @@ class HeartbeatRegistry:
     now_fn: Callable[[], float] = time.time
     log: object = None                                # StructuredLogger | None
     beats: dict = field(default_factory=dict)
+    # Registered-but-possibly-never-beaten services: a service that crashes
+    # BEFORE its first beat would otherwise never appear in service_health,
+    # so ServiceDown could never fire for it. Launcher/stack expect() every
+    # service at build time; an expected service with no beat reports
+    # unhealthy once its grace window (registered_at + threshold) passes.
+    expected: dict = field(default_factory=dict)      # name -> registered_at
     _was_stale: set = field(default_factory=set)
 
     def beat(self, service: str) -> None:
         self.beats[service] = self.now_fn()
+
+    def expect(self, service: str) -> None:
+        self.expected.setdefault(service, self.now_fn())
 
     def _threshold(self, service: str) -> float:
         return self.stale_after.get(service, self.stale_after_s)
@@ -52,12 +61,18 @@ class HeartbeatRegistry:
         now = self.now_fn()
         out = [s for s, t in self.beats.items()
                if now - t > self._threshold(s)]
+        # never-beaten expected services: stale once the same threshold has
+        # elapsed since registration (the grace window covers slow starts)
+        out += [s for s, t0 in self.expected.items()
+                if s not in self.beats and now - t0 > self._threshold(s)]
         if self.log is not None:
             cur = set(out)
             for s in sorted(cur - self._was_stale):
+                ref = self.beats.get(s, self.expected.get(s, now))
                 self.log.warning("service went stale", service_name=s,
-                                 age_s=now - self.beats[s],
-                                 threshold_s=self._threshold(s))
+                                 age_s=now - ref,
+                                 threshold_s=self._threshold(s),
+                                 never_beat=s not in self.beats)
             for s in sorted(self._was_stale - cur):
                 if s in self.beats:
                     self.log.info("service recovered", service_name=s)
@@ -65,9 +80,11 @@ class HeartbeatRegistry:
         return out
 
     def health(self) -> dict:
-        """The `service_health` map the alert rules consume."""
+        """The `service_health` map the alert rules consume — covers every
+        service that has beaten OR is expected to."""
         stale = set(self.stale())
-        return {s: s not in stale for s in self.beats}
+        names = list(dict.fromkeys([*self.beats, *self.expected]))
+        return {s: s not in stale for s in names}
 
 
 def device_liveness() -> dict:

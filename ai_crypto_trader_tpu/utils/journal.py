@@ -1,0 +1,186 @@
+"""Append-only, checksummed JSONL write-ahead journal.
+
+The reference system keeps all trading state in Redis, so a crashed
+service rejoins by re-reading keys (SURVEY §L1, §5.3).  The single-loop
+rewrite holds that state in process memory; this journal is the durable
+seam that replaces Redis for the crash/restart story:
+
+  * every record is one JSON line ``{"seq", "t", "kind", "data", "crc"}``
+    where ``crc`` is the CRC-32 of the canonical encoding of the other
+    fields — a torn or bit-rotted line is detected, not trusted;
+  * appends are buffered and fsync'd in batches (``fsync_every``);
+    records that MUST be durable before the next side effect (an order
+    intent before the order hits the exchange) pass ``flush=True``;
+  * replay is torn-tail tolerant: a truncated/corrupt FINAL line is the
+    expected signature of a crash mid-append and is dropped silently;
+    a corrupt line in the middle of the file is skipped and counted
+    (``corrupt_records``) so the caller can decide how loudly to react;
+  * ``compact(snapshot)`` rewrites the file as a single ``snapshot``
+    record (atomic via temp-file + ``os.replace``), bounding replay time
+    for long-running processes.
+
+No dependency on the rest of the framework — shell/executor.py journals
+through it and TradingSystem.recover() replays it, but any subsystem
+needing a durable record stream can use it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import Any, Callable
+
+
+def _crc(seq: int, kind: str, data: Any) -> int:
+    payload = json.dumps([seq, kind, data], sort_keys=True,
+                         separators=(",", ":"), default=str)
+    return zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+
+
+class JournalCorrupt(RuntimeError):
+    """Raised only on structural impossibilities (e.g. the file is a
+    directory) — ordinary torn/corrupt records never raise."""
+
+
+def replay(path: str) -> tuple[list[dict], dict]:
+    """Read every verifiable record from ``path``.
+
+    Returns ``(records, stats)`` where stats counts what was seen:
+    ``{"total_lines", "replayed", "corrupt_records", "torn_tail"}``.
+    Missing file → ``([], zeroed stats)`` — a fresh start is not an error.
+    """
+    stats = {"total_lines": 0, "replayed": 0, "corrupt_records": 0,
+             "torn_tail": False}
+    if not os.path.exists(path):
+        return [], stats
+    with open(path, "rb") as f:
+        raw = f.read()
+    lines = raw.split(b"\n")
+    # a file not ending in \n has a torn final fragment by construction
+    records: list[dict] = []
+    n = len(lines)
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        stats["total_lines"] += 1
+        is_last = i >= n - 2          # final content line (file ends "…\n")
+        try:
+            rec = json.loads(line.decode("utf-8"))
+            if not isinstance(rec, dict):
+                raise ValueError("record is not an object")
+            if _crc(rec["seq"], rec["kind"], rec["data"]) != rec["crc"]:
+                raise ValueError("crc mismatch")
+        except Exception:                            # noqa: BLE001
+            if is_last:
+                # torn tail: the crash happened mid-append; everything
+                # before this line is intact and trustworthy
+                stats["torn_tail"] = True
+            else:
+                stats["corrupt_records"] += 1
+            continue
+        records.append(rec)
+        stats["replayed"] += 1
+    return records, stats
+
+
+class WriteAheadJournal:
+    """One journal file. Not thread-safe (the system is single-loop)."""
+
+    def __init__(self, path: str, fsync_every: int = 8,
+                 now_fn: Callable[[], float] = time.time):
+        self.path = path
+        self.fsync_every = max(int(fsync_every), 1)
+        self.now_fn = now_fn
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        existing, self.replay_stats = replay(path)
+        self.seq = max((r["seq"] for r in existing), default=0)
+        # kept for recovery: recover_from_journal() reuses this instead of
+        # re-reading the file when nothing has been appended since open
+        self.initial_records = existing
+        if self.replay_stats["torn_tail"]:
+            # drop the torn fragment so the next append starts on a clean
+            # line boundary (appending after a partial line would corrupt
+            # the NEXT record too)
+            self._truncate_to_clean_tail()
+        self._f = open(path, "a", encoding="utf-8")
+        # Records buffer HERE (not in the file object) until flush: the
+        # batch that a crash loses is exactly this list, which makes the
+        # chaos harness's simulated kill bit-accurate and deterministic.
+        self._buf: list[str] = []
+        self._closed = False
+
+    def _truncate_to_clean_tail(self) -> None:
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        cut = raw.rfind(b"\n")
+        keep = raw[: cut + 1] if cut >= 0 else b""
+        with open(self.path, "wb") as f:
+            f.write(keep)
+            f.flush()
+            os.fsync(f.fileno())
+
+    # --- writing -----------------------------------------------------------
+    def append(self, kind: str, data: Any, flush: bool = False) -> int:
+        """Append one record; returns its sequence number.  ``flush=True``
+        forces write-through + fsync before returning — the WAL property
+        for records that must survive a crash occurring immediately after
+        (order intents)."""
+        self.seq += 1
+        rec = {"seq": self.seq, "t": self.now_fn(), "kind": kind,
+               "data": data, "crc": _crc(self.seq, kind, data)}
+        self._buf.append(json.dumps(rec, default=str) + "\n")
+        if flush or len(self._buf) >= self.fsync_every:
+            self.flush()
+        return self.seq
+
+    def flush(self) -> None:
+        if self._closed:
+            return
+        if self._buf:
+            self._f.write("".join(self._buf))
+            self._buf.clear()
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if not self._closed:
+            self.flush()
+            self._f.close()
+            self._closed = True
+
+    # --- snapshot + compaction --------------------------------------------
+    def compact(self, snapshot: Any) -> None:
+        """Atomically replace the journal with one ``snapshot`` record
+        (sequence numbering continues, so later records still order after
+        it).  Called after recovery and periodically by the executor so
+        replay cost stays bounded by live state size, not history."""
+        self.flush()
+        self.initial_records = None        # stale once history is rewritten
+        self.seq += 1
+        rec = {"seq": self.seq, "t": self.now_fn(), "kind": "snapshot",
+               "data": snapshot, "crc": _crc(self.seq, "snapshot", snapshot)}
+        tmp = self.path + ".compact"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    # --- test/chaos seam ---------------------------------------------------
+    def simulate_crash(self, torn_tail_bytes: int = 0) -> None:
+        """Die without flushing: buffered records are lost (what the OS
+        sees when the process is killed between fsync batches).  With
+        ``torn_tail_bytes`` > 0, additionally write that many bytes of the
+        FIRST buffered record before dying — the torn-tail signature of a
+        crash mid-``write(2)`` that replay must tolerate."""
+        if torn_tail_bytes > 0 and self._buf:
+            self._f.write(self._buf[0][:torn_tail_bytes])
+            self._f.flush()
+        self._buf.clear()
+        self._f.close()
+        self._closed = True

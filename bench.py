@@ -379,6 +379,73 @@ def bench_mc():
     emit("mc_paths_10k_ms", ms, "ms", round(ref_ms / ms, 1))
 
 
+def bench_recovery():
+    """Target row: crash-recovery time — write-ahead-journal replay + full
+    exchange reconcile with 1k journaled trades behind it (the restart
+    cost a production deployment pays before the first post-crash tick;
+    utils/journal.py + shell/executor.py recover_from_journal)."""
+    import asyncio
+    import tempfile
+
+    from ai_crypto_trader_tpu.config import TradingParams
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.bus import EventBus
+    from ai_crypto_trader_tpu.shell.executor import TradeExecutor
+    from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+    from ai_crypto_trader_tpu.utils.journal import WriteAheadJournal
+
+    n_trades = int(os.environ.get("BENCH_RECOVERY_TRADES", "1000"))
+    clock = {"t": 0.0}
+    series = from_dict(generate_ohlcv(n=2 * n_trades + 200, seed=11),
+                       symbol="BTCUSDC")
+    ex = FakeExchange({"BTCUSDC": series}, quote_balance=1e9, fee_rate=0.0)
+    ex.advance(steps=64)
+    trading = TradingParams(ai_confidence_threshold=0.0,
+                            min_signal_strength=0.0, min_trade_amount=1.0)
+
+    def executor(journal):
+        return TradeExecutor(EventBus(now_fn=lambda: clock["t"]), ex,
+                             trading=trading, now_fn=lambda: clock["t"],
+                             journal=journal)
+
+    with tempfile.TemporaryDirectory() as td:
+        jpath = os.path.join(td, "trades.journal")
+        writer = executor(WriteAheadJournal(jpath))
+        writer.COMPACT_EVERY = 10 ** 9     # keep ALL records: the row
+        #                                    measures replay at full depth
+
+        async def drive():
+            for _ in range(n_trades):
+                price = ex.get_ticker("BTCUSDC")["price"]
+                trade = await writer.handle_signal({
+                    "symbol": "BTCUSDC", "signal": "BUY", "decision": "BUY",
+                    "confidence": 1.0, "signal_strength": 100.0,
+                    "current_price": price, "volatility": 0.015,
+                    "avg_volume": 60_000.0})
+                ex.advance()
+                clock["t"] += 60.0
+                if trade is not None:
+                    await writer.close_trade(
+                        "BTCUSDC", ex.get_ticker("BTCUSDC")["price"], "Bench")
+
+        asyncio.run(drive())
+        writer.journal.flush()
+        n_records = writer.journal.seq
+
+        t0 = time.perf_counter()
+        fresh = executor(None)             # cold books, same venue
+        journal = WriteAheadJournal(jpath)
+        fresh.journal = journal
+        report = asyncio.run(fresh.recover_from_journal(journal))
+        ms = (time.perf_counter() - t0) * 1e3
+    log(f"recovery: {report['replayed_records']} records / "
+        f"{len(fresh.closed_trades)} closed trades replayed + reconciled "
+        f"in {ms:.1f} ms")
+    emit("recovery_ms", ms, "ms", None, trades=n_trades,
+         journal_records=n_records)
+
+
 def bench_nn():
     """BASELINE row: NN train step time (batch 32 × seq 60, LSTM-64).
 
@@ -794,6 +861,7 @@ def run_worker():
         ("rl", lambda: bench_rl(ind)),
         ("mc", bench_mc),
         ("nn", bench_nn),
+        ("recovery", bench_recovery),
     ]
     for name, fn in secondary:
         if not budget_left(reserve=90):

@@ -1,0 +1,259 @@
+"""Kill-and-restart chaos soak (testing/chaos.py): the full trading
+system driven through a seeded fault schedule — injected exchange errors,
+latency spikes, stale/partial/malformed klines, crash-points mid-order,
+bus drop/duplicate/delay — with hard process kills and journal-based
+recovery in the middle.  Asserts the crash-safety invariants against
+FakeExchange ground truth:
+
+  * no duplicate entry order (each entry client id fills at most once),
+  * no orphaned protective order (every resting venue order in our
+    namespace belongs to a live position, every live position protected),
+  * ledger conserved (venue balances re-derive exactly from the fill log;
+    closed trades durable across restarts; open books backed by inventory),
+  * the system ends healthy (no quarantined stage, fresh heartbeats,
+    no unresolved intents).
+
+The tier-1 smoke variant runs a budgeted schedule; the full soak is
+`slow` (pytest -m slow tests/test_chaos.py).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.config import TradingParams
+from ai_crypto_trader_tpu.data.ingest import from_dict
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.shell.exchange import FakeExchange, ResilientExchange
+from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+from ai_crypto_trader_tpu.testing.chaos import (
+    ChaosExchange,
+    FaultSchedule,
+    SimulatedCrash,
+    inject_bus_faults,
+    torn_tail,
+)
+
+QUOTE0 = 100_000.0
+
+
+def _series(symbols, n, seed=21):
+    return {s: from_dict({k: v for k, v in
+                          generate_ohlcv(n=n, seed=seed + i).items()
+                          if k != "regime"}, symbol=s)
+            for i, s in enumerate(symbols)}
+
+
+class SoakRig:
+    """One venue + one fault schedule surviving any number of 'processes'."""
+
+    def __init__(self, tmp_path, symbols, ticks, rates, seed, fused):
+        self.symbols = list(symbols)
+        self.clock = {"t": 0.0}
+        self.inner = FakeExchange(_series(self.symbols, ticks + 720),
+                                  quote_balance=QUOTE0, fee_rate=0.0)
+        self.inner.advance(steps=600)
+        self.schedule = FaultSchedule(seed=seed, rates=rates)
+        self.chaos = ChaosExchange(self.inner, self.schedule,
+                                   sleep=self._sleep, latency_s=2.0)
+        self.journal_path = str(tmp_path / "chaos.journal")
+        self.fused = fused
+        self.closed_durable: set = set()   # closures that must survive kills
+        self.restarts = 0
+        self.system = self._build()
+
+    def _sleep(self, s):
+        self.clock["t"] += s
+
+    def _now(self):
+        return self.clock["t"]
+
+    def _build(self) -> TradingSystem:
+        ex = ResilientExchange(self.chaos, now_fn=self._now,
+                               sleep=self._sleep, max_read_retries=1,
+                               failure_threshold=3, reset_timeout_s=120.0,
+                               max_block_s=30.0)
+        system = TradingSystem(ex, self.symbols, now_fn=self._now,
+                               journal_path=self.journal_path,
+                               stage_backoff_s=0.0, stage_quarantine_s=300.0)
+        system.monitor.fused = self.fused
+        system.executor.trading = TradingParams(
+            ai_confidence_threshold=0.0, min_signal_strength=0.0,
+            min_trade_amount=1.0, max_positions=len(self.symbols))
+        inject_bus_faults(system.bus, self.schedule)
+        return system
+
+    def kill(self):
+        """SIGKILL semantics: the unflushed journal tail is lost, the
+        process state is abandoned; the venue (and its resting orders)
+        survives untouched."""
+        self.closed_durable |= {
+            (r["symbol"], r["opened_at"]) for r in
+            self.system.executor.closed_trades}   # flushed ⇒ must survive
+        self.system.journal.simulate_crash()
+        self.restarts += 1
+
+    async def restart_and_recover(self) -> dict:
+        """Operator restart loop: chaos may fault DURING recovery too —
+        keep rebuilding until a recovery pass completes."""
+        from ai_crypto_trader_tpu.shell.exchange import ExchangeUnavailable
+
+        for _ in range(30):
+            self.system = self._build()
+            try:
+                return await self.system.recover()
+            except (ExchangeUnavailable, SimulatedCrash):
+                self.system.journal.simulate_crash()
+                self.clock["t"] += 150.0       # let the breaker close
+        raise AssertionError("recovery never completed under chaos")
+
+    async def run(self, ticks, kill_at=()):
+        for i in range(ticks):
+            self.inner.advance()
+            self.clock["t"] += 60.0
+            if i in kill_at:
+                self.kill()
+                await self.restart_and_recover()
+            try:
+                await self.system.tick()
+            except SimulatedCrash:
+                # died mid-order inside a tick: the AMBIGUOUS window
+                self.kill()
+                await self.restart_and_recover()
+
+    async def drain(self, ticks=8):
+        """Fault-free cool-down: past quarantine/breaker windows, so the
+        end-state assertion is about RECOVERY, not an in-flight fault."""
+        self.schedule.rates = {}
+        self.clock["t"] += 310.0               # past stage quarantine
+        last = None
+        for _ in range(ticks):
+            self.inner.advance()
+            self.clock["t"] += 60.0
+            last = await self.system.tick()
+        return last
+
+
+def check_invariants(rig: SoakRig, final_tick: dict):
+    inner, system = rig.inner, rig.system
+    executor = system.executor
+
+    # -- no duplicate entry orders: each entry client id fills once --------
+    ent_fills = [f for f in inner.fills
+                 if (f.get("client_order_id") or "").startswith("wj-ent-")]
+    coids = [f["client_order_id"] for f in ent_fills]
+    assert len(coids) == len(set(coids)), "duplicate entry fill"
+    # every executor BUY went through the client-id namespace (no
+    # un-reconcilable anonymous entries)
+    assert all(f.get("client_order_id")
+               for f in inner.fills if f["side"] == "BUY")
+
+    # -- ledger conserved: venue balances re-derive from the fill log ------
+    derived = {"USDC": QUOTE0}
+    for f in inner.fills:
+        base = f["symbol"][:-4]
+        cost = f["quantity"] * f["price"]
+        if f["side"] == "BUY":
+            derived["USDC"] = derived.get("USDC", 0.0) - cost
+            derived[base] = derived.get(base, 0.0) + f["quantity"]
+        else:
+            derived["USDC"] = derived.get("USDC", 0.0) + cost
+            derived[base] = derived.get(base, 0.0) - f["quantity"]
+    for asset, v in inner.get_balances().items():
+        np.testing.assert_allclose(v, derived.get(asset, 0.0),
+                                   rtol=1e-9, atol=1e-5)
+    assert all(v >= -1e-6 for v in inner.get_balances().values())
+
+    # -- closures flushed before a kill survived every restart -------------
+    closed_now = {(r["symbol"], r["opened_at"])
+                  for r in executor.closed_trades}
+    assert rig.closed_durable <= closed_now, "closed-trade ledger lost rows"
+
+    # -- books backed by real inventory ------------------------------------
+    for sym, t in executor.active_trades.items():
+        assert inner.get_balances().get(sym[:-4], 0.0) >= t.quantity - 1e-9
+
+    # -- no orphaned protective orders -------------------------------------
+    referenced = {oid for t in executor.active_trades.values()
+                  for oid in (t.stop_order_id, t.tp_order_id)
+                  if oid is not None}
+    for o in inner.list_open_orders():
+        coid = o.get("client_order_id") or ""
+        if coid.startswith("wj-"):
+            assert o["order_id"] in referenced, f"orphaned protection: {o}"
+    #    ... and every live position is fully protected
+    for sym, t in executor.active_trades.items():
+        assert t.stop_order_id is not None and t.tp_order_id is not None
+        assert inner.order_is_open(sym, t.stop_order_id)
+        assert inner.order_is_open(sym, t.tp_order_id)
+
+    # -- system ends healthy ------------------------------------------------
+    assert "skipped" not in final_tick
+    assert not any(b.quarantined for b in system.stage_breakers.values())
+    for stage in ("monitor", "analyzer", "executor"):
+        assert rig.clock["t"] - system.heartbeats.beats[stage] <= 60.0
+    assert executor.pending_intents == {}
+    assert rig.restarts >= 2, "the soak must actually kill and restart"
+
+
+SMOKE_RATES = {"error": 0.04, "latency": 0.02, "stale": 0.02,
+               "partial": 0.01, "malformed": 0.01,
+               "crash_after_order": 0.01, "bus_drop": 0.01,
+               "bus_dup": 0.01, "bus_delay": 0.01}
+
+
+def test_chaos_smoke_kill_restart(tmp_path):
+    """Tier-1 budget variant: one symbol, per-symbol monitor path, ~100
+    ticks, two scripted kills (+ any schedule-driven mid-order crashes)."""
+    rig = SoakRig(tmp_path, ["BTCUSDC"], ticks=100, rates=SMOKE_RATES,
+                  seed=7, fused=False)
+
+    async def go():
+        await rig.run(100, kill_at={33, 66})
+        return await rig.drain()
+
+    final = asyncio.run(go())
+    check_invariants(rig, final)
+    # the schedule actually injected faults of several kinds
+    kinds = {f for _, _, f in rig.schedule.injected}
+    assert len(kinds) >= 3, kinds
+
+
+def test_chaos_torn_journal_still_recovers(tmp_path):
+    """A kill that tears the journal mid-record must still recover to a
+    consistent book."""
+    rig = SoakRig(tmp_path, ["BTCUSDC"], ticks=60, rates=SMOKE_RATES,
+                  seed=11, fused=False)
+
+    async def go():
+        await rig.run(30)
+        rig.kill()
+        torn_tail(rig.journal_path)            # crash mid-write(2)
+        await rig.restart_and_recover()
+        rig.restarts += 1                      # count the torn restart too
+        await rig.run(20)
+        return await rig.drain()
+
+    final = asyncio.run(go())
+    check_invariants(rig, final)
+
+
+@pytest.mark.slow
+def test_chaos_soak_full(tmp_path):
+    """The full soak: two symbols, the fused monitor path, 600 ticks,
+    three scripted kills plus schedule-driven mid-order crashes."""
+    rig = SoakRig(tmp_path, ["BTCUSDC", "ETHUSDC"], ticks=600,
+                  rates=SMOKE_RATES | {"crash_after_order": 0.02},
+                  seed=3, fused=True)
+
+    async def go():
+        await rig.run(600, kill_at={150, 300, 450})
+        return await rig.drain()
+
+    final = asyncio.run(go())
+    check_invariants(rig, final)
+    # the soak must have actually traded through the chaos
+    assert rig.inner.fills, "no trades executed — the soak proved nothing"
+    kinds = {f for _, _, f in rig.schedule.injected}
+    assert {"error", "crash_after_order"} <= kinds

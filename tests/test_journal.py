@@ -1,0 +1,142 @@
+"""Write-ahead journal (utils/journal.py): checksummed append-only
+records, fsync batching, torn-tail-tolerant replay, snapshot+compaction —
+the durable seam the reference got from Redis (SURVEY §L1, §5.3)."""
+
+import json
+import os
+
+from ai_crypto_trader_tpu.utils.journal import WriteAheadJournal, replay
+
+
+def _path(tmp_path):
+    return str(tmp_path / "trades.journal")
+
+
+class TestAppendReplay:
+    def test_roundtrip_ordered_and_checksummed(self, tmp_path):
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p, fsync_every=2)
+        for i in range(5):
+            j.append("tick", {"i": i})
+        j.close()
+        records, stats = replay(p)
+        assert [r["data"]["i"] for r in records] == list(range(5))
+        assert [r["seq"] for r in records] == [1, 2, 3, 4, 5]
+        assert stats == {"total_lines": 5, "replayed": 5,
+                         "corrupt_records": 0, "torn_tail": False}
+
+    def test_missing_file_is_a_fresh_start(self, tmp_path):
+        records, stats = replay(_path(tmp_path))
+        assert records == [] and stats["replayed"] == 0
+
+    def test_seq_continues_across_reopen(self, tmp_path):
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p)
+        j.append("a", {})
+        j.close()
+        j2 = WriteAheadJournal(p)
+        assert j2.append("b", {}) == 2
+        j2.close()
+
+    def test_flush_true_is_durable_before_return(self, tmp_path):
+        """The WAL property: a flush=True record survives a crash that
+        loses every batched record after it."""
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p, fsync_every=100)
+        j.append("intent", {"coid": "x"}, flush=True)
+        j.append("lazy", {"n": 1})
+        j.append("lazy", {"n": 2})
+        j.simulate_crash()                        # batched tail lost
+        records, stats = replay(p)
+        assert [r["kind"] for r in records] == ["intent"]
+        assert not stats["torn_tail"]
+
+
+class TestCorruption:
+    def test_torn_tail_dropped_silently(self, tmp_path):
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p, fsync_every=100)
+        j.append("keep", {"i": 0}, flush=True)
+        j.append("torn", {"i": 1})
+        j.simulate_crash(torn_tail_bytes=12)      # died mid-write(2)
+        records, stats = replay(p)
+        assert [r["kind"] for r in records] == ["keep"]
+        assert stats["torn_tail"] is True
+        assert stats["corrupt_records"] == 0
+
+    def test_reopen_after_torn_tail_truncates_then_appends_cleanly(
+            self, tmp_path):
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p, fsync_every=100)
+        j.append("keep", {}, flush=True)
+        j.append("torn", {})
+        j.simulate_crash(torn_tail_bytes=9)
+        j2 = WriteAheadJournal(p)                 # restart over torn file
+        assert j2.replay_stats["torn_tail"] is True
+        j2.append("after", {}, flush=True)
+        j2.close()
+        records, stats = replay(p)
+        assert [r["kind"] for r in records] == ["keep", "after"]
+        assert stats["corrupt_records"] == 0 and not stats["torn_tail"]
+
+    def test_bitrot_mid_file_skipped_and_counted(self, tmp_path):
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p)
+        for i in range(4):
+            j.append("r", {"i": i})
+        j.close()
+        lines = open(p, "rb").read().splitlines(keepends=True)
+        lines[1] = lines[1].replace(b'"i": 1', b'"i": 9')   # flipped bits
+        with open(p, "wb") as f:
+            f.writelines(lines)
+        records, stats = replay(p)
+        assert [r["data"]["i"] for r in records] == [0, 2, 3]
+        assert stats["corrupt_records"] == 1
+        assert not stats["torn_tail"]
+
+    def test_garbage_line_mid_file_skipped(self, tmp_path):
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p)
+        j.append("a", {})
+        j.append("b", {})
+        j.close()
+        raw = open(p, "rb").read().splitlines(keepends=True)
+        with open(p, "wb") as f:
+            f.write(raw[0] + b"not json at all\n" + raw[1])
+        records, stats = replay(p)
+        assert [r["kind"] for r in records] == ["a", "b"]
+        assert stats["corrupt_records"] == 1
+
+
+class TestCompaction:
+    def test_compact_replaces_history_with_snapshot(self, tmp_path):
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p)
+        for i in range(50):
+            j.append("r", {"i": i})
+        j.compact({"open": {"BTCUSDC": 1.5}})
+        j.append("post", {"i": 99}, flush=True)
+        j.close()
+        records, stats = replay(p)
+        assert [r["kind"] for r in records] == ["snapshot", "post"]
+        assert records[0]["data"] == {"open": {"BTCUSDC": 1.5}}
+        assert records[1]["seq"] > records[0]["seq"]   # ordering preserved
+        assert stats["replayed"] == 2
+
+    def test_compact_is_atomic_no_tmp_left_behind(self, tmp_path):
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p)
+        j.append("r", {})
+        j.compact({"s": 1})
+        j.close()
+        assert not os.path.exists(p + ".compact")
+
+    def test_records_json_parseable_lines(self, tmp_path):
+        p = _path(tmp_path)
+        j = WriteAheadJournal(p)
+        j.append("k", {"x": [1, 2]}, flush=True)
+        j.close()
+        with open(p) as f:
+            for line in f:
+                rec = json.loads(line)
+                assert {"seq", "t", "kind", "data", "crc"} <= set(rec)

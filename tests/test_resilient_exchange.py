@@ -227,11 +227,12 @@ def test_filled_buy_with_dead_protection_stays_managed():
     outage = {"on": False}
     real_place = inner.place_order
 
-    def place(symbol, side, order_type, quantity, price=None, stop_price=None):
+    def place(symbol, side, order_type, quantity, price=None, stop_price=None,
+              **kw):
         if outage["on"] and order_type != "MARKET":
             raise ConnectionError("down")
         return real_place(symbol, side, order_type, quantity, price,
-                          stop_price)
+                          stop_price, **kw)
 
     inner.place_order = place
     clock = VirtualClock()
