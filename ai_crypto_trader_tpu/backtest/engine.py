@@ -47,7 +47,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ai_crypto_trader_tpu.backtest import signals as sig
 from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
-from ai_crypto_trader_tpu.utils import tracing
+from ai_crypto_trader_tpu.utils import devprof, tracing
 
 
 def _traced_entry(name: str, close, attrs_fn, call):
@@ -363,7 +363,16 @@ def _sweep_jit(inputs: BacktestInputs, params: StrategyParams,
 
 def sweep(inputs: BacktestInputs, params: StrategyParams, *args, **kw):
     """Host entry for `_sweep_jit` (same signature), with a
-    `backtest.sweep` span + compile/execute attribution when traced."""
+    `backtest.sweep` span + compile/execute attribution when traced and a
+    one-shot ``backtest_sweep`` devprof cost card (FLOPs/bytes only: the
+    sweep program is the largest in the repo, so the card skips the AOT
+    backend re-compile that memory_analysis would cost — see
+    utils/devprof.py)."""
+    if (devprof.active() is not None
+            and not isinstance(inputs.close, jax.core.Tracer)
+            and not devprof.has_card("backtest_sweep")):
+        devprof.cost_card("backtest_sweep", _sweep_jit, inputs, params,
+                          *args, _memory_analysis=False, **kw)
     return _traced_entry(
         "backtest.sweep", inputs.close,
         lambda: {"candles": int(inputs.close.shape[-1]),

@@ -12,6 +12,7 @@ compute commands this framework adds:
     python -m ai_crypto_trader_tpu.cli evolve    --generations 5
     python -m ai_crypto_trader_tpu.cli mc        --paths 10000 --days 30
     python -m ai_crypto_trader_tpu.cli trade     --paper --ticks 100
+    python -m ai_crypto_trader_tpu.cli profile   --ticks 10 --out profiles/x
     python -m ai_crypto_trader_tpu.cli dashboard --out dashboard.html
 
 With no network, `fetch` generates the deterministic synthetic series into
@@ -294,7 +295,8 @@ def cmd_trade(args):
                            log_path=os.environ.get("LOG_PATH"),
                            enable_tracing=bool(args.trace_jsonl),
                            trace_jsonl=args.trace_jsonl,
-                           journal_path=args.journal)
+                           journal_path=args.journal,
+                           enable_devprof=args.devprof)
     if args.full_stack:
         from ai_crypto_trader_tpu.shell.stack import build_full_stack
         from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
@@ -351,6 +353,47 @@ def cmd_trade(args):
         if server is not None:
             server.stop()
         system.shutdown()          # deactivate tracer + close span JSONL
+
+
+def cmd_profile(args):
+    """On-demand device profiler capture (the CLI twin of the dashboard's
+    `/profile?seconds=N`): run a short paper-trading burst with the
+    devprof observatory on, wrap it in `utils/profiling.trace`, and dump
+    a TensorBoard-loadable XPlane trace plus the cost cards / SLO
+    summaries the run produced.  Load the artifact with
+    `tensorboard --logdir <out>` (Profile plugin)."""
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.exchange import make_exchange
+    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+    from ai_crypto_trader_tpu.utils import profiling
+
+    d = generate_ohlcv(n=args.ticks + 600, seed=args.seed)
+    series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                       symbol=args.symbol)
+    clock = {"t": 0.0}
+    ex = make_exchange("fake", series={args.symbol: series},
+                       quote_balance=10_000.0)
+    ex.advance(args.symbol, steps=600)
+    system = TradingSystem(ex, [args.symbol], now_fn=lambda: clock["t"],
+                           enable_devprof=True)
+    out_dir = args.out or time.strftime("profiles/xplane_%Y%m%d_%H%M%S")
+    os.makedirs(out_dir, exist_ok=True)
+
+    async def go():
+        for _ in range(args.ticks):
+            ex.advance(args.symbol)
+            clock["t"] += 60.0
+            await system.tick()
+
+    try:
+        with profiling.trace(out_dir):
+            asyncio.run(go())
+        print(json.dumps({"artifact": out_dir, "ticks": args.ticks,
+                          "devprof": system.devprof.status()}, indent=2,
+                         default=str))
+    finally:
+        system.shutdown()
 
 
 def cmd_scan(args):
@@ -491,7 +534,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "the exchange before trading (utils/journal.py)")
     sp.add_argument("--serve-hold-s", type=float, default=0.0,
                     help="keep serving this many seconds after the ticks")
+    sp.add_argument("--devprof", action="store_true",
+                    help="device-runtime observatory (utils/devprof.py): "
+                         "program cost cards + donation verification, "
+                         "live-memory watermarks, latency SLO gauges")
     sp.set_defaults(fn=cmd_trade)
+    sp = sub.add_parser("profile",
+                        help="capture a TensorBoard XPlane device profile "
+                             "of a short paper-trading burst")
+    common(sp)
+    sp.add_argument("--ticks", type=int, default=10)
+    sp.add_argument("--out", default=None,
+                    help="artifact directory (default profiles/xplane_<ts>)")
+    sp.set_defaults(fn=cmd_profile)
     sp = sub.add_parser("scan", help="discover + rank tradable pairs")
     sp.add_argument("--pairs", type=int, default=64,
                     help="synthetic universe size (paper mode)")
@@ -512,7 +567,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _JAX_COMMANDS = {"backtest", "train", "evolve", "mc", "trade", "dashboard",
-                 "scan"}
+                 "scan", "profile"}
 
 
 def main(argv=None):

@@ -34,7 +34,7 @@ import optax
 from ai_crypto_trader_tpu.models import train_loop
 from ai_crypto_trader_tpu.models.train_loop import EpochTrainer, snapshot_params
 from ai_crypto_trader_tpu.models.zoo import build_model
-from ai_crypto_trader_tpu.utils import tracing
+from ai_crypto_trader_tpu.utils import devprof, tracing
 
 MULTITASK_WEIGHTS = (1.0, 0.7, 0.5)
 
@@ -186,7 +186,8 @@ def train_model(
 
     if compiled_epoch:
         trainer = EpochTrainer(train_loss, tx, eval_loss_fn=eval_loss,
-                               precision=precision)
+                               precision=precision,
+                               card=f"train_epoch.{model_type}")
         # One host→device transfer for the whole dataset, up front.
         X_tr_d, y_tr_d = jnp.asarray(X_tr), jnp.asarray(y_tr)
         run_epoch = lambda params, opt_state, k_shuf, k_ep: trainer.epoch(
@@ -340,8 +341,12 @@ def predict_prices_batched(results: Sequence[TrainResult], features_list,
                           *[r.params for r in results])
     smin = jnp.stack([r.scaler.min for r in results])
     smax = jnp.stack([r.scaler.max for r in results])
-    out = _batched_predict_fn(r0.model_type, kwargs_key)(
-        params, smin, smax, windows)
+    fn = _batched_predict_fn(r0.model_type, kwargs_key)
+    # one-shot devprof cost card per architecture (lane count varies per
+    # call; the first-seen shape is the card — utils/devprof.py)
+    devprof.cost_card(f"predict_batched.{r0.model_type}", fn,
+                      params, smin, smax, windows)
+    out = fn(params, smin, smax, windows)
     out, mins, maxs = jax.device_get((out, smin, smax))   # one pull, all lanes
     preds = []
     for lane, r in enumerate(results):

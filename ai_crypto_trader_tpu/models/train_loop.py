@@ -32,12 +32,15 @@ inputs) for best-params bookkeeping.
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+from ai_crypto_trader_tpu.utils import devprof
 
 _PRECISIONS = {
     # None = backend default (f32 on CPU; the MXU's default mode on TPU).
@@ -71,8 +74,14 @@ def host_read(x) -> np.ndarray:
     """THE per-epoch host sync: device metrics → numpy.
 
     Kept as a module-level seam so tests can wrap it with a counting
-    double and assert the loop performs exactly one sync per epoch."""
-    return np.asarray(x)
+    double and assert the loop performs exactly one sync per epoch.
+    Timed into the ``host_read`` SLO window (utils/devprof.py): this
+    readback blocks on the whole epoch program, so its latency IS the
+    device-side epoch time as seen from the host."""
+    t0 = time.perf_counter()
+    out = np.asarray(x)
+    devprof.observe_latency("host_read", time.perf_counter() - t0)
+    return out
 
 
 def snapshot_params(tree):
@@ -96,11 +105,17 @@ class EpochTrainer:
 
     def __init__(self, train_loss_fn: Callable, tx, *,
                  eval_loss_fn: Callable | None = None,
-                 precision: str | None = None):
+                 precision: str | None = None,
+                 card: str = "train_epoch"):
         self.train_loss_fn = train_loss_fn
         self.eval_loss_fn = eval_loss_fn
         self.tx = tx
         self.precision = canonical_precision(precision)
+        # devprof cost-card name: cards are one-shot PER NAME, and every
+        # architecture compiles a distinct epoch program — callers that
+        # train multiple architectures pass e.g. "train_epoch.lstm" so a
+        # later architecture's silent donation copy is still caught
+        self.card = card
         self._with_val = eval_loss_fn is not None
 
         def body(carry, inp, k_drop):
@@ -146,10 +161,30 @@ class EpochTrainer:
 
     def epoch(self, params, opt_state, X, y, k_perm, k_drop,
               X_val=None, y_val=None, *, batch_size: int):
-        """One compiled epoch.  DONATES params/opt_state (see module doc)."""
+        """One compiled epoch.  DONATES params/opt_state (see module doc).
+
+        With the devprof observatory active, the first epoch publishes a
+        ``self.card`` cost card (default ``train_epoch``), verifies the params/opt_state donation
+        actually freed the old buffers, and every epoch feeds the
+        ``train_step`` SLO window (dispatch wall amortized per batch)."""
+        args = (params, opt_state, X, y, k_perm, k_drop)
+        if self._with_val:
+            args = args + (X_val, y_val)
+        dp = devprof.active()
+        carding = dp is not None and not devprof.has_card(self.card)
+        donated = jax.tree.leaves((params, opt_state)) if carding else None
         with matmul_precision(self.precision):
-            if self._with_val:
-                return self._epoch(params, opt_state, X, y, k_perm, k_drop,
-                                   X_val, y_val, batch_size=batch_size)
-            return self._epoch(params, opt_state, X, y, k_perm, k_drop,
-                               batch_size=batch_size)
+            if carding:        # lower under the same precision as the run
+                devprof.cost_card(self.card, self._epoch, *args,
+                                  batch_size=batch_size)
+            # t0 AFTER carding: the card's duplicate AOT lowering/compile
+            # must not pollute the train_step SLO window
+            t0 = time.perf_counter()
+            out = self._epoch(*args, batch_size=batch_size)
+        if dp is not None:
+            nb = max(X.shape[0] // min(batch_size, X.shape[0]), 1)
+            dp.observe_latency("train_step",
+                               (time.perf_counter() - t0) / nb)
+            if donated is not None:
+                devprof.verify_donation(self.card, donated)
+        return out

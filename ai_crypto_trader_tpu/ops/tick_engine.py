@@ -37,12 +37,14 @@ program; dead lanes are masked invalid and cost only device FLOPs.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.utils import devprof
 from ai_crypto_trader_tpu.backtest import compute_signal_features, reference_signal
 from ai_crypto_trader_tpu.ops.combinations import (
     combination_signal,
@@ -55,8 +57,13 @@ def host_read(tree):
     """THE per-poll device→host sync: output pytree → numpy pytree.
 
     Module-level seam (like models/train_loop.host_read) so tests can wrap
-    it with a counting double and assert one sync per poll."""
-    return jax.device_get(tree)
+    it with a counting double and assert one sync per poll.  The transfer
+    is timed into the ``host_read`` SLO window (utils/devprof.py) — sync
+    time is where a device-queue stall first becomes visible."""
+    t0 = time.perf_counter()
+    out = jax.device_get(tree)
+    devprof.observe_latency("host_read", time.perf_counter() - t0)
+    return out
 
 
 def _pad_symbols(n: int) -> int:
@@ -258,8 +265,18 @@ class TickEngine:
             upload_bytes += (rows.nbytes + s_ix.nbytes + f_ix.nbytes
                              + pos.nbytes)
         valid = self._count >= T
+        # one-shot cost card + donation verification on the first carded
+        # dispatch (utils/devprof.py; disabled = one attribute read)
+        carding = (devprof.active() is not None
+                   and not devprof.has_card("tick_engine"))
+        if carding:
+            devprof.cost_card("tick_engine", _tick_program, self._ring,
+                              self._base, rows, s_ix, f_ix, pos, valid)
+        donated_ring = self._ring if carding else None
         self._ring, out = _tick_program(self._ring, self._base, rows, s_ix,
                                         f_ix, pos, valid)
+        if donated_ring is not None:
+            devprof.verify_donation("tick_engine", donated_ring)
         self.dispatch_count += 1
         self._need_seed = False
         self.last_valid = valid

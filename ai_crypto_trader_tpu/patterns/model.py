@@ -175,7 +175,8 @@ def train_pattern_model(key, model_type: str = "cnn", *, n_per_class: int = 64,
         logits = model.apply(p, xb, True, rngs={"dropout": rng})
         return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
 
-    trainer = EpochTrainer(loss_fn, tx, precision=precision)
+    trainer = EpochTrainer(loss_fn, tx, precision=precision,
+                           card="train_epoch.pattern_cnn")
     rec = PatternRecognizer(model_type=model_type)
     for epoch in range(epochs):
         key, k_perm, k_ep = jax.random.split(key, 3)

@@ -22,6 +22,7 @@ TPU-first differences:
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple
 
 import flax.linen as nn
@@ -31,6 +32,7 @@ import numpy as np
 import optax
 
 from ai_crypto_trader_tpu.rl.env import EnvParams, EnvState, OBS_SIZE, env_reset, env_step
+from ai_crypto_trader_tpu.utils import devprof
 
 
 class QNetwork(nn.Module):
@@ -223,15 +225,41 @@ def train_iteration(env_params: EnvParams, state: DQNState, cfg: DQNConfig):
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_iters"),
                    donate_argnums=(1,))
+def _train_iterations_jit(env_params: EnvParams, state: DQNState,
+                          cfg: DQNConfig, n_iters: int = 1):
+    return jax.lax.scan(lambda st, _: _iteration(env_params, st, cfg),
+                        state, None, length=n_iters)
+
+
 def train_iterations(env_params: EnvParams, state: DQNState, cfg: DQNConfig,
                      n_iters: int = 1):
     """K iterations as ONE compiled `lax.scan` with the DQNState donated:
     params, replay ring, env states and opt state update in place, and the
     host reads metrics back once per K iterations instead of once per
     iteration — metrics readback no longer serializes the device queue.
-    Returns (state, metrics) with each metric stacked to [n_iters]."""
-    return jax.lax.scan(lambda st, _: _iteration(env_params, st, cfg),
-                        state, None, length=n_iters)
+    Returns (state, metrics) with each metric stacked to [n_iters].
+
+    Host entry around the jitted scan: with the devprof observatory
+    active (utils/devprof.py) the first call publishes a
+    ``dqn_train_iterations`` cost card, verifies the DQNState donation
+    actually freed the old buffers (replay ring + params — the largest
+    donated tree in the repo), and every call feeds the ``train_step``
+    SLO window (dispatch wall amortized per iteration)."""
+    dp = devprof.active()
+    if dp is None:
+        return _train_iterations_jit(env_params, state, cfg, n_iters=n_iters)
+    carding = not devprof.has_card("dqn_train_iterations")
+    if carding:
+        devprof.cost_card("dqn_train_iterations", _train_iterations_jit,
+                          env_params, state, cfg, n_iters=n_iters)
+    donated = jax.tree.leaves(state) if carding else None
+    t0 = time.perf_counter()
+    out = _train_iterations_jit(env_params, state, cfg, n_iters=n_iters)
+    dp.observe_latency("train_step",
+                       (time.perf_counter() - t0) / max(n_iters, 1))
+    if donated is not None:
+        devprof.verify_donation("dqn_train_iterations", donated)
+    return out
 
 
 def train_dqn(key, env_params: EnvParams, cfg: DQNConfig,

@@ -13,6 +13,11 @@ callbacks implement, without taking on the Dash dependency. Endpoints:
                `services/utils/metrics.py:189-221`)
   /health      heartbeat/liveness JSON (reference: per-service TCP health
                listeners, e.g. `services/monte_carlo_service.py:825-845`)
+  /profile     on-demand device profiler capture: ?seconds=N runs
+               `jax.profiler.trace` for N wall seconds WHILE the system
+               keeps ticking and returns the TensorBoard-loadable XPlane
+               artifact path (single-capture guard: a second concurrent
+               request gets 409)
 
 The server runs in a daemon thread; `port=0` binds an ephemeral port
 (tests). Reads of live bus dicts from the serving thread are safe under
@@ -23,18 +28,30 @@ render may see a mid-tick snapshot, never a torn value).
 from __future__ import annotations
 
 import json
+import math
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ai_crypto_trader_tpu.shell.dashboard import render_dashboard
+
+# /profile bounds: a zero-length capture produces an empty artifact, an
+# unbounded one wedges the handler thread (and the profiler) for hours
+MIN_PROFILE_S = 0.05
+MAX_PROFILE_S = 60.0
 
 
 class DashboardServer:
     """Serve a TradingSystem's live state over HTTP."""
 
-    def __init__(self, system, port: int = 8050, refresh_s: float = 5.0):
+    def __init__(self, system, port: int = 8050, refresh_s: float = 5.0,
+                 profile_dir: str = "profiles"):
         self.system = system
         self.refresh_s = refresh_s
+        self.profile_dir = profile_dir
+        self._profile_lock = threading.Lock()   # single-capture guard
+        self._profile_seq = 0                   # unique artifact names
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -87,6 +104,23 @@ class DashboardServer:
                         self._send(json.dumps(outer.traces(limit),
                                               default=str).encode(),
                                    "application/json")
+                    elif path == "/profile":
+                        try:
+                            seconds = float(q.get("seconds", ["1"])[0])
+                        except ValueError:
+                            seconds = 1.0
+                        if not math.isfinite(seconds):
+                            seconds = 1.0   # nan/inf survive min/max clamps
+                        seconds = min(max(seconds, MIN_PROFILE_S),
+                                      MAX_PROFILE_S)
+                        out = outer.profile(seconds)
+                        if out is None:
+                            self._send(json.dumps(
+                                {"error": "capture already in progress"}
+                            ).encode(), "application/json", 409)
+                        else:
+                            self._send(json.dumps(out).encode(),
+                                       "application/json")
                     elif path == "/metrics":
                         self._send(outer.system.metrics.exposition().encode(),
                                    "text/plain; version=0.0.4")
@@ -167,12 +201,42 @@ class DashboardServer:
         tracer = getattr(self.system, "tracer", None)
         return tracer.traces(limit=limit) if tracer is not None else []
 
+    def profile(self, seconds: float) -> dict | None:
+        """On-demand XPlane capture: `jax.profiler.trace` for ``seconds``
+        of wall clock while the system keeps ticking on its own loop.
+        Returns None when a capture is already running (the guard: jax
+        supports exactly one active profiler session per process)."""
+        if not self._profile_lock.acquire(blocking=False):
+            return None
+        try:
+            from ai_crypto_trader_tpu.utils import profiling
+
+            self._profile_seq += 1
+            artifact = os.path.join(
+                self.profile_dir,
+                time.strftime("xplane_%Y%m%d_%H%M%S")
+                + f"_{self._profile_seq:03d}")
+            os.makedirs(artifact, exist_ok=True)
+            t0 = time.perf_counter()
+            with profiling.trace(artifact):
+                time.sleep(seconds)
+            return {"artifact": artifact,
+                    "seconds": round(time.perf_counter() - t0, 3),
+                    "requested_s": seconds}
+        finally:
+            self._profile_lock.release()
+
     def state(self) -> dict:
         system = self.system
         bus_state = {k: system.bus.get(k) for k in system.bus.keys("*")
                      if isinstance(system.bus.get(k),
                                    (int, float, str, list, dict))}
-        return {"status": system.status_cached(), "bus": bus_state}
+        out = {"status": system.status_cached(), "bus": bus_state}
+        devprof = getattr(system, "devprof", None)
+        if devprof is not None:
+            # cost cards / SLO summaries / donation results / watermarks
+            out["devprof"] = devprof.status()
+        return out
 
     def health(self) -> dict:
         return {"healthy": all(self.system.heartbeats.health().values())

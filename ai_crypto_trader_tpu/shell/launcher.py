@@ -27,6 +27,7 @@ from ai_crypto_trader_tpu.shell.dashboard import write_dashboard
 from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
 from ai_crypto_trader_tpu.shell.executor import TradeExecutor
 from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
+from ai_crypto_trader_tpu.utils import devprof as devprof_mod
 from ai_crypto_trader_tpu.utils import tracing
 from ai_crypto_trader_tpu.utils.alerts import AlertManager
 from ai_crypto_trader_tpu.utils.health import HeartbeatRegistry
@@ -54,6 +55,13 @@ class TradingSystem:
     # enable_tracing).
     enable_tracing: bool = False
     trace_jsonl: str | None = None
+    # Device-runtime observatory (utils/devprof.py). Default OFF like
+    # tracing (the disabled hot path is one module-global check). When on:
+    # one-shot cost cards + donation verification for every compiled hot
+    # program, per-device live-memory watermarks sampled each tick, and
+    # p50/p99/burn-rate latency SLO gauges for tick / train_step /
+    # host_read.
+    enable_devprof: bool = False
     # Crash-safe trading state (utils/journal.py): when set, the executor
     # write-ahead-journals every order intent/ack/closure here, and
     # `recover()` replays + reconciles it after a restart.
@@ -100,6 +108,10 @@ class TradingSystem:
             # compile-vs-execute attribution for every traced JAX dispatch,
             # plus the jit_compile_seconds histogram
             tracing.JitCompileMonitor.install(metrics=self.metrics)
+        self.devprof = None
+        if self.enable_devprof:
+            self.devprof = devprof_mod.configure(
+                devprof_mod.DevProf(metrics=self.metrics))
         # bus telemetry: fanout latency + queue depth metrics, and slow-
         # subscriber warnings through the structured log (trace-correlated)
         self.bus = EventBus(now_fn=self.now_fn, metrics=self.metrics,
@@ -269,6 +281,9 @@ class TradingSystem:
             self.metrics.inc("signals_processed_total", executed)
             self.metrics.observe("tick_duration_seconds",
                                  time.perf_counter() - t0)
+            if self.devprof is not None:
+                self.devprof.observe_latency("tick",
+                                             time.perf_counter() - t0)
             self._emit_health_gauges()
             self.log.warning("exchange unavailable; tick skipped",
                              error=str(exc))
@@ -309,6 +324,8 @@ class TradingSystem:
         self.metrics.set_gauge("closed_trades", self.executor.closed_count())
         self.metrics.observe("tick_duration_seconds",
                              time.perf_counter() - t0)
+        if self.devprof is not None:
+            self.devprof.observe_latency("tick", time.perf_counter() - t0)
         self._emit_health_gauges()
         self._peak_value = max(getattr(self, "_peak_value", total), total)
         self.metrics.set_gauge("drawdown_usd", self._peak_value - total)
@@ -354,6 +371,17 @@ class TradingSystem:
         for service, beat_t in self.heartbeats.beats.items():
             self.metrics.set_gauge("heartbeat_timestamp", beat_t,
                                    service=service)
+        # continuous staleness per registered service: Grafana graphs the
+        # drift toward the threshold, not just the ServiceDown edge
+        for service, age in self.heartbeats.staleness().items():
+            self.metrics.set_gauge("heartbeat_staleness_seconds", age,
+                                   service=service)
+        if self.devprof is not None:
+            # SLO p50/p99 + burn-rate gauges, and the per-device
+            # live-buffer watermark sample — on BOTH tick paths, so a
+            # latency burn or HBM leak is visible during outages too
+            self.devprof.export()
+            self.devprof.sample_memory()
         self.metrics.set_gauge("last_market_update_timestamp",
                                self._last_market_update)
         self.metrics.set_gauge("max_positions",
@@ -423,6 +451,9 @@ class TradingSystem:
             "crash_looped_services": [n for n, b in self.stage_breakers.items()
                                       if b.quarantined],
         }
+        if self.devprof is not None:
+            state["slo_burn_rates"] = self.devprof.burn_rates()
+            state["donation_failures"] = list(self.devprof.donation_failures)
         confidences = [
             s.get("confidence", 0.0)
             for s in (self.bus.get(f"latest_signal_{sym}")
@@ -510,6 +541,10 @@ class TradingSystem:
                 # discarded registry (listener registration is permanent)
                 monitor.metrics = None
             self.tracer.close()
+        if (self.devprof is not None
+                and devprof_mod.active() is self.devprof):
+            devprof_mod.disable()          # a later system's devprof is
+            #                                left alone (tracer pattern)
         if self.journal is not None:
             self.journal.close()           # flush the buffered tail
 

@@ -60,6 +60,24 @@ def default_rules() -> list[AlertRule]:
         AlertRule("MaxPositionsReached", "info",
                   lambda s: s.get("open_positions", 0) >= s.get("max_positions", 5),
                   "position slots exhausted"),
+        # --- device-runtime observatory (utils/devprof.py) ---
+        # burn rate = frac-of-window over the SLO target / error budget:
+        # 14.4 is the classic fast-burn page (a 30 d budget gone in ~2 d),
+        # 6 the slow-burn warning.  The launcher feeds `slo_burn_rates`
+        # from DevProf.burn_rates(); monitoring/alert_rules.yml carries
+        # the PromQL twins over crypto_trader_tpu_slo_burn_rate.
+        AlertRule("LatencySLOBurnRateCritical", "critical",
+                  lambda s: any(v > 14.4 for v in
+                                s.get("slo_burn_rates", {}).values()),
+                  "a latency SLO error budget is burning >14.4x"),
+        AlertRule("LatencySLOBurnRateWarning", "warning",
+                  lambda s: any(6.0 < v <= 14.4 for v in
+                                s.get("slo_burn_rates", {}).values()),
+                  "a latency SLO error budget is burning >6x"),
+        AlertRule("DonatedBufferNotFreed", "warning",
+                  lambda s: bool(s.get("donation_failures")),
+                  "a donated input buffer survived its dispatch "
+                  "(XLA fell back to a silent copy — doubles HBM)"),
     ]
 
 

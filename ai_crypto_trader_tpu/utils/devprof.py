@@ -1,0 +1,432 @@
+"""Device-runtime performance observatory: cost cards, donation checks,
+live-memory watermarks, latency SLOs.
+
+PR 1 gave the host side spans and compile-vs-execute attribution; this
+module watches the DEVICE runtime the scale-out arc lives on.  Podracer
+(arXiv:2104.06272) and FinRL-Podracer (arXiv:2111.05188) treat
+throughput-per-device as the continuously measured north-star — that
+requires knowing what each compiled program *costs* and whether the
+memory story the code claims (donation, ring residency) is the one XLA
+actually delivered.  Four instruments, one module:
+
+  * **Cost cards** (`cost_card`): a one-shot per-program summary from
+    ``jax.stages`` AOT introspection — FLOPs and bytes accessed from
+    ``Lowered.cost_analysis()``, argument/output/temp/generated-code
+    bytes from ``Compiled.memory_analysis()`` — published as
+    ``program_*{program=...}`` gauges and a ``compile.cost`` span event.
+    Every hot-path program registers one: the fused tick engine, the
+    compiled epoch trainer, the DQN iteration scan, the backtest sweep,
+    and the batched predict.
+  * **Donation verifier** (`verify_donation`): after a donated program's
+    first real dispatch, assert the donated input buffers were actually
+    deleted.  XLA silently falls back to a copy when it cannot alias a
+    donated buffer — at mesh scale that doubles HBM, and nothing else in
+    the stack would notice.
+  * **Live-memory watermarks** (`DevProf.sample_memory`): a sampler over
+    ``jax.live_arrays()`` exporting live-buffer count/bytes per device
+    plus high-watermark gauges, hooked into the launcher's supervised
+    loop and the soak tier.
+  * **Latency SLOs** (`observe_latency` / `DevProf.export`): sliding-
+    window p50/p99 estimators over the hot latencies (``tick``,
+    ``train_step``, ``host_read``), exported as
+    ``latency_p50_seconds{slo=...}`` / ``latency_p99_seconds{slo=...}``
+    gauges, a ``slo_latency_seconds`` histogram for PromQL, and a
+    ``slo_burn_rate`` gauge (fraction of the window over the SLO target,
+    divided by the error budget) that drives the burn-rate alert rules
+    in utils/alerts.py and monitoring/alert_rules.yml.
+
+Like tracing, the observatory is OFF by default: every hot-path helper
+checks one module global and returns immediately when no `DevProf` is
+configured, so the disabled path costs one attribute read.  Enable with
+``TradingSystem(..., enable_devprof=True)``, ``cli trade --devprof``, or
+``devprof.use(DevProf(metrics=...))`` in tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+# The active observatory. None = disabled (the default): the module-level
+# helpers below check this one global and bail out immediately.
+_ACTIVE: "DevProf | None" = None
+
+# SLO targets (seconds) for the burn-rate gauge: the latency each window
+# is budgeted against.  `error_budget` is the allowed fraction of
+# observations over target; burn rate = frac_over(target) / budget, so
+# burn 1.0 = exactly on budget, 14.4 = the classic fast-burn page
+# threshold (a 30 d budget gone in ~2 d).
+DEFAULT_SLO_TARGETS = {
+    "tick": 1.0,          # full live tick (monitor→analyzer→executor)
+    "train_step": 0.5,    # compiled-epoch / DQN-scan amortized step
+    "host_read": 0.25,    # the one device→host sync per dispatch
+}
+DEFAULT_ERROR_BUDGET = 0.01
+
+
+def percentile(values, q: float) -> float:
+    """Nearest-rank percentile over an unsorted sequence (0 when empty).
+    No numpy: this runs on hot-path export with tiny windows."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = max(0, min(len(s) - 1, round(q / 100.0 * (len(s) - 1))))
+    return s[idx]
+
+
+class SlidingQuantiles:
+    """Bounded-window quantile estimator: observations land in a deque of
+    ``window`` samples; quantiles are exact over that window (long-run
+    decay for free — old samples fall off the back)."""
+
+    def __init__(self, window: int = 1024):
+        self.buf: deque = deque(maxlen=window)
+        self.count = 0                       # total ever observed
+
+    def observe(self, value: float) -> None:
+        self.buf.append(float(value))
+        self.count += 1
+
+    def quantile(self, q: float) -> float:
+        return percentile(self.buf, q)
+
+    def frac_over(self, threshold: float) -> float:
+        """Fraction of the current window exceeding ``threshold``."""
+        if not self.buf:
+            return 0.0
+        return sum(1 for v in self.buf if v > threshold) / len(self.buf)
+
+    def summary(self) -> dict:
+        return {"count": self.count, "window": len(self.buf),
+                "p50": self.quantile(50), "p99": self.quantile(99)}
+
+
+@dataclass
+class CostCard:
+    """One compiled program's cost/memory attribution (one-shot)."""
+
+    program: str
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    alias_bytes: int = 0
+    generated_code_bytes: int = 0
+    donation_ok: bool | None = None          # verify_donation result
+    error: str | None = None                 # analysis failure, if any
+
+    def to_dict(self) -> dict:
+        return {"program": self.program, "flops": self.flops,
+                "bytes_accessed": self.bytes_accessed,
+                "argument_bytes": self.argument_bytes,
+                "output_bytes": self.output_bytes,
+                "temp_bytes": self.temp_bytes,
+                "alias_bytes": self.alias_bytes,
+                "generated_code_bytes": self.generated_code_bytes,
+                "donation_ok": self.donation_ok, "error": self.error}
+
+
+class MemoryWatermark:
+    """Per-device live-buffer accounting over ``jax.live_arrays()`` with
+    monotone high watermarks (the number capacity planning needs: not
+    what is live NOW, but the most that was ever live at a sample)."""
+
+    def __init__(self):
+        self.peak_bytes: dict[str, int] = {}
+        self.peak_count: dict[str, int] = {}
+
+    def sample(self, metrics=None) -> dict:
+        import jax
+
+        # every visible device gets a row even with zero live buffers —
+        # a flat-zero series is a dashboard fact, a missing one is a hole
+        per: dict[str, list] = {str(d): [0, 0] for d in jax.devices()}
+        for arr in jax.live_arrays():
+            try:
+                for sh in arr.addressable_shards:
+                    dev = str(sh.device)
+                    slot = per.setdefault(dev, [0, 0])
+                    slot[0] += 1
+                    slot[1] += sh.data.nbytes
+            except Exception:                # noqa: BLE001 — a mid-GC array
+                continue                     # must not kill the sampler
+        out = {}
+        for dev, (count, nbytes) in per.items():
+            self.peak_bytes[dev] = max(self.peak_bytes.get(dev, 0), nbytes)
+            self.peak_count[dev] = max(self.peak_count.get(dev, 0), count)
+            out[dev] = {"count": count, "bytes": nbytes,
+                        "peak_bytes": self.peak_bytes[dev],
+                        "peak_count": self.peak_count[dev]}
+            if metrics is not None:
+                metrics.set_gauge("live_buffer_count", count, device=dev)
+                metrics.set_gauge("live_buffer_bytes", nbytes, device=dev)
+                metrics.set_gauge("live_buffer_bytes_peak",
+                                  self.peak_bytes[dev], device=dev)
+        return out
+
+
+class DevProf:
+    """The observatory instance: cards + SLO windows + watermark.
+
+    ``metrics`` (a MetricsRegistry) receives every gauge/histogram;
+    ``memory_analysis=False`` skips the AOT backend compile in cost
+    cards (FLOPs/bytes still published from the lowering) — use it where
+    a second compile of a huge program is unaffordable (bench sweeps).
+    Thread-safe: dashboard handler threads read cards while offloaded
+    model work observes latencies.
+    """
+
+    def __init__(self, metrics=None, memory_analysis: bool = True,
+                 slo_targets: dict | None = None,
+                 error_budget: float = DEFAULT_ERROR_BUDGET,
+                 window: int = 1024, min_samples: int = 32):
+        self.metrics = metrics
+        self.memory_analysis = memory_analysis
+        self.slo_targets = dict(DEFAULT_SLO_TARGETS if slo_targets is None
+                                else slo_targets)
+        self.error_budget = error_budget
+        self.window = window
+        # burn rates report 0 below this window fill: a single compile-
+        # heavy cold tick is 100% of a 1-sample window and would page
+        # instantly — burn alerts need minimum traffic, like real SRE
+        # multiwindow burn alerts do
+        self.min_samples = min_samples
+        self.cards: dict[str, CostCard] = {}
+        self.slos: dict[str, SlidingQuantiles] = {}
+        self.watermark = MemoryWatermark()
+        self.donation_failures: list[str] = []
+        self._lock = threading.Lock()
+
+    # -- cost cards ----------------------------------------------------------
+    def cost_card(self, name: str, jit_fn, *args,
+                  _memory_analysis: bool | None = None, **kwargs) -> CostCard:
+        """One-shot cost/memory attribution for ``jit_fn`` at the shapes of
+        ``args``/``kwargs``.  Arrays are abstracted to ShapeDtypeStructs
+        (no buffer reads — safe to call right before a donating dispatch);
+        static arguments pass through unchanged.  ``_memory_analysis``
+        overrides the instance setting for THIS card only (underscore so
+        it can never collide with a jit static kwarg) — call sites use it
+        instead of flipping the shared flag, which would race a
+        concurrent card from another thread.  Analysis failures land on
+        ``card.error`` — a cost card must never kill a hot path."""
+        want_memory = (self.memory_analysis if _memory_analysis is None
+                       else _memory_analysis)
+        with self._lock:
+            if name in self.cards:
+                return self.cards[name]
+            card = CostCard(program=name)
+            self.cards[name] = card
+        try:
+            import jax
+
+            def abstract(v):
+                if isinstance(v, jax.Array):
+                    return jax.ShapeDtypeStruct(v.shape, v.dtype)
+                return v
+
+            a_args, a_kwargs = jax.tree.map(abstract, (args, kwargs))
+            lowered = jit_fn.lower(*a_args, **a_kwargs)
+            cost = lowered.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            card.flops = float(cost.get("flops", 0.0))
+            card.bytes_accessed = float(cost.get("bytes accessed", 0.0))
+            if want_memory:
+                mem = lowered.compile().memory_analysis()
+                if mem is not None:
+                    card.argument_bytes = int(
+                        getattr(mem, "argument_size_in_bytes", 0))
+                    card.output_bytes = int(
+                        getattr(mem, "output_size_in_bytes", 0))
+                    card.temp_bytes = int(
+                        getattr(mem, "temp_size_in_bytes", 0))
+                    card.alias_bytes = int(
+                        getattr(mem, "alias_size_in_bytes", 0))
+                    card.generated_code_bytes = int(
+                        getattr(mem, "generated_code_size_in_bytes", 0))
+        except Exception as exc:             # noqa: BLE001
+            card.error = f"{type(exc).__name__}: {exc}"
+        self._publish_card(card)
+        return card
+
+    def _publish_card(self, card: CostCard) -> None:
+        m = self.metrics
+        if m is not None:
+            m.set_gauge("program_flops", card.flops, program=card.program)
+            m.set_gauge("program_bytes_accessed", card.bytes_accessed,
+                        program=card.program)
+            m.set_gauge("program_argument_bytes", card.argument_bytes,
+                        program=card.program)
+            m.set_gauge("program_output_bytes", card.output_bytes,
+                        program=card.program)
+            m.set_gauge("program_temp_bytes", card.temp_bytes,
+                        program=card.program)
+            m.set_gauge("program_generated_code_bytes",
+                        card.generated_code_bytes, program=card.program)
+        # compile.cost span event: on the current span when one is open
+        # (the dispatch's own span), else a standalone marker span
+        from ai_crypto_trader_tpu.utils import tracing
+
+        sp = tracing.current()
+        if sp is not None:
+            sp.add_event("compile.cost", **card.to_dict())
+        else:
+            tracer = tracing.active()
+            if tracer is not None:
+                with tracer.span("compile.cost",
+                                 attributes=card.to_dict()):
+                    pass
+
+    # -- donation verifier ---------------------------------------------------
+    def verify_donation(self, name: str, donated) -> bool:
+        """True iff every array leaf of ``donated`` was deleted by the
+        dispatch it was donated to.  Call AFTER the first dispatch, with
+        references captured BEFORE it.  A surviving buffer means XLA fell
+        back to a silent copy — recorded on the card, the
+        ``program_donation_ok`` gauge, and ``donation_failures`` (the
+        DonatedBufferNotFreed alert input)."""
+        import jax
+
+        leaves = [x for x in jax.tree.leaves(donated)
+                  if isinstance(x, jax.Array)]
+        ok = bool(leaves) and all(x.is_deleted() for x in leaves)
+        with self._lock:
+            card = self.cards.get(name)
+            if card is None:
+                card = self.cards[name] = CostCard(program=name)
+            card.donation_ok = ok
+            if not ok and name not in self.donation_failures:
+                self.donation_failures.append(name)
+        if self.metrics is not None:
+            self.metrics.set_gauge("program_donation_ok",
+                                   1.0 if ok else 0.0, program=name)
+        return ok
+
+    # -- latency SLOs --------------------------------------------------------
+    def observe_latency(self, name: str, seconds: float) -> None:
+        with self._lock:
+            q = self.slos.get(name)
+            if q is None:
+                q = self.slos[name] = SlidingQuantiles(window=self.window)
+            q.observe(seconds)
+        if self.metrics is not None:
+            self.metrics.observe("slo_latency_seconds", seconds, slo=name)
+
+    def _slo_snapshots(self) -> dict:
+        """{name: (total_count, [window values])} copied under the lock —
+        observe_latency appends from worker threads (offloaded model
+        work), so readers must never iterate the live deques."""
+        with self._lock:
+            return {name: (q.count, list(q.buf))
+                    for name, q in self.slos.items()}
+
+    def _burn(self, values: list, target: float) -> float:
+        if len(values) < self.min_samples:
+            return 0.0
+        frac = sum(1 for v in values if v > target) / len(values)
+        return frac / self.error_budget
+
+    def burn_rates(self) -> dict:
+        """{slo: burn rate} for every window with a configured target
+        (0.0 until the window holds ``min_samples`` observations)."""
+        out = {}
+        for name, (_, values) in self._slo_snapshots().items():
+            target = self.slo_targets.get(name)
+            if target:
+                out[name] = self._burn(values, target)
+        return out
+
+    def export(self) -> None:
+        """Publish the p50/p99 + burn-rate gauges (one call per tick)."""
+        m = self.metrics
+        if m is None:
+            return
+        for name, (_, values) in self._slo_snapshots().items():
+            m.set_gauge("latency_p50_seconds", percentile(values, 50),
+                        slo=name)
+            m.set_gauge("latency_p99_seconds", percentile(values, 99),
+                        slo=name)
+            target = self.slo_targets.get(name)
+            if target:
+                m.set_gauge("slo_burn_rate", self._burn(values, target),
+                            slo=name)
+
+    # -- memory watermarks ---------------------------------------------------
+    def sample_memory(self) -> dict:
+        return self.watermark.sample(metrics=self.metrics)
+
+    # -- views ---------------------------------------------------------------
+    def status(self) -> dict:
+        """JSON-able snapshot (dashboard /state.json, cli profile)."""
+        with self._lock:
+            cards = {n: c.to_dict() for n, c in self.cards.items()}
+        slos = {name: {"count": count, "window": len(values),
+                       "p50": percentile(values, 50),
+                       "p99": percentile(values, 99)}
+                for name, (count, values) in self._slo_snapshots().items()}
+        return {"cost_cards": cards, "slos": slos,
+                "burn_rates": self.burn_rates(),
+                "donation_failures": list(self.donation_failures),
+                "memory": {d: {"peak_bytes": b}
+                           for d, b in self.watermark.peak_bytes.items()}}
+
+
+# -- module-level hot-path API (single-check disabled path) ------------------
+
+def configure(dp: DevProf) -> DevProf:
+    """Install ``dp`` as the process-wide active observatory."""
+    global _ACTIVE
+    _ACTIVE = dp
+    return dp
+
+
+def disable() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> DevProf | None:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def use(dp: DevProf):
+    """Scoped activation (tests): restores the previous instance on exit."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = dp
+    try:
+        yield dp
+    finally:
+        _ACTIVE = prev
+
+
+def cost_card(name: str, jit_fn, *args, **kwargs) -> CostCard | None:
+    dp = _ACTIVE
+    if dp is None:
+        return None
+    return dp.cost_card(name, jit_fn, *args, **kwargs)
+
+
+def has_card(name: str) -> bool:
+    """Cheap pre-dispatch check: is this program already carded?  False
+    also when the observatory is disabled — call sites use this to skip
+    the donated-reference capture entirely."""
+    dp = _ACTIVE
+    return dp is not None and name in dp.cards
+
+
+def verify_donation(name: str, donated) -> bool | None:
+    dp = _ACTIVE
+    if dp is None:
+        return None
+    return dp.verify_donation(name, donated)
+
+
+def observe_latency(name: str, seconds: float) -> None:
+    dp = _ACTIVE
+    if dp is not None:
+        dp.observe_latency(name, seconds)

@@ -86,6 +86,19 @@ class HeartbeatRegistry:
         names = list(dict.fromkeys([*self.beats, *self.expected]))
         return {s: s not in stale for s in names}
 
+    def staleness(self) -> dict:
+        """Continuous per-service staleness in seconds (registered
+        services only: beaten ∪ expected) — the
+        `heartbeat_staleness_seconds{service=...}` gauge, so Grafana can
+        graph a service's drift toward its threshold instead of only
+        seeing the edge-triggered ServiceDown alert.  Never-beaten
+        expected services age from their registration time."""
+        now = self.now_fn()
+        names = list(dict.fromkeys([*self.beats, *self.expected]))
+        return {s: max(now - self.beats.get(s, self.expected.get(s, now)),
+                       0.0)
+                for s in names}
+
 
 def device_liveness() -> dict:
     """Round-trip a tiny computation through every device."""

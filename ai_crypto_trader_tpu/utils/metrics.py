@@ -24,6 +24,14 @@ _BUCKETS = (0.00001, 0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
             float("inf"))
 
 
+def escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline must be escaped or a real scrape mangles the series (the
+    parser sees a truncated value and a garbage sample line)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 @dataclass
 class MetricsRegistry:
     namespace: str = "crypto_trader_tpu"
@@ -34,7 +42,8 @@ class MetricsRegistry:
     now_fn: any = time.time
 
     def _key(self, name: str, labels: dict | None):
-        lbl = ",".join(f'{k}="{v}"' for k, v in sorted((labels or {}).items()))
+        lbl = ",".join(f'{k}="{escape_label_value(v)}"'
+                       for k, v in sorted((labels or {}).items()))
         return f"{self.namespace}_{name}{{{lbl}}}" if lbl else f"{self.namespace}_{name}"
 
     def inc(self, name: str, value: float = 1.0, **labels):
@@ -66,13 +75,25 @@ class MetricsRegistry:
 
     def exposition(self) -> str:
         lines = []
+        typed = set()
+
+        def type_line(base: str, mtype: str):
+            # one # TYPE per metric family, ahead of its first sample —
+            # real Prometheus scrapers use it to pick the sample parser
+            if base not in typed:
+                typed.add(base)
+                lines.append(f"# TYPE {base} {mtype}")
+
         for k, v in sorted(self.counters.items()):
+            type_line(k.partition("{")[0], "counter")
             lines.append(f"{k} {v}")
         for k, v in sorted(self.gauges.items()):
+            type_line(k.partition("{")[0], "gauge")
             lines.append(f"{k} {v}")
         for k, h in sorted(self.histograms.items()):
             base, _, lbl = k.partition("{")
             lbl = ("{" + lbl) if lbl else ""
+            type_line(base, "histogram")
             for b in _BUCKETS:
                 le = "+Inf" if b == float("inf") else str(b)
                 l2 = (lbl[:-1] + f',le="{le}"}}') if lbl else f'{{le="{le}"}}'
@@ -92,14 +113,25 @@ class MetricsRegistry:
                 while (await reader.readline()).strip():
                     pass
                 if path == "/health":
+                    status = "200 OK"
                     body = '{"status": "healthy"}'
                     ctype = "application/json"
-                else:
+                elif path == "/metrics":
+                    status = "200 OK"
                     body = self.exposition()
                     ctype = "text/plain"
-                resp = (f"HTTP/1.1 200 OK\r\nContent-Type: {ctype}\r\n"
-                        f"Content-Length: {len(body)}\r\n\r\n{body}")
-                writer.write(resp.encode())
+                else:
+                    # unknown paths 404 — serving the full exposition for
+                    # every path made probes and typos look like scrapes
+                    status = "404 Not Found"
+                    body = "not found"
+                    ctype = "text/plain"
+                payload = body.encode()     # Content-Length counts BYTES:
+                #                             a non-ASCII label value would
+                #                             otherwise truncate the scrape
+                head = (f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                        f"Content-Length: {len(payload)}\r\n\r\n")
+                writer.write(head.encode() + payload)
                 await writer.drain()
             finally:
                 writer.close()

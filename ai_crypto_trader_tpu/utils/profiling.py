@@ -3,9 +3,12 @@
 The reference has no tracing at all (Jaeger is an unchecked TODO,
 SURVEY §5.1) and only Prometheus latency histograms.  Here:
   * `trace(dir)` — context manager around `jax.profiler.trace` producing
-    TensorBoard-loadable XPlane traces of device execution;
+    TensorBoard-loadable XPlane traces of device execution (the artifact
+    behind the dashboard's on-demand `/profile?seconds=N` endpoint and
+    `cli profile`);
   * `StepTimer` — wall-clock step timing with jax.block_until_ready
-    semantics, feeding the MetricsRegistry histograms;
+    semantics, feeding the MetricsRegistry histograms and (when the
+    devprof observatory is active) its latency SLO windows;
   * `annotate` — `jax.profiler.TraceAnnotation` passthrough for host-side
     region labels.
 """
@@ -14,8 +17,11 @@ from __future__ import annotations
 
 import contextlib
 import time
+from collections import deque
 
 import jax
+
+from ai_crypto_trader_tpu.utils import devprof
 
 
 @contextlib.contextmanager
@@ -44,12 +50,21 @@ class _StepHandle:
 class StepTimer:
     """Times compiled-step wall clock (blocking on device completion of
     whatever the block registers via `s.block(...)`) and reports into a
-    MetricsRegistry histogram."""
+    MetricsRegistry histogram.
 
-    def __init__(self, metrics=None, name: str = "step_seconds"):
+    ``history`` is BOUNDED (deque of ``window`` samples): a long soak
+    observing a step every few seconds must not grow a list forever.
+    ``count`` keeps the total ever observed; ``summary()`` gives
+    count/p50/p99 over the current window — the shape the devprof SLO
+    estimator consumes.  With the observatory active each step also
+    lands in the SLO window named by ``name``."""
+
+    def __init__(self, metrics=None, name: str = "step_seconds",
+                 window: int = 4096):
         self.metrics = metrics
         self.name = name
-        self.history: list[float] = []
+        self.history: deque[float] = deque(maxlen=window)
+        self.count = 0
 
     @contextlib.contextmanager
     def step(self):
@@ -60,9 +75,17 @@ class StepTimer:
             jax.block_until_ready(handle.value)
         dt = time.perf_counter() - t0
         self.history.append(dt)
+        self.count += 1
         if self.metrics is not None:
             self.metrics.observe(self.name, dt)
+        devprof.observe_latency(self.name, dt)
 
     @property
     def mean(self) -> float:
         return sum(self.history) / len(self.history) if self.history else 0.0
+
+    def summary(self) -> dict:
+        """count (total ever) + window p50/p99 — the SLO estimator's view."""
+        return {"count": self.count, "window": len(self.history),
+                "p50": devprof.percentile(self.history, 50),
+                "p99": devprof.percentile(self.history, 99)}

@@ -51,6 +51,15 @@ artifact came back EMPTY.  This script is therefore split in two:
       after the replay sweep) and re-printed LAST, so a worker killed
       mid-secondary-bench still leaves a parseable headline in the
       captured output.
+
+Trajectory + regression gate: every orchestrated run appends its rows to
+BENCH_history.jsonl (run_id + device_kind stamped; `--history-file PATH`
+overrides, `--no-history` skips) and mirrors the latest values into
+BASELINE.json's `published` block.  `bench.py --gate` compares the latest
+run against the best prior same-device-kind row per metric and exits
+nonzero when one regressed beyond `--gate-tolerance` (default 0.10, env
+BENCH_GATE_TOLERANCE) — the CI hook that keeps the fused-tick and
+compiled-epoch wins from silently rotting.  The gate never imports jax.
 """
 
 import json
@@ -79,6 +88,200 @@ BACKEND = "unknown"
 # `backend` on every row — VERDICT r5: without it, TPU evidence in the
 # artifact is indistinguishable from CPU prose.
 DEVICE_KIND = "unknown"
+
+# --------------------------------------------------------------------------
+# bench trajectory + regression gate (jax-free: runs in the orchestrator)
+# --------------------------------------------------------------------------
+# Every orchestrated run appends its rows to BENCH_history.jsonl (one JSON
+# row per metric, run_id + device-kind stamped) and mirrors the latest
+# values into BASELINE.json's `published` block, so the perf trajectory of
+# the repo is a file, not archaeology over old logs.  `--gate` compares
+# the latest run against the best prior same-device-kind rows and exits
+# nonzero on a regression beyond tolerance — the wins from the fused tick
+# path and the compiled epoch cannot silently rot.  `--no-history` skips
+# the recording (scratch runs).
+
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+HISTORY_PATH = os.environ.get(
+    "BENCH_HISTORY", os.path.join(_REPO_DIR, "BENCH_history.jsonl"))
+BASELINE_PATH = os.path.join(_REPO_DIR, "BASELINE.json")
+GATE_TOLERANCE = float(os.environ.get("BENCH_GATE_TOLERANCE", "0.10"))
+
+# units where smaller is better; everything else is a throughput.  "bool"
+# rows (parity checks) are pass/fail artifacts, not trajectory points.
+LOWER_IS_BETTER_UNITS = ("ms", "s", "seconds")
+GATE_SKIP_UNITS = ("bool",)
+
+# rows this orchestrator process saw (its own emits + worker stdout rows)
+_COLLECTED = []
+
+
+def collected_rows() -> list:
+    """Deduped rows of this run: last occurrence per (metric, device_kind)
+    wins (the headline is printed early AND re-printed last by design; a
+    CPU-fallback worker followed by a TPU retry in the same run emits the
+    same metrics for BOTH device kinds, and both trajectories must
+    survive)."""
+    out = {}
+    for row in _COLLECTED:
+        if isinstance(row, dict) and "metric" in row:
+            out[(row["metric"], row.get("device_kind", "unknown"))] = row
+    return list(out.values())
+
+
+def append_history(rows: list, path: str | None = None,
+                   run_id: str | None = None) -> str:
+    """Append one run's rows to the history file, stamped with a shared
+    run_id and the scale knobs that shaped them."""
+    path = path or HISTORY_PATH
+    run_id = run_id or time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    scale = {k: os.environ[k] for k in
+             ("BENCH_T", "BENCH_POP", "BENCH_TICK_SYMBOLS")
+             if os.environ.get(k)}
+    with open(path, "a", encoding="utf-8") as f:
+        for row in rows:
+            rec = {"run_id": run_id, "at": round(time.time(), 3), **row}
+            if scale:
+                rec["scale"] = scale
+            f.write(json.dumps(rec) + "\n")
+    return run_id
+
+
+def publish_baseline(rows: list, path: str | None = None) -> None:
+    """Mirror the run's rows into BASELINE.json `published` (the block the
+    ROADMAP's north-star metrics report from)."""
+    path = path or BASELINE_PATH
+    try:
+        with open(path, encoding="utf-8") as f:
+            base = json.load(f)
+    except Exception:                        # noqa: BLE001 — missing/corrupt
+        base = {}
+    published = base.setdefault("published", {})
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    for row in rows:
+        if row.get("unit") in GATE_SKIP_UNITS:
+            continue
+        entry = {k: row[k] for k in ("value", "unit", "vs_baseline",
+                                     "backend", "device_kind", "engine")
+                 if row.get(k) is not None}
+        entry["at"] = stamp
+        published[row["metric"]] = entry
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(base, f, indent=1)
+        f.write("\n")
+
+
+def load_history(path: str | None = None) -> list:
+    path = path or HISTORY_PATH
+    rows = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue                 # torn tail / hand edits
+    except FileNotFoundError:
+        pass
+    return rows
+
+
+def _gate_key(r: dict) -> tuple:
+    """Rows are comparable only at the same device kind AND the same
+    scale knobs (append_history stamps `scale` precisely because a
+    BENCH_T=43200 run and a default-T run measure different things —
+    letting one gate the other would perma-fail CI on no regression)."""
+    scale = r.get("scale") or {}
+    return (r["metric"], r.get("device_kind", "unknown"),
+            tuple(sorted(scale.items())))
+
+
+def gate_history(rows: list, tolerance: float = GATE_TOLERANCE):
+    """Compare the latest run's rows against the best prior row per
+    (metric, device_kind, scale).  Returns (ok, report).  Keys with no
+    prior row pass as "new"; cross-device or cross-scale rows never gate
+    each other (a CPU fallback run must not fail against a TPU
+    trajectory, nor a scaled-down dev run against the full config)."""
+    usable = [r for r in rows
+              if r.get("unit") not in GATE_SKIP_UNITS
+              and isinstance(r.get("value"), (int, float))
+              and "metric" in r and "run_id" in r]
+    if not usable:
+        return True, [{"status": "empty", "detail": "no gateable history"}]
+    last_run = usable[-1]["run_id"]
+    latest, best_prior = {}, {}
+    for r in usable:
+        key = _gate_key(r)
+        if r["run_id"] == last_run:
+            latest[key] = r                  # last row of the run wins
+        else:
+            prev = best_prior.get(key)
+            if prev is None or _better(r, prev):
+                best_prior[key] = r
+    ok, report = True, []
+    for key in sorted(latest):
+        metric, device_kind, scale = key
+        row, best = latest[key], best_prior.get(key)
+        rec = {"metric": metric, "device_kind": device_kind,
+               "value": row["value"], "unit": row.get("unit")}
+        if scale:
+            rec["scale"] = dict(scale)
+        if best is None:
+            rec.update(status="new")
+        else:
+            lower = row.get("unit") in LOWER_IS_BETTER_UNITS
+            bound = (best["value"] * (1.0 + tolerance) if lower
+                     else best["value"] * (1.0 - tolerance))
+            regressed = (row["value"] > bound if lower
+                         else row["value"] < bound)
+            rec.update(best_prior=best["value"],
+                       best_prior_run=best["run_id"],
+                       allowed=round(bound, 6),
+                       status="REGRESSION" if regressed else "ok")
+            if regressed:
+                ok = False
+        report.append(rec)
+    return ok, report
+
+
+def _better(a: dict, b: dict) -> bool:
+    if a.get("unit") in LOWER_IS_BETTER_UNITS:
+        return a["value"] < b["value"]
+    return a["value"] > b["value"]
+
+
+def _flag_value(name: str, default):
+    if name in sys.argv:
+        i = sys.argv.index(name)
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return default
+
+
+def run_gate() -> int:
+    path = _flag_value("--history-file", HISTORY_PATH)
+    tol = float(_flag_value("--gate-tolerance", GATE_TOLERANCE))
+    ok, report = gate_history(load_history(path), tolerance=tol)
+    for rec in report:
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({"gate": "pass" if ok else "FAIL",
+                      "tolerance": tol, "history": path}), flush=True)
+    return 0 if ok else 1
+
+
+def finalize_history() -> None:
+    rows = collected_rows()
+    if not rows:
+        log("history: no rows collected; nothing recorded")
+        return
+    path = _flag_value("--history-file", HISTORY_PATH)
+    run_id = append_history(rows, path=path)
+    publish_baseline(rows)
+    log(f"history: {len(rows)} rows appended to {path} (run {run_id}); "
+        f"BASELINE.json published block updated")
 
 
 def log(*a):
@@ -125,6 +328,7 @@ def emit(metric, value, unit, vs_baseline=None, engine=None, **extra):
     if engine is not None:
         row["engine"] = engine
     row.update(extra)
+    _COLLECTED.append(row)
     print(json.dumps(row), flush=True)
 
 
@@ -179,8 +383,11 @@ def run_bench_worker(label: str, budget_s: float, *, cpu: bool) -> bool:
                 continue
             seen["last"] = ln
             try:
-                if json.loads(ln).get("metric") == HEADLINE_METRIC:
-                    seen["headline"] = ln
+                row = json.loads(ln)
+                if isinstance(row, dict) and "metric" in row:
+                    _COLLECTED.append(row)   # worker rows feed the history
+                    if row["metric"] == HEADLINE_METRIC:
+                        seen["headline"] = ln
             except ValueError:
                 pass
             print(ln, flush=True)
@@ -882,5 +1089,17 @@ if __name__ == "__main__":
         run_worker()
     elif "--emergency" in sys.argv:
         run_emergency()
+    elif "--gate" in sys.argv:
+        sys.exit(run_gate())
     else:
         orchestrate()
+        # trajectory recording is default-ON (--no-history for scratch
+        # runs): the history file and BASELINE.json.published only fill
+        # up if every real run contributes.  Recorded AFTER the final
+        # stdout row so the driver's headline-last parse is untouched.
+        if "--no-history" not in sys.argv:
+            try:
+                finalize_history()
+            except Exception as e:           # noqa: BLE001 — recording must
+                log(f"history recording failed "    # never fail the bench
+                    f"({type(e).__name__}: {e})")

@@ -1,0 +1,224 @@
+"""Bench trajectory + regression gate (bench.py --history / --gate).
+
+Tier-1-safe: the gate logic runs against SYNTHETIC history files — no
+benchmark executes, and the `--gate` entry point never imports jax (it
+must stay runnable as a cheap CI step on any box).  Covers the gate
+verdicts (pass / injected regression / unit direction / device-kind
+isolation / empty history), the history appender, and the
+BASELINE.json `published` block.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
+                                     "bench.py"))
+
+
+def _bench_module():
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_history(path, runs):
+    """runs: list of lists of row dicts; each inner list shares a run_id."""
+    with open(path, "w", encoding="utf-8") as f:
+        for i, rows in enumerate(runs):
+            for row in rows:
+                rec = {"run_id": f"run{i}", "at": float(i), **row}
+                f.write(json.dumps(rec) + "\n")
+
+
+def _row(metric, value, unit, device_kind="cpu"):
+    return {"metric": metric, "value": value, "unit": unit,
+            "backend": "cpu", "device_kind": device_kind}
+
+
+def _run_gate(history_file, *extra):
+    return subprocess.run(
+        [sys.executable, BENCH, "--gate", "--history-file",
+         str(history_file), *extra],
+        capture_output=True, text=True, timeout=120)
+
+
+class TestGateSubprocess:
+    """The CI smoke the satellite asks for: --gate as a real subprocess
+    against a synthetic two-run history — one clean, one with an
+    injected regression."""
+
+    def test_pass_on_improvement_and_new_metric(self, tmp_path):
+        hist = tmp_path / "h.jsonl"
+        _write_history(hist, [
+            [_row("backtest_candles_per_sec_per_chip", 1000.0, "candles/s/chip"),
+             _row("tick_pipeline", 12.0, "ms")],
+            [_row("backtest_candles_per_sec_per_chip", 1100.0, "candles/s/chip"),
+             _row("tick_pipeline", 11.0, "ms"),
+             _row("rl_env_steps_per_sec", 5e4, "steps/s")],   # new metric
+        ])
+        r = _run_gate(hist)
+        assert r.returncode == 0, r.stdout + r.stderr
+        verdict = json.loads(r.stdout.strip().splitlines()[-1])
+        assert verdict["gate"] == "pass"
+        statuses = [json.loads(l) for l in r.stdout.strip().splitlines()[:-1]]
+        assert {s["status"] for s in statuses} == {"ok", "new"}
+
+    def test_fail_on_injected_regression(self, tmp_path):
+        hist = tmp_path / "h.jsonl"
+        _write_history(hist, [
+            [_row("backtest_candles_per_sec_per_chip", 1000.0, "candles/s/chip")],
+            [_row("backtest_candles_per_sec_per_chip", 500.0, "candles/s/chip")],
+        ])
+        r = _run_gate(hist)
+        assert r.returncode != 0
+        lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+        assert lines[-1]["gate"] == "FAIL"
+        bad = [l for l in lines if l.get("status") == "REGRESSION"]
+        assert bad and bad[0]["metric"] == "backtest_candles_per_sec_per_chip"
+        assert bad[0]["best_prior"] == 1000.0
+
+    def test_gate_never_imports_jax(self, tmp_path):
+        """The gate must stay a cheap jax-free CI step: poison jax's
+        import and the verdict must be unaffected."""
+        hist = tmp_path / "h.jsonl"
+        _write_history(hist, [[_row("m", 1.0, "ms")], [_row("m", 1.0, "ms")]])
+        site = tmp_path / "site"
+        site.mkdir()
+        (site / "jax.py").write_text("raise ImportError('gate imported jax')")
+        env = dict(os.environ, PYTHONPATH=str(site))
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize must not dial
+        r = subprocess.run(
+            [sys.executable, BENCH, "--gate", "--history-file", str(hist)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+class TestGateLogic:
+    def setup_method(self):
+        self.bench = _bench_module()
+
+    def test_lower_is_better_units(self):
+        rows = []
+        for i, v in enumerate((100.0, 120.0)):      # ms went UP 20%
+            rows.append({"run_id": f"r{i}", "metric": "recovery_ms",
+                         "value": v, "unit": "ms", "device_kind": "cpu"})
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert not ok and report[0]["status"] == "REGRESSION"
+        ok, _ = self.bench.gate_history(rows, tolerance=0.30)
+        assert ok                                    # inside tolerance
+
+    def test_same_device_kind_only(self):
+        """A CPU fallback run must not gate against a TPU trajectory."""
+        rows = [
+            {"run_id": "r0", "metric": "m", "value": 1e6, "unit": "x/s",
+             "device_kind": "TPU v5e"},
+            {"run_id": "r1", "metric": "m", "value": 1e3, "unit": "x/s",
+             "device_kind": "cpu"},
+        ]
+        ok, report = self.bench.gate_history(rows)
+        assert ok and report[0]["status"] == "new"
+
+    def test_cross_scale_rows_never_gate(self):
+        """A scaled-down dev run (BENCH_T override, stamped into `scale`)
+        must not become the bar for a full-config run — different scale
+        knobs measure different things."""
+        rows = [
+            {"run_id": "r0", "metric": "m", "value": 1e6, "unit": "x/s",
+             "device_kind": "cpu", "scale": {"BENCH_T": "43200"}},
+            {"run_id": "r1", "metric": "m", "value": 1e3, "unit": "x/s",
+             "device_kind": "cpu"},                # default scale
+        ]
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert ok and report[0]["status"] == "new"
+        # same scale on both runs DOES gate
+        rows[1]["scale"] = {"BENCH_T": "43200"}
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert not ok and report[0]["status"] == "REGRESSION"
+        assert report[0]["scale"] == {"BENCH_T": "43200"}
+
+    def test_best_prior_not_just_last(self):
+        """The gate compares against the BEST prior row, so two
+        successive small regressions cannot ratchet the bar down."""
+        rows = [
+            {"run_id": "r0", "metric": "m", "value": 1000.0, "unit": "x/s",
+             "device_kind": "cpu"},
+            {"run_id": "r1", "metric": "m", "value": 920.0, "unit": "x/s",
+             "device_kind": "cpu"},
+            {"run_id": "r2", "metric": "m", "value": 850.0, "unit": "x/s",
+             "device_kind": "cpu"},
+        ]
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert not ok
+        assert report[0]["best_prior"] == 1000.0
+
+    def test_bool_rows_and_empty_history_pass(self):
+        ok, report = self.bench.gate_history([])
+        assert ok and report[0]["status"] == "empty"
+        rows = [{"run_id": "r0", "metric": "parity", "value": 1.0,
+                 "unit": "bool", "device_kind": "cpu"},
+                {"run_id": "r1", "metric": "parity", "value": 0.0,
+                 "unit": "bool", "device_kind": "cpu"}]
+        ok, _ = self.bench.gate_history(rows)
+        assert ok                                    # parity rows excluded
+
+    def test_corrupt_lines_skipped(self, tmp_path):
+        hist = tmp_path / "h.jsonl"
+        good = {"run_id": "r0", "metric": "m", "value": 1.0, "unit": "ms",
+                "device_kind": "cpu"}
+        hist.write_text(json.dumps(good) + "\n{torn-tail")
+        rows = self.bench.load_history(str(hist))
+        assert rows == [good]
+        assert self.bench.load_history(str(tmp_path / "missing.jsonl")) == []
+
+
+class TestHistoryRecording:
+    def setup_method(self):
+        self.bench = _bench_module()
+
+    def test_append_history_stamps_run(self, tmp_path):
+        hist = tmp_path / "h.jsonl"
+        run_id = self.bench.append_history(
+            [_row("m", 1.5, "ms")], path=str(hist))
+        rows = self.bench.load_history(str(hist))
+        assert rows[0]["run_id"] == run_id
+        assert rows[0]["metric"] == "m" and rows[0]["value"] == 1.5
+        assert "at" in rows[0]
+        # appends accumulate (the trajectory property)
+        self.bench.append_history([_row("m", 1.4, "ms")], path=str(hist))
+        assert len(self.bench.load_history(str(hist))) == 2
+
+    def test_publish_baseline_fills_published(self, tmp_path):
+        base = tmp_path / "BASELINE.json"
+        base.write_text(json.dumps({"metric": "x", "published": {}}))
+        self.bench.publish_baseline(
+            [_row("backtest_candles_per_sec_per_chip", 2e5, "candles/s/chip"),
+             _row("parity", 1.0, "bool")],          # excluded
+            path=str(base))
+        out = json.loads(base.read_text())
+        pub = out["published"]
+        assert pub["backtest_candles_per_sec_per_chip"]["value"] == 2e5
+        assert pub["backtest_candles_per_sec_per_chip"]["device_kind"] == "cpu"
+        assert "at" in pub["backtest_candles_per_sec_per_chip"]
+        assert "parity" not in pub
+        assert out["metric"] == "x"                  # rest preserved
+
+    def test_collected_rows_dedup_headline_keeps_device_kinds(self):
+        """Dedup is per (metric, device_kind): a CPU-fallback worker
+        followed by a TPU retry in the SAME run must contribute both
+        trajectories, while the re-printed headline dedups away."""
+        self.bench._COLLECTED.extend([
+            {"metric": "h", "value": 1.0, "unit": "x", "device_kind": "cpu"},
+            {"metric": "other", "value": 2.0, "unit": "x",
+             "device_kind": "cpu"},
+            {"metric": "h", "value": 9.0, "unit": "x",
+             "device_kind": "TPU v5e"},               # TPU retry row
+            {"metric": "h", "value": 1.0, "unit": "x",
+             "device_kind": "cpu"},                   # re-printed headline
+        ])
+        rows = self.bench.collected_rows()
+        assert sorted((r["metric"], r["device_kind"]) for r in rows) == [
+            ("h", "TPU v5e"), ("h", "cpu"), ("other", "cpu")]

@@ -14,9 +14,9 @@ from ai_crypto_trader_tpu.shell.exchange import FakeExchange
 from ai_crypto_trader_tpu.shell.launcher import TradingSystem
 
 
-def _fetch(port, path):
+def _fetch(port, path, timeout=5):
     with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
-                                timeout=5) as r:
+                                timeout=timeout) as r:
         return r.status, r.read().decode()
 
 
@@ -71,5 +71,47 @@ def test_serves_live_state_and_updates_between_polls():
             raise AssertionError("expected 404")
         except urllib.error.HTTPError as e:
             assert e.code == 404
+    finally:
+        server.stop()
+
+
+def test_profile_endpoint_capture_guard_and_artifact(tmp_path):
+    """On-demand device profiler capture (`/profile?seconds=N`): returns a
+    TensorBoard-loadable XPlane artifact directory that actually contains
+    trace files, and the single-capture guard 409s a concurrent request
+    (jax supports one profiler session per process)."""
+    import os
+
+    series = from_dict(generate_ohlcv(n=700, seed=7), symbol="BTCUSDC")
+    ex = FakeExchange({"BTCUSDC": series})
+    system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: 0.0)
+    server = DashboardServer(system, port=0,
+                             profile_dir=str(tmp_path / "profiles")).start()
+    try:
+        code, raw = _fetch(server.port, "/profile?seconds=0.2",
+                           timeout=120)
+        out = json.loads(raw)
+        assert code == 200
+        assert out["requested_s"] == 0.2 and out["seconds"] >= 0.2
+        files = [os.path.join(r, f)
+                 for r, _, fs in os.walk(out["artifact"]) for f in fs]
+        assert files, f"empty profile artifact {out['artifact']}"
+        assert out["artifact"].startswith(str(tmp_path / "profiles"))
+
+        # capture guard: while a capture holds the lock, a second request
+        # is refused rather than corrupting the running session
+        assert server._profile_lock.acquire(blocking=False)
+        try:
+            _fetch(server.port, "/profile?seconds=0.1", timeout=120)
+            raise AssertionError("expected 409")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+            assert "in progress" in e.read().decode()
+        finally:
+            server._profile_lock.release()
+
+        # released: capture works again
+        code, raw = _fetch(server.port, "/profile?seconds=0.1", timeout=120)
+        assert code == 200 and json.loads(raw)["artifact"] != out["artifact"]
     finally:
         server.stop()

@@ -1,0 +1,448 @@
+"""Device-runtime performance observatory (utils/devprof.py): program
+cost cards with donation verification, sliding-window latency SLOs with
+burn-rate alerts, live-memory watermarks, and the launcher/StepTimer/
+heartbeat-staleness wiring around them.
+
+The acceptance contract: every registered hot-path program (fused tick
+engine, compiled epoch trainer, DQN iteration scan, backtest sweep,
+batched predict) publishes a cost card with NONZERO FLOPs/bytes on first
+compile, and the donation verifier passes on all donated programs — and
+fails on a deliberately non-donated buffer.
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ai_crypto_trader_tpu.utils import devprof
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+
+class TestSlidingQuantiles:
+    def test_quantiles_on_known_distribution(self):
+        q = devprof.SlidingQuantiles(window=2048)
+        values = np.linspace(0.001, 1.0, 1000)
+        for v in np.random.default_rng(0).permutation(values):
+            q.observe(float(v))
+        assert abs(q.quantile(50) - 0.5) < 0.01
+        assert abs(q.quantile(99) - 0.99) < 0.01
+        s = q.summary()
+        assert s["count"] == 1000 and s["window"] == 1000
+        assert s["p50"] == q.quantile(50) and s["p99"] == q.quantile(99)
+
+    def test_window_slides(self):
+        """Old samples fall off: after a regime change the quantiles
+        reflect ONLY the recent window."""
+        q = devprof.SlidingQuantiles(window=100)
+        for _ in range(100):
+            q.observe(1.0)
+        for _ in range(100):
+            q.observe(0.001)
+        assert q.quantile(99) == 0.001
+        assert q.count == 200 and len(q.buf) == 100
+
+    def test_frac_over(self):
+        q = devprof.SlidingQuantiles(window=100)
+        for i in range(100):
+            q.observe(0.2 if i < 10 else 0.01)
+        assert abs(q.frac_over(0.1) - 0.10) < 1e-9
+        assert devprof.SlidingQuantiles().frac_over(1.0) == 0.0
+
+    def test_empty(self):
+        q = devprof.SlidingQuantiles()
+        assert q.quantile(50) == 0.0
+        assert q.summary()["count"] == 0
+
+
+class TestCostCards:
+    def test_card_has_nonzero_cost_and_memory_fields(self):
+        m = MetricsRegistry()
+        with devprof.use(devprof.DevProf(metrics=m)):
+            f = jax.jit(lambda a, b: jnp.tanh(a @ b))
+            x = jnp.ones((64, 64))
+            card = devprof.cost_card("matmul", f, x, x)
+        assert card.error is None
+        assert card.flops > 0 and card.bytes_accessed > 0
+        assert card.argument_bytes == 2 * 64 * 64 * 4
+        assert card.output_bytes >= 64 * 64 * 4
+        text = m.exposition()
+        for gauge in ("program_flops", "program_bytes_accessed",
+                      "program_argument_bytes", "program_output_bytes"):
+            line = [l for l in text.splitlines()
+                    if l.startswith(f'crypto_trader_tpu_{gauge}{{program="matmul"}}')]
+            assert line, gauge
+            if gauge in ("program_flops", "program_bytes_accessed"):
+                assert float(line[0].rsplit(" ", 1)[1]) > 0, line[0]
+
+    def test_one_shot_per_program(self):
+        dp = devprof.DevProf()
+        with devprof.use(dp):
+            f = jax.jit(lambda a: a + 1)
+            card = devprof.cost_card("once", f, jnp.ones((4,)))
+            again = devprof.cost_card("once", f, jnp.ones((4096,)))
+        assert again is card                 # second shape never analyzed
+
+    def test_disabled_is_noop(self):
+        devprof.disable()
+        assert devprof.cost_card("x", None) is None
+        assert devprof.verify_donation("x", None) is None
+        assert not devprof.has_card("x")
+        devprof.observe_latency("x", 1.0)    # no crash, no state
+
+    def test_analysis_failure_lands_on_card_not_raise(self):
+        with devprof.use(devprof.DevProf()) as dp:
+            card = devprof.cost_card("broken", object())   # no .lower
+        assert card.error is not None and dp.cards["broken"] is card
+
+    def test_compile_cost_span_event_on_current_span(self):
+        from ai_crypto_trader_tpu.utils import tracing
+
+        tracer = tracing.Tracer(now_fn=lambda: 0.0)
+        with tracing.use(tracer), devprof.use(devprof.DevProf()):
+            with tracer.span("dispatch"):
+                devprof.cost_card("ev", jax.jit(lambda a: a * 2),
+                                  jnp.ones((8,)))
+        span = tracer.finished[-1]
+        assert span.name == "dispatch"
+        events = [e for e in span.events if e["name"] == "compile.cost"]
+        assert events and events[0]["program"] == "ev"
+        assert events[0]["flops"] >= 0
+
+
+class TestDonationVerifier:
+    def test_donated_buffer_freed_passes(self):
+        m = MetricsRegistry()
+        with devprof.use(devprof.DevProf(metrics=m)) as dp:
+            f = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+            x = jnp.ones((256,))
+            f(x)
+            assert devprof.verify_donation("donated", x) is True
+        assert dp.cards["donated"].donation_ok is True
+        assert dp.donation_failures == []
+        assert ('crypto_trader_tpu_program_donation_ok{program="donated"} 1.0'
+                in m.exposition())
+
+    def test_non_donated_buffer_fails(self):
+        """The negative case the acceptance criteria demand: a dispatch
+        WITHOUT donation leaves the input buffer alive, and the verifier
+        must say so."""
+        m = MetricsRegistry()
+        with devprof.use(devprof.DevProf(metrics=m)) as dp:
+            f = jax.jit(lambda x: x * 2.0)   # deliberately not donated
+            x = jnp.ones((256,))
+            f(x)
+            assert devprof.verify_donation("not_donated", x) is False
+        assert dp.cards["not_donated"].donation_ok is False
+        assert dp.donation_failures == ["not_donated"]
+        assert ('crypto_trader_tpu_program_donation_ok{program="not_donated"} 0.0'
+                in m.exposition())
+
+    def test_failure_drives_alert_rule(self):
+        from ai_crypto_trader_tpu.utils.alerts import AlertManager
+
+        am = AlertManager(now_fn=lambda: 0.0)
+        fired = am.evaluate({"donation_failures": ["tick_engine"]})
+        assert any(a["name"] == "DonatedBufferNotFreed" for a in fired)
+        am.evaluate({"donation_failures": []})
+        assert "DonatedBufferNotFreed" not in am.active
+
+
+class TestSLOExportAndBurnRates:
+    def test_export_gauges_and_burn(self):
+        m = MetricsRegistry()
+        dp = devprof.DevProf(metrics=m, slo_targets={"tick": 0.1},
+                             min_samples=32)
+        # 95 in-budget + 5 over-target observations: frac_over = 5%
+        for _ in range(95):
+            dp.observe_latency("tick", 0.01)
+        for _ in range(5):
+            dp.observe_latency("tick", 0.5)
+        dp.export()
+        rates = dp.burn_rates()
+        assert abs(rates["tick"] - 5.0) < 1e-9   # 5% over / 1% budget
+        text = m.exposition()
+        assert 'crypto_trader_tpu_latency_p50_seconds{slo="tick"} 0.01' in text
+        assert 'crypto_trader_tpu_latency_p99_seconds{slo="tick"} 0.5' in text
+        assert 'crypto_trader_tpu_slo_burn_rate{slo="tick"} 5.0' in text
+        # the histogram twin for PromQL recording rules
+        assert 'crypto_trader_tpu_slo_latency_seconds_bucket{slo="tick"' in text
+
+    def test_burn_rate_needs_minimum_traffic(self):
+        """A 1-sample window that is 100% over target must NOT page:
+        burn stays 0 until min_samples observations arrive (the cold tick
+        right after process start is compile-dominated by design)."""
+        dp = devprof.DevProf(slo_targets={"tick": 0.1}, min_samples=32)
+        dp.observe_latency("tick", 60.0)
+        assert dp.burn_rates()["tick"] == 0.0
+        for _ in range(31):
+            dp.observe_latency("tick", 60.0)
+        assert dp.burn_rates()["tick"] == 100.0
+
+    def test_burn_alert_rules(self):
+        from ai_crypto_trader_tpu.utils.alerts import AlertManager
+
+        am = AlertManager(now_fn=lambda: 0.0)
+        fired = am.evaluate({"slo_burn_rates": {"tick": 20.0}})
+        assert any(a["name"] == "LatencySLOBurnRateCritical" for a in fired)
+        fired = am.evaluate({"slo_burn_rates": {"tick": 8.0}})
+        assert any(a["name"] == "LatencySLOBurnRateWarning" for a in fired)
+        am.evaluate({"slo_burn_rates": {"tick": 0.5}})
+        assert "LatencySLOBurnRateWarning" not in am.active
+        assert "LatencySLOBurnRateCritical" not in am.active
+
+
+class TestMemoryWatermark:
+    def test_sample_counts_live_buffers_and_keeps_peak(self):
+        m = MetricsRegistry()
+        dp = devprof.DevProf(metrics=m)
+        big = jnp.ones((65536,))             # 256 KB held live
+        jax.block_until_ready(big)
+        snap = dp.sample_memory()
+        dev = str(big.devices().pop() if hasattr(big, "devices")
+                  else big.device)
+        assert snap[dev]["bytes"] >= big.nbytes
+        peak = snap[dev]["peak_bytes"]
+        del big
+        snap2 = dp.sample_memory()
+        assert snap2[dev]["peak_bytes"] >= peak      # watermark is monotone
+        text = m.exposition()
+        assert "crypto_trader_tpu_live_buffer_count" in text
+        assert "crypto_trader_tpu_live_buffer_bytes_peak" in text
+
+    def test_every_device_reported_even_when_idle(self):
+        """Zero live buffers still produce a (zero) series per device —
+        a flat-zero line is a fact, a missing one is a dashboard hole."""
+        dp = devprof.DevProf()
+        snap = dp.watermark.sample()
+        assert len(snap) >= len(jax.devices())
+
+
+class TestStepTimerBounded:
+    def test_history_bounded_and_summary(self):
+        from ai_crypto_trader_tpu.utils.profiling import StepTimer
+
+        t = StepTimer(window=16)
+        for _ in range(50):
+            with t.step():
+                pass
+        assert len(t.history) == 16          # bounded on long soaks
+        assert t.count == 50                 # total preserved
+        s = t.summary()
+        assert s["count"] == 50 and s["window"] == 16
+        assert s["p99"] >= s["p50"] >= 0.0
+
+    def test_steps_feed_slo_window(self):
+        from ai_crypto_trader_tpu.utils.profiling import StepTimer
+
+        with devprof.use(devprof.DevProf()) as dp:
+            t = StepTimer(name="bench_step")
+            with t.step() as s:
+                s.block(jnp.ones((8,)) * 2)
+        assert dp.slos["bench_step"].count == 1
+
+
+class TestHeartbeatStaleness:
+    def test_continuous_staleness_registered_only(self):
+        from ai_crypto_trader_tpu.utils.health import HeartbeatRegistry
+
+        clock = {"t": 0.0}
+        hb = HeartbeatRegistry(now_fn=lambda: clock["t"])
+        hb.beat("monitor")
+        hb.expect("analyzer")                # registered, never beat
+        clock["t"] = 12.0
+        ages = hb.staleness()
+        assert ages == {"monitor": 12.0, "analyzer": 12.0}
+        hb.beat("monitor")
+        assert hb.staleness()["monitor"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the acceptance sweep: every hot-path program cards with nonzero cost and
+# (where donated) a passing donation check
+# ---------------------------------------------------------------------------
+
+class TestHotPathCostCards:
+    def test_tick_engine_card_and_donation(self):
+        from ai_crypto_trader_tpu.ops.tick_engine import TickEngine
+
+        m = MetricsRegistry()
+        # memory_analysis off: the card's AOT backend compile of the full
+        # indicator graph would double this test's compile bill for
+        # fields the assertion below doesn't need
+        with devprof.use(devprof.DevProf(metrics=m,
+                                         memory_analysis=False)) as dp:
+            T = 64
+            eng = TickEngine(["AUSDC"], ("1m",), window=T)
+            rng = np.random.default_rng(0)
+            close = 100 + np.cumsum(rng.normal(0, 0.1, T))
+            kl = [[i * 60_000, close[i] - 0.05, close[i] + 0.1,
+                   close[i] - 0.1, close[i], 50.0] for i in range(T)]
+            eng.ingest("AUSDC", "1m", kl)
+            eng.step()
+            card = dp.cards["tick_engine"]
+            assert card.error is None
+            assert card.flops > 0 and card.bytes_accessed > 0
+            assert card.donation_ok is True  # the donated ring was freed
+            # one-shot: the second step re-cards nothing and re-verifies
+            # nothing (references to a donated ring are per-first-step)
+            eng.ingest("AUSDC", "1m", kl)
+            eng.step()
+        text = m.exposition()
+        assert 'crypto_trader_tpu_program_donation_ok{program="tick_engine"} 1.0' in text
+
+    def test_epoch_trainer_card_and_donation(self):
+        from ai_crypto_trader_tpu.models.train_loop import EpochTrainer
+
+        m = MetricsRegistry()
+        with devprof.use(devprof.DevProf(metrics=m)) as dp:
+            def loss(p, xb, yb, rng):
+                return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+            tx = optax.adam(1e-3)
+            params = {"w": jnp.ones((8, 1))}
+            opt_state = tx.init(params)
+            X = jnp.ones((64, 8))
+            Y = jnp.zeros((64, 1))
+            trainer = EpochTrainer(loss, tx)
+            trainer.epoch(params, opt_state, X, Y, jax.random.PRNGKey(0),
+                          jax.random.PRNGKey(1), batch_size=16)
+            card = dp.cards["train_epoch"]
+            assert card.error is None
+            assert card.flops > 0 and card.bytes_accessed > 0
+            assert card.donation_ok is True
+            assert dp.slos["train_step"].count == 1   # amortized latency
+
+    def test_dqn_scan_card_and_donation(self):
+        from ai_crypto_trader_tpu import ops
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.rl import (
+            DQNConfig, dqn_init, make_env_params, train_iterations)
+
+        m = MetricsRegistry()
+        with devprof.use(devprof.DevProf(metrics=m,
+                                         memory_analysis=False)) as dp:
+            d = {k: jnp.asarray(v)
+                 for k, v in generate_ohlcv(n=700, seed=1).items()
+                 if k != "regime"}
+            ind = ops.compute_indicators(d)
+            cfg = DQNConfig(num_envs=4, rollout_len=2, replay_capacity=256,
+                            batch_size=8)
+            p = make_env_params(ind, episode_len=64)
+            st = dqn_init(jax.random.PRNGKey(0), p, cfg)
+            st, _ = train_iterations(p, st, cfg, n_iters=2)
+            card = dp.cards["dqn_train_iterations"]
+            assert card.error is None
+            assert card.flops > 0 and card.bytes_accessed > 0
+            assert card.donation_ok is True  # whole DQNState freed
+            # second call must still work on the donated-out state
+            st, _ = train_iterations(p, st, cfg, n_iters=2)
+            assert dp.slos["train_step"].count == 2
+
+    def test_backtest_sweep_card(self):
+        from ai_crypto_trader_tpu import ops
+        from ai_crypto_trader_tpu.backtest import (
+            prepare_inputs, sample_params, sweep)
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+
+        m = MetricsRegistry()
+        with devprof.use(devprof.DevProf(metrics=m)) as dp:
+            d = {k: jnp.asarray(v)
+                 for k, v in generate_ohlcv(n=512, seed=2).items()
+                 if k != "regime"}
+            inp = prepare_inputs(ops.compute_indicators(d))
+            params = sample_params(jax.random.PRNGKey(0), 4)
+            stats = sweep(inp, params)
+            jax.block_until_ready(stats.final_balance)
+            card = dp.cards["backtest_sweep"]
+            assert card.error is None
+            assert card.flops > 0 and card.bytes_accessed > 0
+            # the sweep card intentionally skips memory_analysis via the
+            # per-card override (it would recompile the largest program
+            # in the repo) — the shared instance flag is never touched
+            assert dp.memory_analysis is True
+            assert card.argument_bytes == 0
+
+    def test_batched_predict_card(self):
+        from ai_crypto_trader_tpu.models.train import (
+            TrainResult, fit_scaler, predict_prices_batched)
+        from ai_crypto_trader_tpu.models.zoo import build_model
+
+        m = MetricsRegistry()
+        with devprof.use(devprof.DevProf(metrics=m)) as dp:
+            feats = np.abs(np.random.default_rng(0)
+                           .normal(1.0, 0.1, (40, 5))).astype(np.float32)
+            model = build_model("lstm", units=8)
+            results = []
+            for seed in (0, 1):
+                params = model.init(jax.random.PRNGKey(seed),
+                                    jnp.ones((1, 16, 5)), False)
+                results.append(TrainResult(
+                    params=params, model_type="lstm",
+                    scaler=fit_scaler(feats),
+                    model_kwargs={"units": 8}, best_val_loss=0.1,
+                    target_col=3))
+            preds = predict_prices_batched(results, [feats, feats],
+                                           seq_len=16)
+            assert len(preds) == 2
+            card = dp.cards["predict_batched.lstm"]
+            assert card.error is None
+            assert card.flops > 0 and card.bytes_accessed > 0
+
+
+class TestLauncherIntegration:
+    def _system(self, **kw):
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        series = from_dict(generate_ohlcv(n=700, seed=5), symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series})
+        ex.advance("BTCUSDC", steps=600)
+        clock = {"t": 0.0}
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: clock["t"],
+                               **kw)
+        system.monitor.fused = False   # keep this test off the big compile
+        return system, ex, clock
+
+    def test_devprof_series_emitted_per_tick(self):
+        system, ex, clock = self._system(enable_devprof=True)
+        try:
+            for _ in range(2):
+                ex.advance("BTCUSDC")
+                clock["t"] += 60.0
+                asyncio.run(system.tick())
+            text = system.metrics.exposition()
+            for needle in (
+                    "crypto_trader_tpu_heartbeat_staleness_seconds"
+                    '{service="monitor"}',
+                    'crypto_trader_tpu_latency_p50_seconds{slo="tick"}',
+                    'crypto_trader_tpu_latency_p99_seconds{slo="tick"}',
+                    'crypto_trader_tpu_slo_burn_rate{slo="tick"}',
+                    "crypto_trader_tpu_live_buffer_bytes",
+                    "crypto_trader_tpu_live_buffer_bytes_peak",
+                    "crypto_trader_tpu_slo_latency_seconds_bucket"):
+                assert needle in text, needle
+            # cold ticks are compile-dominated: burn must NOT page yet
+            assert "LatencySLOBurnRateCritical" not in system.alerts.active
+            assert system.devprof.burn_rates().get("tick") == 0.0
+        finally:
+            system.shutdown()
+
+    def test_shutdown_releases_global(self):
+        system, _, _ = self._system(enable_devprof=True)
+        assert devprof.active() is system.devprof
+        system.shutdown()
+        assert devprof.active() is None
+
+    def test_devprof_off_by_default(self):
+        system, _, _ = self._system()
+        try:
+            assert system.devprof is None and devprof.active() is None
+        finally:
+            system.shutdown()

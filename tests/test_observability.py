@@ -118,6 +118,117 @@ class TestHistogramCumulativeBuckets:
         assert int(float(count_line.rsplit(" ", 1)[1])) == 7
 
 
+class TestExpositionFormat:
+    """Prometheus text-format fidelity: # TYPE lines, label-value
+    escaping, and strict endpoint routing — a real scrape must parse
+    every series, not just eyeball-friendly ones."""
+
+    WEIRD = 'back\\slash "quoted"\nnewline'
+
+    def _parse(self, text):
+        """Minimal Prometheus text parser: {series_key: value} with label
+        values UNescaped, plus the # TYPE map."""
+        import re
+
+        types, samples = {}, {}
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                _, _, name, mtype = line.split(" ")
+                types[name] = mtype
+                continue
+            m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$", line)
+            assert m, f"unparseable line: {line!r}"
+            labels = {}
+            if m.group(2):
+                for lm in re.finditer(r'([a-zA-Z_]+)="((?:\\.|[^"\\])*)"',
+                                      m.group(2)):
+                    labels[lm.group(1)] = (lm.group(2)
+                                           .replace("\\n", "\n")
+                                           .replace('\\"', '"')
+                                           .replace("\\\\", "\\"))
+            samples[(m.group(1), tuple(sorted(labels.items())))] = \
+                float(m.group(3))
+        return types, samples
+
+    def test_type_lines_for_every_family(self):
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        m.inc("errors_total", kind="x")
+        m.set_gauge("portfolio_value_usd", 1234.5)
+        m.observe("lat_seconds", 0.003)
+        types, _ = self._parse(m.exposition())
+        assert types["crypto_trader_tpu_errors_total"] == "counter"
+        assert types["crypto_trader_tpu_portfolio_value_usd"] == "gauge"
+        assert types["crypto_trader_tpu_lat_seconds"] == "histogram"
+
+    def test_label_values_escaped_and_round_trip(self):
+        """Backslash, double-quote and newline in a label value survive a
+        scrape: the exposition escapes them and a parser recovers the
+        original string exactly."""
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        m.inc("errors_total", kind=self.WEIRD)
+        text = m.exposition()
+        assert "\\\\" in text and '\\"' in text and "\\n" in text
+        # escaped newline: the sample must still be ONE physical line
+        sample_lines = [l for l in text.splitlines()
+                        if l.startswith("crypto_trader_tpu_errors_total")]
+        assert len(sample_lines) == 1
+        _, samples = self._parse(text)
+        key = ("crypto_trader_tpu_errors_total", (("kind", self.WEIRD),))
+        assert samples[key] == 1.0
+
+    def test_golden_histogram_parse_with_inf_bucket(self):
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        for v in (0.0005, 0.003, 0.07, 2.0):
+            m.observe("lat_seconds", v, stage='s"1')
+        types, samples = self._parse(m.exposition())
+        assert types["crypto_trader_tpu_lat_seconds"] == "histogram"
+        inf = samples[("crypto_trader_tpu_lat_seconds_bucket",
+                       (("le", "+Inf"), ("stage", 's"1')))]
+        count = samples[("crypto_trader_tpu_lat_seconds_count",
+                         (("stage", 's"1'),))]
+        assert inf == count == 4.0
+
+    def test_serve_routes_metrics_health_404(self):
+        """serve(): /metrics and /health only; anything else is 404 (it
+        used to dump the exposition for every path)."""
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        async def scenario():
+            m = MetricsRegistry()
+            m.inc("errors_total")
+            srv = await m.serve("127.0.0.1", 0)
+            port = srv.sockets[0].getsockname()[1]
+
+            async def get(path):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(f"GET {path} HTTP/1.1\r\n\r\n".encode())
+                await writer.drain()
+                data = await reader.read(1 << 16)
+                writer.close()
+                return data.decode()
+
+            metrics = await get("/metrics")
+            health = await get("/health")
+            bogus = await get("/bogus")
+            root = await get("/")
+            srv.close()
+            await srv.wait_closed()
+            return metrics, health, bogus, root
+
+        metrics, health, bogus, root = asyncio.run(scenario())
+        assert "200 OK" in metrics and "# TYPE" in metrics
+        assert "200 OK" in health and "healthy" in health
+        assert "404 Not Found" in bogus and "errors_total" not in bogus
+        assert "404 Not Found" in root
+
+
 class TestHeartbeatRegistry:
     def test_per_service_threshold_override(self):
         from ai_crypto_trader_tpu.utils.health import HeartbeatRegistry
@@ -241,7 +352,7 @@ class TestStackConfigCoherence:
                     continue
                 src = open(os.path.join(root, f)).read()
                 for m in re.finditer(
-                        r'(?:set_gauge|inc|observe)\(\s*"([a-z_]+)"', src):
+                        r'(?:set_gauge|inc|observe)\(\s*"([a-z0-9_]+)"', src):
                     names.add(m.group(1))
         return names
 
@@ -255,7 +366,7 @@ class TestStackConfigCoherence:
             for t in p.get("targets", []):
                 import re
 
-                for m in re.finditer(r"crypto_trader_tpu_([a-z_]+?)"
+                for m in re.finditer(r"crypto_trader_tpu_([a-z0-9_]+?)"
                                      r"(?:_bucket|_sum|_count)?[\{\[\)\s,]",
                                      t["expr"] + " "):
                     queried.add(m.group(1))
@@ -297,8 +408,8 @@ class TestStackConfigCoherence:
             for group in rules["groups"]:
                 for rule in group["rules"]:
                     for m in re.finditer(
-                            r"crypto_trader_tpu_([a-z_]+?)"
-                            r"(?:_bucket|_sum|_count)?(?![a-z_])",
+                            r"crypto_trader_tpu_([a-z0-9_]+?)"
+                            r"(?:_bucket|_sum|_count)?(?![a-z0-9_])",
                             rule["expr"]):
                         referenced.add(m.group(1))
             unknown = referenced - emitted

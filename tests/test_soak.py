@@ -42,7 +42,8 @@ def test_full_stack_soak(tmp_path):
     ex = FakeExchange(series, quote_balance=100_000.0, fee_rate=0.0)
     ex.advance(steps=600)              # warm history for the fixed window
     system = TradingSystem(ex, list(SYMBOLS), now_fn=lambda: clock["t"],
-                           dashboard_path=str(tmp_path / "dash.html"))
+                           dashboard_path=str(tmp_path / "dash.html"),
+                           enable_devprof=True)
     # permissive gates so the loop actually trades during the soak
     system.executor.trading = TradingParams(ai_confidence_threshold=0.0,
                                             min_signal_strength=0.0,
@@ -156,6 +157,24 @@ def test_full_stack_soak(tmp_path):
         assert system.bus.get("risk_metrics")["n_assets"] == 2
         assert len(system.bus.get("portfolio_value_history")) == 500  # bounded
         assert (tmp_path / "dash.html").exists()
+
+        # 5b. the device-runtime observatory survived the whole soak:
+        #     SLO windows stayed bounded, the tick burn rate did not page
+        #     in steady state, the per-device live-memory watermark is
+        #     populated, and every carded donated program verified
+        dp = system.devprof
+        tick_q = dp.slos["tick"]
+        assert tick_q.count >= TICKS and len(tick_q.buf) <= dp.window
+        assert "LatencySLOBurnRateCritical" not in system.alerts.active
+        assert dp.watermark.peak_bytes            # at least one device row
+        assert dp.donation_failures == []
+        for name, card in dp.cards.items():
+            assert card.error is None, (name, card.error)
+            if card.donation_ok is not None:
+                assert card.donation_ok, f"{name} donation silently copied"
+        text = system.metrics.exposition()
+        assert 'crypto_trader_tpu_latency_p99_seconds{slo="tick"}' in text
+        assert "crypto_trader_tpu_live_buffer_bytes_peak" in text
 
         # 6. the dashboard still renders every panel family at the end
         import urllib.request
