@@ -52,15 +52,25 @@ def estimate_mu_sigma(returns: jnp.ndarray, periods_per_year: float = PERIODS_PE
 
 @functools.partial(jax.jit, static_argnames=("days", "num_sims"))
 def simulate_gbm(key, initial_price, mu, sigma, days: int, num_sims: int,
-                 dt: float = 1.0 / PERIODS_PER_YEAR):
+                 dt: float = 1.0 / PERIODS_PER_YEAR,
+                 shock_shift=None, shock_vol=None):
     """GBM paths, shape [num_sims, days]; paths[:, 0] == initial_price.
 
     Same recursion as the reference timestep loop
     (`monte_carlo_service.py:266-273`) solved in closed form:
     S_t = S_0 · exp(Σ ((μ-σ²/2)dt + σ√dt·Z)).
+
+    ``shock_shift`` / ``shock_vol`` ([num_sims, days-1], from
+    `sim/scenarios.mc_schedule`) are the stress-mode channels: an additive
+    log-return injection and a per-step vol multiplier.  None (the
+    default) traces to exactly the unstressed program.
     """
     z = jax.random.normal(key, (num_sims, days - 1))
+    if shock_vol is not None:
+        z = z * shock_vol
     inc = (mu - 0.5 * sigma**2) * dt + sigma * jnp.sqrt(dt) * z
+    if shock_shift is not None:
+        inc = inc + shock_shift
     log_path = jnp.concatenate(
         [jnp.zeros((num_sims, 1)), jnp.cumsum(inc, axis=-1)], axis=-1
     )
@@ -69,16 +79,21 @@ def simulate_gbm(key, initial_price, mu, sigma, days: int, num_sims: int,
 
 @functools.partial(jax.jit, static_argnames=("days", "num_sims", "log_returns"))
 def simulate_bootstrap(key, initial_price, returns, days: int, num_sims: int,
-                       log_returns: bool = True):
+                       log_returns: bool = True,
+                       shock_shift=None, shock_vol=None):
     """Historical bootstrap: resample past returns with replacement
     (`monte_carlo_service.py:275-298`) — the per-simulation Python loop
-    becomes one gather + cumsum."""
+    becomes one gather + cumsum.  Stress channels as in `simulate_gbm`."""
     idx = jax.random.randint(key, (num_sims, days - 1), 0, returns.shape[-1])
     sampled = returns[idx]
     if log_returns:
         log_inc = sampled
     else:
         log_inc = jnp.log1p(sampled)
+    if shock_vol is not None:
+        log_inc = log_inc * shock_vol
+    if shock_shift is not None:
+        log_inc = log_inc + shock_shift
     log_path = jnp.concatenate(
         [jnp.zeros((num_sims, 1)), jnp.cumsum(log_inc, axis=-1)], axis=-1
     )
@@ -123,12 +138,20 @@ def path_statistics(paths, initial_price, confidence: float = 0.95):
 def run_simulation(key, initial_price, returns, *, days: int = 30,
                    num_sims: int = 1_000, scenario: str = "base",
                    scenarios: dict | None = None, method: str = "gbm",
-                   confidence: float = 0.95) -> dict:
+                   confidence: float = 0.95, stress: str | None = None,
+                   stress_seed: int = 0) -> dict:
     """Full single-asset simulation: estimate params → apply scenario
     multipliers → simulate → statistics.  One fused device program.
 
     `scenarios` maps name → (drift_factor, volatility_factor); defaults to
     the reference's five (config.json:97-103 via config.MonteCarloParams).
+
+    `stress` routes the paths through a `sim/scenarios.py` shock schedule
+    (a preset name like "flash_crash" / "black_swan", or a ScenarioSpec):
+    every simulated path gets its own randomized crash/vol-shock overlay
+    on top of the estimated dynamics — tail risk from markets that never
+    happened, surfaced as stress-VaR/CVaR via `risk/var.stress_var_cvar`.
+    ``stress=None`` (default) runs the exact unstressed program.
     """
     from ai_crypto_trader_tpu.config import MonteCarloParams
 
@@ -136,15 +159,27 @@ def run_simulation(key, initial_price, returns, *, days: int = 30,
     drift_f, vol_f = scenarios[scenario]
     mu, sigma = estimate_mu_sigma(jnp.asarray(returns))
     mu, sigma = mu * drift_f, sigma * vol_f
+    shift = vol_mult = None
+    if stress is not None:
+        from ai_crypto_trader_tpu.sim.scenarios import mc_schedule
+
+        shift_np, vol_np = mc_schedule(stress, num_sims, days - 1,
+                                       seed=stress_seed)
+        shift, vol_mult = jnp.asarray(shift_np), jnp.asarray(vol_np)
     if method == "gbm":
-        paths = simulate_gbm(key, initial_price, mu, sigma, days, num_sims)
+        paths = simulate_gbm(key, initial_price, mu, sigma, days, num_sims,
+                             shock_shift=shift, shock_vol=vol_mult)
     elif method in ("bootstrap", "historical"):
-        paths = simulate_bootstrap(key, initial_price, jnp.asarray(returns), days, num_sims)
+        paths = simulate_bootstrap(key, initial_price, jnp.asarray(returns),
+                                   days, num_sims,
+                                   shock_shift=shift, shock_vol=vol_mult)
     else:
         raise ValueError(f"unknown simulation method {method!r}")
     stats = path_statistics(paths, initial_price, confidence)
     stats.update({"mu": mu, "sigma": sigma, "scenario": scenario,
                   "drift_factor": drift_f, "volatility_factor": vol_f,
+                  "stress": (stress if isinstance(stress, (str, type(None)))
+                             else getattr(stress, "name", str(stress))),
                   "paths": paths})
     return stats
 

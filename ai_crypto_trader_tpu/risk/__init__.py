@@ -6,6 +6,7 @@ from ai_crypto_trader_tpu.risk.var import (  # noqa: F401
     historical_var,
     parametric_var,
     portfolio_var,
+    stress_var_cvar,
 )
 from ai_crypto_trader_tpu.risk.stops import (  # noqa: F401
     TrailingStopState,

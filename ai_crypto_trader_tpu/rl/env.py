@@ -6,7 +6,11 @@ anywhere in the reference repo** (SURVEY §2.3) — the env is implicit.  This
 module supplies it as a pure functional environment over precomputed market
 feature arrays, designed for massive vmap: thousands of independent episodes
 (different start offsets) step in lock-step on one TPU core, Anakin/Podracer
-style (PAPERS.md: "Podracer architectures for scalable RL").
+style (PAPERS.md: "Podracer architectures for scalable RL").  The feature
+tables may carry a leading scenario axis ([S, T], built by
+`sim/engine.scenario_env_params` from adversarial generated markets): each
+reset then draws a (scenario, offset) pair, so training data is scenario-
+diverse, not one replayed history.
 
 Action space mirrors the reference agent (BUY=0 / HOLD=1 / SELL=2,
 `reinforcement_learning.py:292-318`); long-only single position; reward =
@@ -32,8 +36,8 @@ OBS_SIZE = 10
 
 
 class EnvParams(NamedTuple):
-    close: jnp.ndarray       # [T]
-    obs_table: jnp.ndarray   # [T, OBS_SIZE-2] market features (position
+    close: jnp.ndarray       # [T], or [S, T] for a scenario-diverse env
+    obs_table: jnp.ndarray   # [(S,) T, OBS_SIZE-2] market features (position
                              # features are appended dynamically)
     episode_len: int
     fee_rate: jnp.ndarray    # taker fee fraction per side
@@ -45,15 +49,22 @@ class EnvState(NamedTuple):
     in_pos: jnp.ndarray      # bool
     entry: jnp.ndarray
     balance: jnp.ndarray     # equity in quote units (starts at 1.0)
+    scen: jnp.ndarray        # scenario row (0 on a single-path env)
 
 
 def make_env_params(ind: dict, episode_len: int = 256,
                     fee_rate: float = 0.0) -> EnvParams:
-    """Build the feature table from a compute_indicators() dict."""
+    """Build the feature table from a compute_indicators() dict.
+
+    ``ind`` arrays may carry a leading scenario axis ([S, T] — the
+    `sim/engine.scenario_env_params` path): the env then samples a
+    scenario per episode on reset, so vmapped training sees S different
+    adversarial markets instead of one replayed history."""
     close = ind["close"]
-    ret1 = jnp.diff(close, prepend=close[:1]) / close
-    ret5 = (close - jnp.roll(close, 5)) / jnp.roll(close, 5)
-    ret5 = ret5.at[:5].set(0.0) if hasattr(ret5, "at") else ret5
+    ret1 = jnp.diff(close, prepend=close[..., :1], axis=-1) / close
+    prev5 = jnp.roll(close, 5, axis=-1)
+    ret5 = (close - prev5) / prev5
+    ret5 = ret5.at[..., :5].set(0.0)
     obs = jnp.stack([
         ind["rsi"] / 100.0,
         ind["stoch_k"] / 100.0,
@@ -69,9 +80,19 @@ def make_env_params(ind: dict, episode_len: int = 256,
                      fee_rate=jnp.asarray(fee_rate, jnp.float32))
 
 
+def _lane(p: EnvParams, s: EnvState):
+    """This episode's [T] close / [T, F] obs slices — the scenario row when
+    the params are batched, the whole table otherwise (ndim is static
+    under jit, so single-path envs compile to exactly the old program)."""
+    if p.close.ndim == 2:
+        return p.close[s.scen], p.obs_table[s.scen]
+    return p.close, p.obs_table
+
+
 def _observe(p: EnvParams, s: EnvState) -> jnp.ndarray:
-    market = p.obs_table[s.t]
-    unreal = jnp.where(s.in_pos, (p.close[s.t] - s.entry) / s.entry, 0.0)
+    close, obs_table = _lane(p, s)
+    market = obs_table[s.t]
+    unreal = jnp.where(s.in_pos, (close[s.t] - s.entry) / s.entry, 0.0)
     return jnp.concatenate([
         market,
         jnp.stack([s.in_pos.astype(jnp.float32), unreal * 100.0]),
@@ -80,12 +101,18 @@ def _observe(p: EnvParams, s: EnvState) -> jnp.ndarray:
 
 @functools.partial(jax.jit, static_argnames=())
 def env_reset(p: EnvParams, key) -> tuple[EnvState, jnp.ndarray]:
-    """Random start offset so vmapped episodes decorrelate."""
-    T = p.close.shape[0]
+    """Random start offset so vmapped episodes decorrelate; on a
+    scenario-batched env a random scenario row is drawn too."""
+    T = p.close.shape[-1]
+    if p.close.ndim == 2:
+        k_scen, key = jax.random.split(key)
+        scen = jax.random.randint(k_scen, (), 0, p.close.shape[0])
+    else:
+        scen = jnp.asarray(0, jnp.int32)
     start = jax.random.randint(key, (), 0, jnp.maximum(T - p.episode_len - 1, 1))
     s = EnvState(t=start, start=start, in_pos=jnp.asarray(False),
                  entry=jnp.asarray(0.0, jnp.float32),
-                 balance=jnp.asarray(1.0, jnp.float32))
+                 balance=jnp.asarray(1.0, jnp.float32), scen=scen)
     return s, _observe(p, s)
 
 
@@ -93,9 +120,10 @@ def env_reset(p: EnvParams, key) -> tuple[EnvState, jnp.ndarray]:
 def env_step(p: EnvParams, s: EnvState, action) -> tuple[EnvState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(state, action) → (state', obs', reward, done). Pure; vmap over the
     leading axis of states for parallel envs."""
-    price = p.close[s.t]
+    close, _ = _lane(p, s)
+    price = close[s.t]
     next_t = s.t + 1
-    next_price = p.close[next_t]
+    next_price = close[next_t]
 
     open_now = (action == BUY) & ~s.in_pos
     close_now = (action == SELL) & s.in_pos
@@ -115,8 +143,8 @@ def env_step(p: EnvParams, s: EnvState, action) -> tuple[EnvState, jnp.ndarray, 
     balance = s.balance * (1.0 + reward)
     # Terminal: episode budget exhausted OR end of data (without the latter,
     # an episode longer than the series would run forever on a clamped index).
-    done = ((next_t - s.start) >= p.episode_len) | (next_t >= p.close.shape[0] - 1)
+    done = ((next_t - s.start) >= p.episode_len) | (next_t >= p.close.shape[-1] - 1)
 
     s2 = EnvState(t=next_t, start=s.start, in_pos=in_pos,
-                  entry=entry, balance=balance)
+                  entry=entry, balance=balance, scen=s.scen)
     return s2, _observe(p, s2), reward, done

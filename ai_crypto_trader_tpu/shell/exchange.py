@@ -15,6 +15,7 @@ from __future__ import annotations
 import itertools
 import random
 import time
+import zlib
 from abc import ABC, abstractmethod
 from typing import Any, Callable
 
@@ -175,7 +176,11 @@ class FakeExchange(ExchangeInterface):
         mid = c["close"]
         spread = max(mid * 1e-4, 1e-8)
         levels = np.arange(1, limit + 1)
-        rng = np.random.default_rng(self.cursor[symbol])  # deterministic per candle
+        # deterministic per (symbol, candle): the symbol is mixed into the
+        # seed (stable crc32, not salted hash()) so two symbols at the same
+        # cursor don't serve identically-shaped books
+        rng = np.random.default_rng(
+            (zlib.crc32(symbol.encode()), self.cursor[symbol]))
         sizes = c["volume"] / limit * np.exp(-levels / limit) * (1 + 0.3 * rng.random(limit))
         bids = [[mid - spread * i, float(s)] for i, s in zip(levels, sizes)]
         asks = [[mid + spread * i, float(s)] for i, s in zip(levels, sizes)]
@@ -285,8 +290,13 @@ class FakeExchange(ExchangeInterface):
                     fill_price = o["limit_price"] or o["stop_price"]
             if fill_price is not None:
                 qty = o["quantity"]
+                # `is not None`, not truthiness: a cap of exactly 0.0 means
+                # NO liquidity this candle (the sim's schedule can drive the
+                # cap to zero), not "uncapped"
                 fill_qty = (min(qty, self.max_fill_base)
-                            if self.max_fill_base else qty)
+                            if self.max_fill_base is not None else qty)
+                if fill_qty <= 0.0:
+                    continue               # zero-liquidity candle: rests on
                 result = self._fill({**o, "quantity": fill_qty}, fill_price)
                 if result["status"] == "FILLED":
                     if fill_qty < qty:

@@ -1,0 +1,34 @@
+"""Device-resident market simulator: thousands of adversarial scenarios
+per dispatch (ROADMAP open item 2, JAX-LOB direction — arXiv:2308.13289).
+
+Layering (host → device):
+
+  scenarios.py   named stress presets → dense per-candle shock-schedule
+                 arrays [B, T] (NumPy only; nothing here touches jax)
+  paths.py       traced scenario path generators: regime-switching GBM
+                 and bootstrapped historical candles with the shock
+                 schedules injected (shares the regime chain with
+                 data/synthetic.py)
+  exchange.py    traced candle-granularity matching — market/limit/stop
+                 fills against high/low, fees, per-candle liquidity
+                 caps, partial fills — mirroring FakeExchange semantics
+                 (`shell/exchange.py`), the scalar parity oracle
+  engine.py      the vmapped strategy-vs-market rollout: ONE jitted
+                 dispatch for the whole scenario batch, donated
+                 schedules, one host readback, devprof cost card
+
+See docs/SIMULATOR.md for the scenario spec, the parity-oracle pattern,
+and bench rows.
+"""
+
+from ai_crypto_trader_tpu.sim.scenarios import (  # noqa: F401
+    PRESETS,
+    ScenarioSpec,
+    Shock,
+    ShockSchedule,
+    compile_schedules,
+    mc_schedule,
+    mixed_schedules,
+    preset,
+    preset_names,
+)

@@ -1,0 +1,457 @@
+"""The vmapped strategy-vs-market rollout: one dispatch, thousands of
+adversarial scenarios.
+
+`sweep()` is the headline entry: path generation (sim/paths.py), matching
+(sim/exchange.py) and the strategy's decision loop are ONE jitted program
+— `lax.scan` over candles inside `vmap` over scenarios — so 4k–10k
+regime-switching / flash-crash / liquidity-hole markets evaluate per
+dispatch with ONE host readback (the `host_read` seam below, the
+`ops/tick_engine.py` pattern).  The shock-schedule arrays are donated and
+aliased onto the program's [B, T] outputs (candles + equity curve, kept
+device-resident), so the sweep never holds two copies of the big buffers
+at 10k×1k scale.  The first carded dispatch publishes a `sim_sweep`
+devprof cost card and verifies the donation actually freed the inputs.
+
+The rolled-out strategy is a deliberately simple, *parity-mirrorable*
+long-only EMA-cross with protective STOP + take-profit LIMIT orders: every
+decision is a pure function of the candle close and the exchange state, so
+tests/test_sim.py can drive `FakeExchange` through the identical decisions
+host-side and pin the sim trade-by-trade (fills, fees, final equity) —
+the scalar parity oracle ISSUE 7 requires.  Realism lives in the MARKET
+(the scenario batch), not in strategy cleverness.
+
+Two more workloads ride the same generators:
+
+  * `backtest_under_stress` — the full `backtest/engine.py` scan (signals,
+    SL/TP ladder, streaks) vmapped over adversarial candle batches, and
+    optionally over a strategy-parameter population too ([B, P] stats);
+  * `scenario_env_params` — a scenario-diverse `rl/env.py` EnvParams
+    ([B, T] close/obs tables; `env_reset` samples a scenario per episode),
+    the Anakin-style env breadth ROADMAP item 3 builds on.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_crypto_trader_tpu.sim import exchange as sx
+from ai_crypto_trader_tpu.sim import paths, scenarios
+from ai_crypto_trader_tpu.utils import devprof
+
+# slot layout the strategy uses (and the parity oracle mirrors): the stop
+# is placed first so FakeExchange's insertion-ordered matching walks the
+# orders in the same sequence as the unrolled slot loop
+STOP_SLOT, TP_SLOT = 0, 1
+N_SLOTS = 2
+WARMUP = 32
+
+
+def host_read(tree):
+    """THE per-sweep device→host sync (module seam so tests can count it;
+    the tick-engine pattern).  Timed into the `host_read` SLO window."""
+    t0 = time.perf_counter()
+    out = jax.device_get(tree)
+    devprof.observe_latency("host_read", time.perf_counter() - t0)
+    return out
+
+
+class SimStrategy(NamedTuple):
+    """EMA-cross long-only strategy knobs (all f32 — broadcastable, so a
+    per-scenario batch of strategies vmaps just like the market does)."""
+
+    alpha_fast: jnp.ndarray     # fast EMA smoothing
+    alpha_slow: jnp.ndarray
+    entry_margin: jnp.ndarray   # enter when ema_fast > ema_slow·(1+margin)
+    sl_pct: jnp.ndarray         # protective stop distance, percent
+    tp_pct: jnp.ndarray         # take-profit distance, percent
+    trade_frac: jnp.ndarray     # fraction of quote committed per entry
+    min_notional: jnp.ndarray   # quote value under which a book is "flat"
+
+
+def default_strategy(alpha_fast: float = 2.0 / 13.0,
+                     alpha_slow: float = 2.0 / 49.0,
+                     entry_margin: float = 0.001, sl_pct: float = 2.0,
+                     tp_pct: float = 4.0, trade_frac: float = 0.25,
+                     min_notional: float = 1.0) -> SimStrategy:
+    f = lambda v: jnp.asarray(v, jnp.float32)  # noqa: E731
+    return SimStrategy(alpha_fast=f(alpha_fast), alpha_slow=f(alpha_slow),
+                       entry_margin=f(entry_margin), sl_pct=f(sl_pct),
+                       tp_pct=f(tp_pct), trade_frac=f(trade_frac),
+                       min_notional=f(min_notional))
+
+
+class FillParams(NamedTuple):
+    fee_rate: jnp.ndarray
+    max_fill_base: jnp.ndarray   # per-candle per-order cap (inf = none)
+
+
+def fill_params(fee_rate: float = 0.001,
+                max_fill_base: float | None = 10.0) -> FillParams:
+    """``max_fill_base`` defaults FINITE (generous — far above the default
+    strategy's position sizes) rather than inf: the schedule's liquidity
+    holes scale this cap, and inf × liquidity_mult stays inf, which would
+    silently turn every liquidity-hole scenario into calm.  Pass None for
+    FakeExchange's uncapped default."""
+    cap = np.inf if max_fill_base is None else max_fill_base
+    return FillParams(fee_rate=jnp.asarray(fee_rate, jnp.float32),
+                      max_fill_base=jnp.asarray(cap, jnp.float32))
+
+
+class StratState(NamedTuple):
+    ema_fast: jnp.ndarray
+    ema_slow: jnp.ndarray
+    entry: jnp.ndarray        # intended entry price of the live position
+    entries: jnp.ndarray      # i32 count of entry orders submitted
+
+
+def _strategy_step(strat: SimStrategy, st: StratState, exch: sx.ExchState,
+                   close, t, halt):
+    """The mirrorable decision rule.  Returns (state', requests) where the
+    requests dict drives the exchange calls in `_rollout_step` — and, in
+    the parity test, the identical FakeExchange calls."""
+    ema_fast = jnp.where(t == 0, close,
+                         st.ema_fast + strat.alpha_fast
+                         * (close - st.ema_fast))
+    ema_slow = jnp.where(t == 0, close,
+                         st.ema_slow + strat.alpha_slow
+                         * (close - st.ema_slow))
+    flat = exch.base * close < strat.min_notional
+    any_resting = exch.book.active.any()
+    open_venue = halt == 0.0
+
+    # post-exit hygiene: a flat book with resting protective orders means
+    # the position closed last candle — cancel the surviving sibling(s)
+    cancel_all = flat & any_resting & open_venue
+
+    cross = ema_fast > ema_slow * (1.0 + strat.entry_margin)
+    enter = (flat & ~(any_resting & ~cancel_all) & ~exch.pend_active
+             & cross & (t >= WARMUP) & open_venue)
+    entry_qty = strat.trade_frac * exch.quote / close
+
+    # protective placement: a live position with no resting orders gets a
+    # STOP (slot 0) + take-profit LIMIT (slot 1) sized to current holdings
+    protect = ~flat & ~any_resting & open_venue
+    stop_price = st.entry * (1.0 - strat.sl_pct / 100.0)
+    tp_price = st.entry * (1.0 + strat.tp_pct / 100.0)
+
+    st2 = StratState(ema_fast=ema_fast, ema_slow=ema_slow,
+                     entry=jnp.where(enter, close, st.entry),
+                     entries=st.entries + enter.astype(jnp.int32))
+    req = {"cancel_all": cancel_all, "enter": enter, "entry_qty": entry_qty,
+           "protect": protect, "stop_price": stop_price,
+           "tp_price": tp_price}
+    return st2, req
+
+
+def _requests_to_action(exch: sx.ExchState, req: dict) -> sx.Action:
+    a = sx.no_action(N_SLOTS)
+    place = jnp.zeros((N_SLOTS,), bool).at[STOP_SLOT].set(req["protect"]) \
+        .at[TP_SLOT].set(req["protect"])
+    sell = jnp.full((N_SLOTS,), sx.SELL, jnp.int32)
+    kind = jnp.zeros((N_SLOTS,), jnp.int32).at[STOP_SLOT].set(sx.STOP) \
+        .at[TP_SLOT].set(sx.LIMIT)
+    qty = jnp.full((N_SLOTS,), exch.base, jnp.float32)
+    limit_price = jnp.zeros((N_SLOTS,), jnp.float32) \
+        .at[TP_SLOT].set(req["tp_price"])
+    stop_price = jnp.zeros((N_SLOTS,), jnp.float32) \
+        .at[STOP_SLOT].set(req["stop_price"])
+    return a._replace(
+        market_qty=jnp.where(req["enter"], req["entry_qty"], 0.0),
+        market_side=jnp.asarray(sx.BUY, jnp.int32),
+        cancel=jnp.broadcast_to(req["cancel_all"], (N_SLOTS,)),
+        place=place, side=sell, kind=kind, qty=qty,
+        limit_price=limit_price, stop_price=stop_price)
+
+
+class RolloutSummary(NamedTuple):
+    """Per-scenario outcomes, every leaf [B]."""
+
+    final_equity: jnp.ndarray
+    final_quote: jnp.ndarray
+    final_base: jnp.ndarray
+    fees: jnp.ndarray
+    n_fills: jnp.ndarray
+    dropped_fills: jnp.ndarray
+    entries: jnp.ndarray
+    max_drawdown: jnp.ndarray   # fraction of the running equity peak
+    min_equity: jnp.ndarray
+
+
+def _rollout_one(candles: dict, sched: dict, strat: SimStrategy,
+                 fp: FillParams, quote0, log_capacity: int):
+    """One scenario's full rollout (arrays [T]); vmapped over B.  Returns
+    (summary, fill log, per-step equity curve)."""
+    T = candles["close"].shape[-1]
+    exch0 = sx.init_state(quote0, K=N_SLOTS, L=log_capacity)
+    strat0 = StratState(ema_fast=jnp.asarray(0.0, jnp.float32),
+                        ema_slow=jnp.asarray(0.0, jnp.float32),
+                        entry=jnp.asarray(0.0, jnp.float32),
+                        entries=jnp.asarray(0, jnp.int32))
+    eq0 = sx.equity(exch0, candles["close"][0])
+    acct0 = (eq0, jnp.asarray(0.0, jnp.float32), eq0)  # peak, max_dd, min_eq
+
+    def step(carry, xs):
+        exch, st, (peak, max_dd, min_eq) = carry
+        candle, sched_t, t = xs
+        halt, latency = sched_t["halt"], sched_t["latency"]
+        spread = sched_t["spread"]
+        cap = fp.max_fill_base * sched_t["liquidity_mult"]
+        exch = sx.settle_pending(exch, candle, t, fp.fee_rate, spread, halt)
+        exch = sx.match_candle(exch, candle, t, cap, halt, fp.fee_rate)
+        st, req = _strategy_step(strat, st, exch, candle["close"], t, halt)
+        exch = sx.apply_action(exch, candle, t, _requests_to_action(exch, req),
+                               fp.fee_rate, spread, halt, latency)
+        eq = sx.equity(exch, candle["close"])
+        peak = jnp.maximum(peak, eq)
+        acct = (peak, jnp.maximum(max_dd, (peak - eq) / peak),
+                jnp.minimum(min_eq, eq))
+        return (exch, st, acct), eq
+
+    xs = ({k: candles[k] for k in ("open", "high", "low", "close")},
+          sched, jnp.arange(T, dtype=jnp.int32))
+    (exch, st, (peak, max_dd, min_eq)), equity_curve = jax.lax.scan(
+        step, (exch0, strat0, acct0), xs)
+    summary = RolloutSummary(
+        final_equity=sx.equity(exch, candles["close"][-1]),
+        final_quote=exch.quote, final_base=exch.base, fees=exch.fee_paid,
+        n_fills=exch.n_fills, dropped_fills=exch.dropped_fills,
+        entries=st.entries, max_drawdown=max_dd, min_equity=min_eq)
+    return summary, exch.fills, equity_curve
+
+
+_SCHED_TRADE_KEYS = ("liquidity_mult", "spread", "halt", "latency")
+
+
+@functools.partial(jax.jit, static_argnames=("log_capacity",),
+                   donate_argnums=(1,))
+def _sweep_jit(key, sched: dict, strat: SimStrategy, fp: FillParams,
+               pp: paths.PathParams, quote0, log_capacity: int = 128):
+    """The one-dispatch sweep: generate [B, T] scenario candles AND roll
+    every scenario's exchange+strategy forward, in a single program.
+
+    The schedule dict (six [B, T] f32 channels) is donated, and the
+    program returns six [B, T] f32 arrays (OHLCV candles + the equity
+    curve) that XLA aliases onto those donated buffers — real in-place
+    reuse, not a decorative donate flag (the devprof verifier would catch
+    a silent copy).  The big outputs stay DEVICE-resident on the host
+    side: `sweep` reads back only the summary, so the one host sync stays
+    [B]-sized at any T."""
+    candles = paths.gbm_candles_traced(key, sched["logret_shift"],
+                                       sched["vol_mult"], pp)
+    trade_sched = {k: sched[k] for k in _SCHED_TRADE_KEYS}
+    summary, fills, equity_curve = jax.vmap(
+        lambda c, s: _rollout_one(c, s, strat, fp, quote0, log_capacity)
+    )({k: candles[k] for k in ("open", "high", "low", "close")},
+      trade_sched)
+    return {"summary": summary._asdict(),
+            "fills": fills,
+            "equity_curve": equity_curve,
+            "candles": {k: candles[k]
+                        for k in ("open", "high", "low", "close", "volume")}}
+
+
+@functools.partial(jax.jit, static_argnames=("log_capacity",))
+def _rollout_candles_jit(candles: dict, sched: dict, strat: SimStrategy,
+                         fp: FillParams, quote0, log_capacity: int = 128):
+    """Rollout on PRE-BUILT candles (no path generation, no donation) —
+    the entry the FakeExchange parity oracle drives, so both sides consume
+    bit-identical candle buffers."""
+    summary, fills, equity_curve = jax.vmap(
+        lambda c, s: _rollout_one(c, s, strat, fp, quote0, log_capacity)
+    )({k: jnp.asarray(candles[k]) for k in ("open", "high", "low", "close")},
+      sched)
+    return {"summary": summary._asdict(), "fills": fills,
+            "equity_curve": equity_curve}
+
+
+def _schedule_dict(sched: scenarios.ShockSchedule) -> dict:
+    return {k: jnp.asarray(getattr(sched, k))
+            for k in scenarios.ShockSchedule._fields}
+
+
+def rollout_candles(candles: dict, schedule=None, strategy=None,
+                    fills_params=None, quote_balance: float = 10_000.0,
+                    log_capacity: int = 128) -> dict:
+    """Host entry for the fixed-candle rollout (parity/property tests).
+    ``candles`` values are [B, T]; ``schedule`` defaults to calm.  The
+    whole result (fill logs included) is read back — test-scale B only."""
+    B, T = np.asarray(candles["close"]).shape
+    sched = schedule or scenarios.compile_schedules("calm", B, T)
+    trade_sched = {k: jnp.asarray(getattr(sched, k))
+                   for k in _SCHED_TRADE_KEYS}
+    out = _rollout_candles_jit(candles, trade_sched,
+                               strategy or default_strategy(),
+                               fills_params or fill_params(),
+                               jnp.asarray(quote_balance, jnp.float32),
+                               log_capacity=log_capacity)
+    return host_read(out)
+
+
+def sweep(key, scenario="mixed", num_scenarios: int = 4096,
+          steps: int = 512, strategy: SimStrategy | None = None,
+          fills_params: FillParams | None = None,
+          path_parameters: paths.PathParams | None = None,
+          quote_balance: float = 10_000.0, seed: int = 0,
+          log_capacity: int = 128, return_fills: bool = False) -> dict:
+    """Run ``num_scenarios`` adversarial markets as ONE jitted dispatch.
+
+    ``scenario`` is a preset name, a list of names, "mixed" (round-robin
+    over every preset), a ScenarioSpec, or a ready ShockSchedule.  Returns
+    the host-side summary dict ([B] arrays) plus ``labels`` (scenario name
+    per row) and ``stats`` (dispatch accounting, the tick-engine shape).
+    """
+    labels = None
+    if isinstance(scenario, scenarios.ShockSchedule):
+        sched = scenario
+    elif scenario == "mixed" or isinstance(scenario, (list, tuple)):
+        names = None if scenario == "mixed" else list(scenario)
+        sched, labels = scenarios.mixed_schedules(names, num_scenarios,
+                                                  steps, seed=seed)
+    else:
+        sched = scenarios.compile_schedules(scenario, num_scenarios, steps,
+                                            seed=seed)
+        name = scenario if isinstance(scenario, str) else scenario.name
+        labels = [name] * sched.num_scenarios
+    strat = strategy or default_strategy()
+    fp = fills_params or fill_params()
+    pp = path_parameters or paths.path_params()
+    quote0 = jnp.asarray(quote_balance, jnp.float32)
+
+    sched_dev = _schedule_dict(sched)
+    upload_bytes = sum(int(np.asarray(getattr(sched, k)).nbytes)
+                       for k in scenarios.ShockSchedule._fields)
+    carding = (devprof.active() is not None
+               and not devprof.has_card("sim_sweep"))
+    if carding:
+        # FLOPs/bytes only: at 10k×1k the sweep is one of the biggest
+        # programs in the repo, and memory_analysis would AOT-compile it a
+        # second time (the backtest.sweep precedent in utils/devprof.py)
+        devprof.cost_card("sim_sweep", _sweep_jit, key, sched_dev, strat,
+                          fp, pp, quote0, log_capacity=log_capacity,
+                          _memory_analysis=False)
+    donated = list(sched_dev.values()) if carding else None
+    t0 = time.perf_counter()
+    out = _sweep_jit(key, sched_dev, strat, fp, pp, quote0,
+                     log_capacity=log_capacity)
+    if donated is not None:
+        devprof.verify_donation("sim_sweep", donated)
+    # ONE [B]-sized host readback: candles / equity curves / fill logs stay
+    # device-resident under "device" (fetch on demand; at 10k × 1k they are
+    # the donated-buffer reuse, not something to drag over the host link)
+    fetch = {"summary": out["summary"]}
+    if return_fills:
+        fetch["fills"] = out["fills"]
+    host = host_read(fetch)
+    wall = time.perf_counter() - t0
+    devprof.observe_latency("sim_sweep", wall)
+    host["device"] = {"candles": out["candles"],
+                      "equity_curve": out["equity_curve"],
+                      **({} if return_fills else {"fills": out["fills"]})}
+    host["labels"] = labels
+    host["stats"] = {"dispatches": 1, "scenarios": sched.num_scenarios,
+                     "steps": sched.steps, "upload_bytes": upload_bytes,
+                     "wall_s": wall}
+    return host
+
+
+# --------------------------------------------------------------------------
+# workload 2: the full backtest engine against adversarial markets
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("population", "warmup",
+                                             "use_param_sl_tp"))
+def _stress_backtest_jit(candles: dict, params, initial_balance,
+                         population: bool = False, warmup: int = 10,
+                         use_param_sl_tp: bool = False):
+    from ai_crypto_trader_tpu import ops
+    from ai_crypto_trader_tpu.backtest import signals as sig
+    from ai_crypto_trader_tpu.backtest.engine import (BacktestInputs,
+                                                      _run_backtest_jit)
+
+    ind = ops.compute_indicators(
+        {k: candles[k] for k in ("open", "high", "low", "close", "volume")})
+    feats = sig.compute_signal_features(ind)
+    signal, strength = sig.reference_signal(feats)
+    close = feats.close
+    nan = jnp.full_like(close, jnp.nan)
+    inputs = BacktestInputs(
+        close=close, signal=signal, strength=strength,
+        volatility=feats.volatility, volume=feats.volume,
+        confidence=jnp.ones_like(close), decision=signal,
+        sl_pct=nan, tp_pct=nan)
+
+    def one(inp):
+        run = lambda p: _run_backtest_jit(  # noqa: E731
+            inp, p, initial_balance=initial_balance, warmup=warmup,
+            use_param_sl_tp=use_param_sl_tp)
+        if population:
+            return jax.vmap(run)(params)
+        return run(params)
+
+    return jax.vmap(one)(inputs)
+
+
+def backtest_under_stress(key, scenario="mixed", num_scenarios: int = 256,
+                          steps: int = 1024, params=None,
+                          initial_balance: float = 10_000.0,
+                          seed: int = 0):
+    """Evaluate the real backtest engine over a batch of adversarial
+    markets: [B] stats (or [B, P] with a stacked StrategyParams
+    population) — scenario-quantile robustness instead of one historical
+    path.  Returns (stats, summary) with host-side robustness quantiles.
+    """
+    if isinstance(scenario, scenarios.ShockSchedule):
+        sched, labels = scenario, None
+    else:
+        names = None if scenario == "mixed" else (
+            [scenario] if isinstance(scenario, str) else list(scenario))
+        sched, labels = scenarios.mixed_schedules(names, num_scenarios,
+                                                  steps, seed=seed)
+    candles = paths.gbm_candles(key, sched)
+    population = (params is not None
+                  and jax.tree.leaves(params)[0].ndim >= 1)
+    stats = _stress_backtest_jit(
+        candles, params, jnp.asarray(initial_balance, jnp.float32),
+        population=population, use_param_sl_tp=params is not None)
+    final = np.asarray(stats.final_balance, np.float64)
+    dd = np.asarray(stats.max_drawdown_pct, np.float64)
+    summary = {
+        "labels": labels,
+        "final_balance_p05": float(np.percentile(final, 5)),
+        "final_balance_p50": float(np.percentile(final, 50)),
+        "final_balance_p95": float(np.percentile(final, 95)),
+        "worst_final_balance": float(final.min()),
+        "worst_drawdown_pct": float(dd.max()),
+    }
+    return stats, summary
+
+
+# --------------------------------------------------------------------------
+# workload 3: a scenario-diverse RL environment
+# --------------------------------------------------------------------------
+
+def scenario_env_params(key, scenario="mixed", num_scenarios: int = 64,
+                        steps: int = 1024, episode_len: int = 256,
+                        fee_rate: float = 0.0, seed: int = 0):
+    """Build `rl/env.py` EnvParams whose close/obs tables carry a leading
+    scenario axis: every `env_reset` draws (scenario, start offset), so a
+    vmapped DQN rollout trains against flash crashes and liquidity holes,
+    not just the one historical path.  Returns (EnvParams, labels)."""
+    from ai_crypto_trader_tpu import ops
+    from ai_crypto_trader_tpu.rl.env import make_env_params
+
+    names = None if scenario == "mixed" else (
+        [scenario] if isinstance(scenario, str) else list(scenario))
+    sched, labels = scenarios.mixed_schedules(names, num_scenarios, steps,
+                                              seed=seed)
+    candles = paths.gbm_candles(key, sched)
+    ind = ops.compute_indicators(
+        {k: candles[k] for k in ("open", "high", "low", "close", "volume")})
+    return make_env_params(ind, episode_len=episode_len,
+                           fee_rate=fee_rate), labels

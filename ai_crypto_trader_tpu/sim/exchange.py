@@ -1,0 +1,228 @@
+"""Traced candle-granularity exchange: FakeExchange semantics as pure state.
+
+`shell/exchange.FakeExchange` is the repo's behavioral ground truth for
+candle-granularity matching — market fills at the candle close, a resting
+LIMIT fills when the candle's low/high crosses its price, a STOP when the
+stop price is pierced (at the stop-limit price if one is set), every fill
+pays `fee = qty·price·fee_rate`, an under-funded fill is REJECTED and the
+order stays open, and a per-candle liquidity cap turns big resting orders
+into partial fills that carry their remainder forward.  This module
+re-expresses exactly those rules over fixed-size jax arrays so a whole
+batch of independent exchanges steps under `vmap` — the single-scenario
+trace is pinned trade-by-trade against FakeExchange itself
+(tests/test_sim.py, the `ops/tick_engine.py` parity-oracle pattern).
+
+Sim-only extensions, OFF in parity mode (all driven by the per-candle
+`ShockSchedule` channels, so turning them on never changes program shape):
+
+  * ``spread``  — market BUYs pay close·(1+spread/2), SELLs receive
+    close·(1−spread/2);
+  * ``halt``    — venue unreachable: placements, cancels and matching are
+    all suppressed for the candle;
+  * ``latency`` — a market order placed under latency parks in a pending
+    slot and fills at the NEXT candle's open (stale-quote execution).
+
+State layout per scenario: scalar balances, K resting-order slots (K
+static; the strategy engine uses slot 0 = protective stop, slot 1 = take
+profit, matching FakeExchange's insertion order when a stop is placed
+first), one pending-market slot, and a fixed-capacity fill log
+``[L, 6] = (t, tag, side, qty, price, fee)`` with tag 0 = market fill and
+tag k+1 = slot k — the ledger the conservation property tests audit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+BUY, SELL = 1, -1
+LIMIT, STOP = 0, 1
+FILL_FIELDS = ("t", "tag", "side", "qty", "price", "fee")
+
+
+class Book(NamedTuple):
+    """K resting-order slots (all arrays [K])."""
+
+    active: jnp.ndarray       # bool
+    side: jnp.ndarray         # i32: BUY=+1 / SELL=-1
+    kind: jnp.ndarray         # i32: LIMIT / STOP
+    qty: jnp.ndarray          # f32 remaining base quantity
+    limit_price: jnp.ndarray  # f32; for STOP, <=0 means "fill at stop"
+    stop_price: jnp.ndarray   # f32 (STOP only)
+
+
+class ExchState(NamedTuple):
+    quote: jnp.ndarray        # f32 quote-asset balance
+    base: jnp.ndarray         # f32 base-asset balance
+    fee_paid: jnp.ndarray     # f32 cumulative fees
+    book: Book
+    pend_active: jnp.ndarray  # bool — latency-parked market order
+    pend_side: jnp.ndarray    # i32
+    pend_qty: jnp.ndarray     # f32
+    fills: jnp.ndarray        # [L, 6] f32 fill log
+    n_fills: jnp.ndarray      # i32 logged fills
+    dropped_fills: jnp.ndarray  # i32 fills lost to a full log
+
+
+class Action(NamedTuple):
+    """One candle's worth of venue requests (placements land in explicit
+    slots so random-flow property tests and the strategy engine share one
+    surface).  All [K] fields align with Book slots."""
+
+    market_qty: jnp.ndarray    # f32 scalar; >0 submits a market order
+    market_side: jnp.ndarray   # i32 scalar
+    cancel: jnp.ndarray        # [K] bool
+    place: jnp.ndarray         # [K] bool (dropped when the slot is busy)
+    side: jnp.ndarray          # [K] i32
+    kind: jnp.ndarray          # [K] i32
+    qty: jnp.ndarray           # [K] f32
+    limit_price: jnp.ndarray   # [K] f32
+    stop_price: jnp.ndarray    # [K] f32
+
+
+def no_action(K: int) -> Action:
+    z = jnp.zeros((K,), jnp.float32)
+    return Action(market_qty=jnp.asarray(0.0, jnp.float32),
+                  market_side=jnp.asarray(BUY, jnp.int32),
+                  cancel=jnp.zeros((K,), bool), place=jnp.zeros((K,), bool),
+                  side=jnp.zeros((K,), jnp.int32),
+                  kind=jnp.zeros((K,), jnp.int32),
+                  qty=z, limit_price=z, stop_price=z)
+
+
+def init_state(quote_balance: float = 10_000.0, K: int = 2,
+               L: int = 128) -> ExchState:
+    f = lambda v: jnp.asarray(v, jnp.float32)  # noqa: E731
+    book = Book(active=jnp.zeros((K,), bool),
+                side=jnp.zeros((K,), jnp.int32),
+                kind=jnp.zeros((K,), jnp.int32),
+                qty=jnp.zeros((K,), jnp.float32),
+                limit_price=jnp.zeros((K,), jnp.float32),
+                stop_price=jnp.zeros((K,), jnp.float32))
+    return ExchState(quote=f(quote_balance), base=f(0.0), fee_paid=f(0.0),
+                     book=book, pend_active=jnp.asarray(False),
+                     pend_side=jnp.asarray(BUY, jnp.int32),
+                     pend_qty=f(0.0),
+                     fills=jnp.zeros((L, len(FILL_FIELDS)), jnp.float32),
+                     n_fills=jnp.asarray(0, jnp.int32),
+                     dropped_fills=jnp.asarray(0, jnp.int32))
+
+
+def _fill(s: ExchState, t, tag, side, qty, price, fee_rate):
+    """Book one (attempted) fill — FakeExchange._fill semantics: a BUY
+    needs quote ≥ cost+fee, a SELL needs base ≥ qty, otherwise the fill is
+    REJECTED and nothing moves.  Returns (state, ok)."""
+    cost = qty * price
+    fee = cost * fee_rate
+    is_buy = side > 0
+    ok = (qty > 0.0) & jnp.where(is_buy,
+                                 s.quote >= cost + fee,
+                                 s.base >= qty)
+    quote = s.quote + jnp.where(ok,
+                                jnp.where(is_buy, -(cost + fee), cost - fee),
+                                0.0)
+    base = s.base + jnp.where(ok, jnp.where(is_buy, qty, -qty), 0.0)
+    fee_paid = s.fee_paid + jnp.where(ok, fee, 0.0)
+    L = s.fills.shape[0]
+    row = jnp.stack([jnp.asarray(t, jnp.float32),
+                     jnp.asarray(tag, jnp.float32),
+                     jnp.asarray(side, jnp.float32), qty, price, fee])
+    slot = jnp.minimum(s.n_fills, L - 1)
+    write = ok & (s.n_fills < L)
+    fills = s.fills.at[slot].set(jnp.where(write, row, s.fills[slot]))
+    return s._replace(
+        quote=quote, base=base, fee_paid=fee_paid, fills=fills,
+        n_fills=s.n_fills + write.astype(jnp.int32),
+        dropped_fills=s.dropped_fills + (ok & ~write).astype(jnp.int32),
+    ), ok
+
+
+def settle_pending(s: ExchState, candle: dict, t, fee_rate, spread, halt):
+    """Fill a latency-parked market order at this candle's OPEN (the venue
+    accepted it last candle; the quote it fills on is stale).  A halted
+    candle keeps it parked."""
+    want = s.pend_active & (halt == 0.0)
+    price = candle["open"] * (1.0 + s.pend_side * spread * 0.5)
+    s, _ok = _fill(s, t, 0, s.pend_side,
+                   jnp.where(want, s.pend_qty, 0.0), price, fee_rate)
+    # filled or rejected, the parked order is consumed either way — a
+    # rejected stale order is simply gone, like a venue expiring it
+    return s._replace(pend_active=s.pend_active & ~want)
+
+
+def match_candle(s: ExchState, candle: dict, t, liquidity_cap, halt,
+                 fee_rate):
+    """Match every resting slot against the candle, in slot order —
+    FakeExchange._match_orders, vectorized over the batch but unrolled
+    over the (small, static) K slots so each fill sees the balances the
+    previous slot's fill left behind.
+
+    ``liquidity_cap`` is the per-candle per-order base-unit cap
+    (FakeExchange.max_fill_base × the schedule's liquidity_mult; inf = no
+    cap): a capped fill leaves the remainder resting — partial-fill
+    carryover.  A REJECTED fill (insufficient balance) leaves the order
+    resting untouched, exactly like the oracle."""
+    K = s.book.active.shape[0]
+    low, high = candle["low"], candle["high"]
+    for k in range(K):
+        b = s.book
+        side, kind = b.side[k], b.kind[k]
+        lp, sp = b.limit_price[k], b.stop_price[k]
+        limit_trig = (kind == LIMIT) & jnp.where(side > 0,
+                                                 low <= lp, high >= lp)
+        stop_trig = (kind == STOP) & jnp.where(side > 0,
+                                               high >= sp, low <= sp)
+        price = jnp.where(kind == STOP,
+                          jnp.where(lp > 0.0, lp, sp), lp)
+        trig = b.active[k] & (halt == 0.0) & (limit_trig | stop_trig)
+        fill_qty = jnp.minimum(b.qty[k], liquidity_cap)
+        s, ok = _fill(s, t, k + 1, side,
+                      jnp.where(trig, fill_qty, 0.0), price, fee_rate)
+        filled = trig & ok
+        partial = filled & (fill_qty < b.qty[k])
+        b = b._replace(
+            qty=b.qty.at[k].set(jnp.where(partial, b.qty[k] - fill_qty,
+                                          b.qty[k])),
+            active=b.active.at[k].set(b.active[k] & ~(filled & ~partial)))
+        s = s._replace(book=b)
+    return s
+
+
+def apply_action(s: ExchState, candle: dict, t, a: Action, fee_rate,
+                 spread, halt, latency):
+    """Apply one candle's requests: cancels, then the market order (filled
+    now at close±spread/2, or parked under latency), then placements into
+    free slots.  Everything is suppressed while halted — the venue is
+    unreachable, requests are simply lost (the caller retries next candle
+    if it still wants to)."""
+    open_venue = halt == 0.0
+    book = s.book._replace(active=s.book.active & ~(a.cancel & open_venue))
+    s = s._replace(book=book)
+
+    want_mkt = (a.market_qty > 0.0) & open_venue
+    park = want_mkt & (latency != 0.0) & ~s.pend_active
+    now = want_mkt & (latency == 0.0)
+    price = candle["close"] * (1.0 + a.market_side * spread * 0.5)
+    s, _ok = _fill(s, t, 0, a.market_side,
+                   jnp.where(now, a.market_qty, 0.0), price, fee_rate)
+    s = s._replace(
+        pend_active=s.pend_active | park,
+        pend_side=jnp.where(park, a.market_side, s.pend_side),
+        pend_qty=jnp.where(park, a.market_qty, s.pend_qty))
+
+    b = s.book
+    can = a.place & ~b.active & open_venue & (a.qty > 0.0)
+    pick = lambda new, old: jnp.where(can, new, old)  # noqa: E731
+    s = s._replace(book=Book(
+        active=b.active | can,
+        side=pick(a.side, b.side), kind=pick(a.kind, b.kind),
+        qty=pick(a.qty, b.qty),
+        limit_price=pick(a.limit_price, b.limit_price),
+        stop_price=pick(a.stop_price, b.stop_price)))
+    return s
+
+
+def equity(s: ExchState, price) -> jnp.ndarray:
+    """Mark-to-market equity in quote units."""
+    return s.quote + s.base * price

@@ -1,0 +1,165 @@
+"""Traced scenario path generators: whole candle batches as one program.
+
+Two generators, both closed-form over the candle axis (the regime chain is
+an associative running-max scan, the price a cumsum — the `mc/engine.py`
+trick), both consuming a `ShockSchedule` so every scenario row carries its
+own injected pathology:
+
+  * `gbm_candles` — the `data/synthetic.generate_ohlcv` dynamics (same
+    3-regime Markov chain, same drift/vol multipliers, imported from
+    there) re-expressed in jax over a [B, T] batch, with the schedule's
+    `logret_shift` / `vol_mult` folded into the per-candle log-returns;
+  * `bootstrap_candles` — historical log-returns resampled with
+    replacement per (scenario, candle), schedule applied the same way, so
+    stress rides on top of real return distributions.
+
+Both return a dict of [B, T] float32 arrays (open/high/low/close/volume +
+regime) shaped exactly like a batched `generate_ohlcv` — downstream
+consumers (`sim/exchange.py`, `ops.compute_indicators`, `backtest`) never
+know whether candles came from numpy, history, or a flash-crash schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ai_crypto_trader_tpu.data.synthetic import (
+    REGIME_DRIFT_MULT,
+    REGIME_VOL_MULT,
+)
+
+
+class PathParams(NamedTuple):
+    """GBM dynamics knobs — defaults mirror `generate_ohlcv`'s."""
+
+    s0: jnp.ndarray
+    base_drift: jnp.ndarray
+    base_vol: jnp.ndarray
+    regime_switch_p: jnp.ndarray
+    base_volume: jnp.ndarray
+
+
+def path_params(s0: float = 40_000.0, base_drift: float = 0.00002,
+                base_vol: float = 0.0015, regime_switch_p: float = 0.002,
+                base_volume: float = 25.0) -> PathParams:
+    f = lambda v: jnp.asarray(v, jnp.float32)  # noqa: E731
+    return PathParams(s0=f(s0), base_drift=f(base_drift),
+                      base_vol=f(base_vol),
+                      regime_switch_p=f(regime_switch_p),
+                      base_volume=f(base_volume))
+
+
+def regime_chain(switches, choices):
+    """Traced twin of `data.synthetic.regime_chain`: the regime at candle
+    i is the choice at the last switch ≤ i (state 0 before any switch) —
+    a running max over switch indices + a gather, batched over any
+    leading axes."""
+    T = switches.shape[-1]
+    t_idx = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), switches.shape)
+    idx = lax.associative_scan(jnp.maximum,
+                               jnp.where(switches, t_idx, -1), axis=-1)
+    filled = jnp.take_along_axis(choices, jnp.maximum(idx, 0), axis=-1)
+    return jnp.where(idx >= 0, filled, 0).astype(jnp.int32)
+
+
+def _assemble(key_wick, key_vol, open_, close, wick_scale, vol_scale,
+              base_volume):
+    """OHLC wick structure + volume from log-price anchors (shared by both
+    generators).  ``wick_scale`` sets the absolute wick size per candle;
+    ``low`` is floored at 20% of the candle body's lower edge so a shocked
+    wick can never cross zero."""
+    shape = close.shape
+    wick = jnp.abs(jax.random.normal(key_wick, (2,) + shape))
+    body_hi = jnp.maximum(open_, close)
+    body_lo = jnp.minimum(open_, close)
+    high = body_hi + wick[0] * wick_scale
+    low = jnp.maximum(body_lo - wick[1] * wick_scale, body_lo * 0.2)
+    volume = (base_volume * jnp.exp(0.35 * jax.random.normal(key_vol, shape))
+              * vol_scale)
+    return high, low, volume
+
+
+def _candle_dict(open_, high, low, close, volume, regime):
+    f = lambda x: x.astype(jnp.float32)  # noqa: E731
+    return {"open": f(open_), "high": f(high), "low": f(low),
+            "close": f(close), "volume": f(volume), "regime": regime}
+
+
+def gbm_candles_traced(key, logret_shift, vol_mult, p: PathParams):
+    """Trace-level GBM generator ([B, T] schedule channels in, candle dict
+    out) — call from inside a larger jitted program (sim/engine.py fuses
+    it with the rollout); `gbm_candles` is the standalone jitted entry."""
+    B, T = logret_shift.shape
+    ks = jax.random.split(key, 5)
+    switches = jax.random.uniform(ks[0], (B, T)) < p.regime_switch_p
+    choices = jax.random.randint(ks[1], (B, T), 0, 3)
+    regime = regime_chain(switches, choices)
+    drift_mult = jnp.asarray(REGIME_DRIFT_MULT, jnp.float32)[regime]
+    vol = (p.base_vol * jnp.asarray(REGIME_VOL_MULT, jnp.float32)[regime]
+           * vol_mult)
+    z = jax.random.normal(ks[2], (B, T))
+    rets = p.base_drift * drift_mult + vol * z + logret_shift
+    close = p.s0 * jnp.exp(jnp.cumsum(rets, axis=-1))
+    open_ = jnp.concatenate(
+        [jnp.full((B, 1), p.s0, close.dtype), close[:, :-1]], axis=-1)
+    high, low, volume = _assemble(ks[3], ks[4], open_, close,
+                                  wick_scale=vol * close,
+                                  vol_scale=jnp.asarray(
+                                      REGIME_VOL_MULT, jnp.float32)[regime],
+                                  base_volume=p.base_volume)
+    return _candle_dict(open_, high, low, close, volume, regime)
+
+
+@jax.jit
+def _gbm_candles_jit(key, logret_shift, vol_mult, p: PathParams):
+    return gbm_candles_traced(key, logret_shift, vol_mult, p)
+
+
+def gbm_candles(key, schedule, params: PathParams | None = None) -> dict:
+    """[B, T] regime-switching GBM candles under a ShockSchedule (or any
+    object with `logret_shift` / `vol_mult` arrays).  One jitted program."""
+    p = params or path_params()
+    return _gbm_candles_jit(key, jnp.asarray(schedule.logret_shift),
+                            jnp.asarray(schedule.vol_mult), p)
+
+
+def bootstrap_candles_traced(key, returns, logret_shift, vol_mult,
+                             p: PathParams):
+    """Trace-level bootstrap generator: per-(scenario, candle) resampled
+    historical log-returns (`mc/engine.simulate_bootstrap`'s gather, with
+    the shock schedule folded in), wicks scaled by each candle's own
+    realized move."""
+    B, T = logret_shift.shape
+    ks = jax.random.split(key, 3)
+    idx = jax.random.randint(ks[0], (B, T), 0, returns.shape[-1])
+    log_inc = returns[idx] * vol_mult + logret_shift
+    close = p.s0 * jnp.exp(jnp.cumsum(log_inc, axis=-1))
+    open_ = jnp.concatenate(
+        [jnp.full((B, 1), p.s0, close.dtype), close[:, :-1]], axis=-1)
+    high, low, volume = _assemble(
+        ks[1], ks[2], open_, close,
+        wick_scale=jnp.abs(log_inc) * close,
+        vol_scale=jnp.maximum(vol_mult, 1.0),
+        base_volume=p.base_volume)
+    regime = jnp.zeros((B, T), jnp.int32)
+    return _candle_dict(open_, high, low, close, volume, regime)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _bootstrap_candles_jit(key, returns, logret_shift, vol_mult,
+                           p: PathParams):
+    return bootstrap_candles_traced(key, returns, logret_shift, vol_mult, p)
+
+
+def bootstrap_candles(key, returns, schedule,
+                      params: PathParams | None = None) -> dict:
+    """[B, T] bootstrapped-historical candles under a ShockSchedule."""
+    p = params or path_params()
+    return _bootstrap_candles_jit(key, jnp.asarray(returns, jnp.float32),
+                                  jnp.asarray(schedule.logret_shift),
+                                  jnp.asarray(schedule.vol_mult), p)

@@ -22,6 +22,10 @@ The other target rows print one JSON line each ahead of it:
                           .py:335-419`; the reference has no env at all)
   mc_paths_10k_ms         10k GBM paths × 30 d + full stats (10× the
                           reference budget, `monte_carlo_service.py:264-336`)
+  sim_sweep               adversarial scenario sweep: 4096 stress markets
+                          (flash crashes / liquidity holes / outages)
+                          generated + strategy-rolled per jitted dispatch
+                          (sim/engine.py; scenarios/s)
   nn_train_step_ms        LSTM train step, batch 32 × seq 60 (the
                           reference's Keras budget, config.json:409-415)
 
@@ -136,7 +140,8 @@ def append_history(rows: list, path: str | None = None,
     path = path or HISTORY_PATH
     run_id = run_id or time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     scale = {k: os.environ[k] for k in
-             ("BENCH_T", "BENCH_POP", "BENCH_TICK_SYMBOLS")
+             ("BENCH_T", "BENCH_POP", "BENCH_TICK_SYMBOLS",
+              "BENCH_SIM_SCENARIOS", "BENCH_SIM_STEPS")
              if os.environ.get(k)}
     with open(path, "a", encoding="utf-8") as f:
         for row in rows:
@@ -584,6 +589,45 @@ def bench_mc():
     np.percentile(prices, 5)
     ref_ms = (time.perf_counter() - t0) * 1e3
     emit("mc_paths_10k_ms", ms, "ms", round(ref_ms / ms, 1))
+
+
+def bench_sim():
+    """sim_sweep row: adversarial-scenario sweep throughput — B mixed
+    stress markets (regime GBM + flash crashes / liquidity holes / spread
+    blowouts / outages) generated AND strategy-rolled as ONE jitted
+    dispatch with one [B]-sized host readback (sim/engine.py, ISSUE 7).
+    Value is scenarios/s; candle-steps/s rides along as extra."""
+    import jax
+
+    from ai_crypto_trader_tpu.sim import engine as sim_engine
+    from ai_crypto_trader_tpu.sim import scenarios as sim_scenarios
+
+    B = int(os.environ.get("BENCH_SIM_SCENARIOS", "4096"))
+    T = int(os.environ.get("BENCH_SIM_STEPS", "512"))
+    # schedules are PRE-built host-side: the row measures the device sweep
+    # (dispatch + [B]-sized readback, = sweep's stats["wall_s"]), not the
+    # per-row Python schedule compiler — a gated throughput metric must not
+    # regress on host prep changes
+    scheds = [sim_scenarios.mixed_schedules(None, B, T, seed=i)[0]
+              for i in range(4)]
+    t0 = time.perf_counter()
+    sim_engine.sweep(jax.random.PRNGKey(0), scenario=scheds[3])   # compile
+    log(f"sim: sweep compile+first run {time.perf_counter()-t0:.1f}s "
+        f"(B={B} × T={T})")
+    reps = []
+    for i in range(3):
+        out = sim_engine.sweep(jax.random.PRNGKey(i + 1),
+                               scenario=scheds[i])
+        reps.append(out["stats"]["wall_s"])
+    dt = float(np.median(reps))
+    log(f"sim: steady sweep {dt:.3f}s "
+        f"(median of {[round(v, 3) for v in reps]}) → "
+        f"{B / dt:,.0f} scenarios/s, {B * T / dt:,.0f} candle-steps/s; "
+        f"traded {float((out['summary']['n_fills'] > 0).mean()):.0%} "
+        f"of scenarios")
+    emit("sim_sweep", B / dt, "scenarios/s", None, scenarios=B, steps=T,
+         candle_steps_per_s=round(B * T / dt, 1),
+         sweep_ms=round(dt * 1e3, 3))
 
 
 def bench_recovery():
@@ -1067,6 +1111,7 @@ def run_worker():
         ("ga", ga_row),
         ("rl", lambda: bench_rl(ind)),
         ("mc", bench_mc),
+        ("sim", bench_sim),
         ("nn", bench_nn),
         ("recovery", bench_recovery),
     ]

@@ -1,0 +1,639 @@
+"""Device-resident market simulator (ISSUE 7): scenario schedules, traced
+paths, the traced matching engine, and the one-dispatch sweep.
+
+The two contracts that guard the subsystem:
+
+  * **Parity oracle** — a single-scenario rollout must match FakeExchange
+    trade-by-trade (fills, fees, final equity) when driven through the
+    identical strategy decisions on the same candle series (the
+    `ops/tick_engine.py` oracle pattern);
+  * **Sweep contract** — ≥ 4096 scenarios evaluate as ONE jitted dispatch
+    with ONE host readback, zero recompiles at steady state, and a
+    `sim_sweep` devprof cost card whose donated schedule buffers are
+    verifiably freed (aliased onto the candle/equity outputs).
+
+Plus fill-accounting property tests over random order flows: ledger
+conservation (balances + fees ≡ the fill log), partial-fill carryover,
+and same-seed determinism.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu.data.ingest import from_dict
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv, regime_chain
+from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+from ai_crypto_trader_tpu.sim import engine, paths, scenarios
+from ai_crypto_trader_tpu.sim import exchange as sx
+from ai_crypto_trader_tpu.utils import devprof
+
+f32 = np.float32
+
+
+# --------------------------------------------------------------------------
+# satellites: vectorized batched synthetic data, symbol-mixed book seeds
+# --------------------------------------------------------------------------
+
+class TestSyntheticBatch:
+    def test_batch_rows_bit_identical_to_scalar_calls(self):
+        batch = generate_ohlcv(n=400, seed=[3, 7, 11])
+        for i, s in enumerate([3, 7, 11]):
+            scalar = generate_ohlcv(n=400, seed=s)
+            for k in scalar:
+                assert np.array_equal(batch[k][i], scalar[k]), (k, s)
+
+    def test_scalar_shape_unchanged(self):
+        d = generate_ohlcv(n=256, seed=0)
+        assert d["close"].shape == (256,) and d["regime"].shape == (256,)
+
+    def test_regime_chain_matches_sequential_loop(self, rng):
+        switches = rng.random(500) < 0.05
+        choices = rng.integers(0, 3, size=500)
+        state, expect = 0, np.empty(500, np.int64)
+        for i in range(500):
+            if switches[i]:
+                state = choices[i]
+            expect[i] = state
+        np.testing.assert_array_equal(regime_chain(switches, choices), expect)
+
+    def test_traced_regime_chain_matches_numpy(self, rng):
+        switches = rng.random((4, 300)) < 0.03
+        choices = rng.integers(0, 3, size=(4, 300))
+        got = paths.regime_chain(jnp.asarray(switches),
+                                 jnp.asarray(choices, jnp.int32))
+        np.testing.assert_array_equal(np.asarray(got),
+                                      regime_chain(switches, choices))
+
+
+class TestOrderBookSeed:
+    def test_symbols_get_distinct_books_at_same_cursor(self):
+        n = 64
+        d = generate_ohlcv(n=n, seed=1)
+        series = {s: from_dict({k: v for k, v in d.items() if k != "regime"},
+                               symbol=s) for s in ("AAAUSDC", "BBBUSDC")}
+        ex = FakeExchange(series)
+        ex.advance(steps=10)
+        sizes = {s: [lvl[1] for lvl in ex.get_order_book(s)["bids"]]
+                 for s in series}
+        assert sizes["AAAUSDC"] != sizes["BBBUSDC"]
+        # still deterministic per (symbol, cursor)
+        again = [lvl[1] for lvl in ex.get_order_book("AAAUSDC")["bids"]]
+        assert again == sizes["AAAUSDC"]
+
+
+# --------------------------------------------------------------------------
+# scenario schedules and traced paths
+# --------------------------------------------------------------------------
+
+class TestScenarios:
+    def test_every_preset_compiles_and_is_deterministic(self):
+        for name in scenarios.preset_names():
+            a = scenarios.compile_schedules(name, 4, 128, seed=5)
+            b = scenarios.compile_schedules(name, 4, 128, seed=5)
+            for field in scenarios.ShockSchedule._fields:
+                arr = getattr(a, field)
+                assert arr.shape == (4, 128) and arr.dtype == np.float32
+                np.testing.assert_array_equal(arr, getattr(b, field))
+
+    def test_presets_actually_inject_their_pathology(self):
+        crash = scenarios.compile_schedules("flash_crash", 8, 256, seed=1)
+        assert crash.logret_shift.min() < -0.02
+        hole = scenarios.compile_schedules("liquidity_hole", 8, 256, seed=1)
+        assert hole.liquidity_mult.min() < 0.11
+        outage = scenarios.compile_schedules("exchange_outage", 8, 256, seed=1)
+        assert outage.halt.max() == 1.0
+        blow = scenarios.compile_schedules("spread_blowout", 8, 256, seed=1)
+        assert blow.spread.max() >= 0.002
+        calm = scenarios.compile_schedules("calm", 8, 256, seed=1)
+        assert calm.logret_shift.any() == 0 and calm.halt.any() == 0
+
+    def test_mixed_round_robin_covers_all_presets(self):
+        sched, labels = scenarios.mixed_schedules(None, 24, 64, seed=0)
+        assert sched.num_scenarios == 24 and sched.steps == 64
+        assert set(labels) == set(scenarios.preset_names())
+
+    def test_mc_schedule_channels(self):
+        shift, vol = scenarios.mc_schedule("flash_crash", 16, 29, seed=0)
+        assert shift.shape == vol.shape == (16, 29)
+        assert shift.min() < 0.0 and vol.max() > 1.0
+
+    def test_unknown_preset_raises(self):
+        with pytest.raises(KeyError, match="unknown scenario"):
+            scenarios.preset("nope")
+
+
+class TestPaths:
+    def test_gbm_candle_structure_and_determinism(self):
+        sched = scenarios.compile_schedules("flash_crash", 8, 256, seed=2)
+        key = jax.random.PRNGKey(0)
+        c = {k: np.asarray(v) for k, v in paths.gbm_candles(key, sched).items()}
+        assert c["close"].shape == (8, 256)
+        assert (c["high"] >= np.maximum(c["open"], c["close"]) - 1e-2).all()
+        assert (c["low"] <= np.minimum(c["open"], c["close"]) + 1e-2).all()
+        assert (c["low"] > 0).all() and (c["volume"] > 0).all()
+        assert np.isin(c["regime"], [0, 1, 2]).all()
+        c2 = {k: np.asarray(v) for k, v in paths.gbm_candles(key, sched).items()}
+        for k in c:
+            np.testing.assert_array_equal(c[k], c2[k])
+
+    def test_crash_schedule_moves_prices(self):
+        calm = scenarios.compile_schedules("calm", 8, 256, seed=3)
+        crash = scenarios.compile_schedules("flash_crash", 8, 256, seed=3)
+        key = jax.random.PRNGKey(1)
+        c_calm = np.asarray(paths.gbm_candles(key, calm)["close"])
+        c_crash = np.asarray(paths.gbm_candles(key, crash)["close"])
+        # same key → same diffusion; the crash overlay must bite
+        drop_calm = c_calm.min(axis=1) / 40_000.0
+        drop_crash = c_crash.min(axis=1) / 40_000.0
+        assert (drop_crash < drop_calm - 0.02).any()
+
+    def test_bootstrap_candles(self, rng):
+        rets = jnp.asarray(rng.normal(0, 0.002, 512), jnp.float32)
+        sched = scenarios.compile_schedules("vol_regime_shift", 4, 128, seed=0)
+        c = paths.bootstrap_candles(jax.random.PRNGKey(2), rets, sched)
+        close = np.asarray(c["close"])
+        assert close.shape == (4, 128) and (close > 0).all()
+
+
+# --------------------------------------------------------------------------
+# fill-accounting property tests over random order flows
+# --------------------------------------------------------------------------
+
+K_FLOW, L_FLOW = 4, 1024
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _run_flow(candles, actions, quote0, fee_rate, cap):
+    """Drive the bare exchange through arbitrary action streams."""
+
+    def one(c_scen, a_scen):
+        def step(st, xs):
+            a, candle, t = xs
+            st = sx.settle_pending(st, candle, t, fee_rate,
+                                   jnp.asarray(0.0), jnp.asarray(0.0))
+            st = sx.match_candle(st, candle, t, cap, jnp.asarray(0.0),
+                                 fee_rate)
+            st = sx.apply_action(st, candle, t, a, fee_rate,
+                                 jnp.asarray(0.0), jnp.asarray(0.0),
+                                 jnp.asarray(0.0))
+            return st, None
+
+        T = c_scen["close"].shape[0]
+        st0 = sx.init_state(quote0, K=K_FLOW, L=L_FLOW)
+        st, _ = jax.lax.scan(
+            step, st0, (a_scen, c_scen, jnp.arange(T, dtype=jnp.int32)))
+        return st
+
+    return jax.vmap(one)(candles, actions)
+
+
+def _random_flow(rng, B, T, close):
+    """Seeded random order flow: markets, placements (some at absurd
+    prices/sizes so rejects and never-triggering orders are exercised),
+    and cancels."""
+    mk = rng.random((B, T)) < 0.15
+    qty = np.exp(rng.normal(-3.5, 1.2, (B, T))).astype(f32)
+    place = (rng.random((B, T, K_FLOW)) < 0.10)
+    side = rng.choice([sx.BUY, sx.SELL], (B, T, K_FLOW)).astype(np.int32)
+    kind = rng.choice([sx.LIMIT, sx.STOP], (B, T, K_FLOW)).astype(np.int32)
+    slot_qty = np.exp(rng.normal(-3.0, 1.5, (B, T, K_FLOW))).astype(f32)
+    ref = close[:, :, None]
+    limit_price = (ref * (1.0 + rng.normal(0, 0.02, (B, T, K_FLOW)))).astype(f32)
+    stop_price = (ref * (1.0 + rng.normal(0, 0.02, (B, T, K_FLOW)))).astype(f32)
+    return sx.Action(
+        market_qty=np.where(mk, qty, 0.0).astype(f32),
+        market_side=rng.choice([sx.BUY, sx.SELL], (B, T)).astype(np.int32),
+        cancel=rng.random((B, T, K_FLOW)) < 0.05,
+        place=place, side=side, kind=kind, qty=slot_qty,
+        limit_price=limit_price, stop_price=stop_price)
+
+
+class TestFillAccounting:
+    B, T = 16, 128
+
+    def _flow_state(self, seed=0, fee=0.001, cap=np.inf, q0=1_000.0):
+        d = generate_ohlcv(n=self.T, seed=list(range(100, 100 + self.B)))
+        candles = {k: jnp.asarray(d[k]) for k in
+                   ("open", "high", "low", "close")}
+        actions = jax.tree.map(
+            jnp.asarray,
+            _random_flow(np.random.default_rng(seed), self.B, self.T,
+                         d["close"]))
+        st = _run_flow(candles, actions, jnp.asarray(q0, jnp.float32),
+                       jnp.asarray(fee, jnp.float32),
+                       jnp.asarray(cap, jnp.float32))
+        return jax.device_get(st), q0
+
+    def test_ledger_conservation_balances_and_fees_match_fill_log(self):
+        st, q0 = self._flow_state()
+        assert (st.n_fills > 0).sum() >= self.B // 2, "flow barely trades"
+        assert (st.dropped_fills == 0).all()
+        for b in range(self.B):
+            log = st.fills[b][:int(st.n_fills[b])].astype(np.float64)
+            side, qty, price, fee = log[:, 2], log[:, 3], log[:, 4], log[:, 5]
+            buys, sells = side > 0, side < 0
+            cost = qty * price
+            quote_expect = (q0 - (cost[buys] + fee[buys]).sum()
+                            + (cost[sells] - fee[sells]).sum())
+            base_expect = qty[buys].sum() - qty[sells].sum()
+            np.testing.assert_allclose(st.quote[b], quote_expect,
+                                       rtol=1e-5, atol=5e-2)
+            np.testing.assert_allclose(st.base[b], base_expect,
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(st.fee_paid[b], fee.sum(),
+                                       rtol=1e-4, atol=1e-3)
+            # fees are consistent with prices at the booked rate
+            np.testing.assert_allclose(fee, cost * 0.001, rtol=1e-3,
+                                       atol=1e-6)
+
+    def test_no_negative_balances_ever_booked(self):
+        for seed in (0, 1, 2):
+            st, _ = self._flow_state(seed=seed)
+            assert (st.quote >= -1e-3).all()
+            assert (st.base >= -1e-6).all()
+
+    def test_same_seed_flows_are_bit_deterministic(self):
+        a, _ = self._flow_state(seed=3)
+        b, _ = self._flow_state(seed=3)
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_partial_fill_carryover_under_liquidity_cap(self):
+        # constant candles; one resting LIMIT BUY for 10 base, cap 3/candle
+        T = 6
+        const = np.full((1, T), 100.0, f32)
+        candles = {k: jnp.asarray(v) for k, v in
+                   {"open": const, "high": const * 1.01,
+                    "low": const * 0.99, "close": const}.items()}
+        act = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                           sx.no_action(K_FLOW))
+        act = jax.tree.map(lambda x: jnp.repeat(x[:, None], T, axis=1), act)
+        place = np.zeros((1, T, K_FLOW), bool)
+        place[0, 0, 0] = True
+        act = act._replace(
+            place=jnp.asarray(place),
+            side=jnp.full((1, T, K_FLOW), sx.BUY, jnp.int32),
+            kind=jnp.full((1, T, K_FLOW), sx.LIMIT, jnp.int32),
+            qty=jnp.full((1, T, K_FLOW), 10.0, jnp.float32),
+            limit_price=jnp.full((1, T, K_FLOW), 100.0, jnp.float32))
+        st = jax.device_get(_run_flow(
+            candles, act, jnp.asarray(10_000.0, jnp.float32),
+            jnp.asarray(0.0, jnp.float32), jnp.asarray(3.0, jnp.float32)))
+        log = st.fills[0][:int(st.n_fills[0])]
+        np.testing.assert_allclose(log[:, 3], [3.0, 3.0, 3.0, 1.0])
+        np.testing.assert_allclose(st.base[0], 10.0)
+        assert not bool(st.book.active[0][0])      # fully consumed
+        assert int(st.n_fills[0]) == 4
+
+
+# --------------------------------------------------------------------------
+# the parity oracle: sim rollout ≡ FakeExchange, trade by trade
+# --------------------------------------------------------------------------
+
+def _oracle_run(c: dict, liq_mult, fee, cap, q0, T,
+                strat: engine.SimStrategy):
+    """Drive FakeExchange through the EXACT decision rule of
+    `engine._strategy_step` (f32 arithmetic mirrored), returning the fill
+    sequence, final equity and total fees."""
+    al_f = f32(np.asarray(strat.alpha_fast))
+    al_s = f32(np.asarray(strat.alpha_slow))
+    margin = f32(np.asarray(strat.entry_margin))
+    sl = f32(np.asarray(strat.sl_pct))
+    tp = f32(np.asarray(strat.tp_pct))
+    frac = f32(np.asarray(strat.trade_frac))
+    min_not = float(np.asarray(strat.min_notional))
+
+    series = from_dict({k: c[k] for k in
+                        ("open", "high", "low", "close", "volume")},
+                       symbol="SIMUSDC")
+    ex = FakeExchange({"SIMUSDC": series}, quote_balance=q0, fee_rate=fee,
+                      max_fill_base=cap)
+    ema_f = ema_s = f32(0.0)
+    entry = f32(0.0)
+    fills, seen = [], [0]
+
+    def drain(t):
+        for fd in ex.fills[seen[0]:]:
+            fills.append((t, 1 if fd["side"] == "BUY" else -1,
+                          fd["quantity"], fd["price"], fd["fee"]))
+        seen[0] = len(ex.fills)
+
+    for t in range(T):
+        # the schedule's per-candle liquidity cap, venue-side
+        ex.max_fill_base = float(f32(cap) * f32(liq_mult[t]))
+        if t > 0:
+            ex.advance()
+        drain(t)
+        close = c["close"][t]
+        bal = ex.get_balances()
+        quote, base = bal.get("USDC", 0.0), bal.get("SIM", 0.0)
+        if t == 0:
+            ema_f = ema_s = f32(close)
+        else:
+            ema_f = f32(ema_f + al_f * f32(close - ema_f))
+            ema_s = f32(ema_s + al_s * f32(close - ema_s))
+        flat = base * float(close) < min_not
+        resting = ex.list_open_orders("SIMUSDC")
+        if flat and resting:                      # post-exit sibling cleanup
+            for o in resting:
+                ex.cancel_order("SIMUSDC", o["order_id"])
+            resting = []
+        cross = ema_f > f32(ema_s * f32(1.0 + margin))
+        if flat and not resting and cross and t >= engine.WARMUP:
+            qty = f32(f32(frac * f32(quote)) / close)
+            ex.place_order("SIMUSDC", "BUY", "MARKET", float(qty))
+            entry = f32(close)
+            drain(t)
+        elif not flat and not resting:            # protective stop + TP
+            sp = f32(entry * f32(1.0 - f32(sl / f32(100.0))))
+            tpp = f32(entry * f32(1.0 + f32(tp / f32(100.0))))
+            ex.place_order("SIMUSDC", "SELL", "STOP_LOSS", float(base),
+                           stop_price=float(sp))
+            ex.place_order("SIMUSDC", "SELL", "LIMIT", float(base),
+                           price=float(tpp))
+    bal = ex.get_balances()
+    eq = bal.get("USDC", 0.0) + bal.get("SIM", 0.0) * float(c["close"][-1])
+    return fills, eq, sum(fd["fee"] for fd in ex.fills)
+
+
+class TestParityOracle:
+    """The acceptance contract: a single-scenario run reproduces
+    FakeExchange trade-by-trade on the same candle series."""
+
+    @pytest.mark.parametrize("preset,seed", [
+        ("flash_crash", 3),        # crash → stops fire, multiple roundtrips
+        ("vol_regime_shift", 5),   # busy two-sided tape
+        ("liquidity_hole", 9),     # capped fills → partial carryover
+        ("calm", 7),               # quiet market, few trades
+    ])
+    def test_single_scenario_matches_fake_exchange(self, preset, seed):
+        T = 768
+        sched = scenarios.compile_schedules(preset, 1, T, seed=seed)
+        candles = {k: np.asarray(v) for k, v in
+                   paths.gbm_candles(jax.random.PRNGKey(seed), sched).items()}
+        strat = engine.default_strategy(sl_pct=1.0, tp_pct=1.5)
+        fee, cap, q0 = 0.001, 0.02, 10_000.0
+        out = engine.rollout_candles(
+            candles, schedule=sched, strategy=strat,
+            fills_params=engine.fill_params(fee_rate=fee, max_fill_base=cap),
+            quote_balance=q0)
+        s = out["summary"]
+        n = int(s["n_fills"][0])
+        assert s["dropped_fills"][0] == 0
+        sim_fills = out["fills"][0][:n]
+
+        c1 = {k: candles[k][0] for k in candles}
+        oracle_fills, oracle_eq, oracle_fees = _oracle_run(
+            c1, np.asarray(sched.liquidity_mult[0]), fee, cap, q0, T, strat)
+
+        assert n == len(oracle_fills), \
+            f"{preset}: sim {n} fills vs oracle {len(oracle_fills)}"
+        for srow, orow in zip(sim_fills, oracle_fills):
+            t_s, _tag, side_s, qty_s, price_s, fee_s = map(float, srow)
+            t_o, side_o, qty_o, price_o, fee_o = orow
+            assert (t_s, side_s) == (t_o, side_o), (srow, orow)
+            np.testing.assert_allclose(qty_s, qty_o, rtol=1e-4, atol=1e-9)
+            np.testing.assert_allclose(price_s, price_o, rtol=1e-5)
+            np.testing.assert_allclose(fee_s, fee_o, rtol=1e-3, atol=1e-6)
+        np.testing.assert_allclose(float(s["fees"][0]), oracle_fees,
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(float(s["final_equity"][0]), oracle_eq,
+                                   rtol=1e-4)
+
+    def test_parity_fills_actually_happen(self):
+        """Guard the oracle itself: the crash scenario must produce a
+        non-trivial trade count or the parity test proves nothing."""
+        T = 768
+        sched = scenarios.compile_schedules("flash_crash", 1, T, seed=3)
+        candles = {k: np.asarray(v) for k, v in
+                   paths.gbm_candles(jax.random.PRNGKey(3), sched).items()}
+        out = engine.rollout_candles(
+            candles, schedule=sched,
+            strategy=engine.default_strategy(sl_pct=1.0, tp_pct=1.5),
+            fills_params=engine.fill_params(fee_rate=0.001,
+                                            max_fill_base=0.02))
+        assert int(out["summary"]["n_fills"][0]) >= 10
+
+
+# --------------------------------------------------------------------------
+# the sweep contract: ≥4096 scenarios, one dispatch, zero recompiles
+# --------------------------------------------------------------------------
+
+class TestSweepContract:
+    def test_4096_scenarios_one_dispatch_zero_recompile(self, monkeypatch):
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+        from ai_crypto_trader_tpu.utils.tracing import JitCompileMonitor
+
+        B, T = 4096, 256
+        syncs = {"n": 0}
+        real_read = engine.host_read
+
+        def counting_read(tree):
+            syncs["n"] += 1
+            return real_read(tree)
+
+        monkeypatch.setattr(engine, "host_read", counting_read)
+        m = MetricsRegistry()
+        with devprof.use(devprof.DevProf(metrics=m)) as dp:
+            out = engine.sweep(jax.random.PRNGKey(0), scenario="mixed",
+                               num_scenarios=B, steps=T)   # compile + card
+            assert syncs["n"] == 1
+            assert out["stats"]["dispatches"] == 1
+            assert out["stats"]["scenarios"] == B
+            assert out["summary"]["final_equity"].shape == (B,)
+            assert len(out["labels"]) == B
+            # cost card + donation check (acceptance criteria)
+            card = dp.cards["sim_sweep"]
+            assert card.error is None and card.flops > 0
+            assert card.donation_ok is True
+            assert dp.donation_failures == []
+            # the big outputs stayed on device — the one sync is [B]-sized
+            assert out["device"]["candles"]["close"].shape == (B, T)
+
+            jit_mon = JitCompileMonitor.install()
+            before = jit_mon.sample()
+            out2 = engine.sweep(jax.random.PRNGKey(1), scenario="mixed",
+                                num_scenarios=B, steps=T, seed=1)
+            since = jit_mon.since(before)
+            assert since["compiles"] == 0, since
+            assert syncs["n"] == 2                 # ONE more host readback
+        # different keys/schedules → different outcomes (not a cached blob)
+        assert not np.array_equal(out["summary"]["final_equity"],
+                                  out2["summary"]["final_equity"])
+
+    def test_sweep_same_seed_deterministic(self):
+        a = engine.sweep(jax.random.PRNGKey(5), scenario="flash_crash",
+                         num_scenarios=32, steps=128, seed=2)
+        b = engine.sweep(jax.random.PRNGKey(5), scenario="flash_crash",
+                         num_scenarios=32, steps=128, seed=2)
+        for k, v in a["summary"].items():
+            np.testing.assert_array_equal(v, b["summary"][k], err_msg=k)
+
+    def test_adversarial_presets_hurt_more_than_calm(self):
+        kw = dict(num_scenarios=48, steps=256, seed=4,
+                  strategy=engine.default_strategy(sl_pct=1.0, tp_pct=1.5))
+        calm = engine.sweep(jax.random.PRNGKey(9), scenario="calm", **kw)
+        swan = engine.sweep(jax.random.PRNGKey(9), scenario="black_swan",
+                            **kw)
+        # the black swan batch must show strictly worse tails
+        assert (swan["summary"]["min_equity"].min()
+                < calm["summary"]["min_equity"].min())
+        assert (swan["summary"]["max_drawdown"].max()
+                > calm["summary"]["max_drawdown"].max())
+
+
+# --------------------------------------------------------------------------
+# workload integrations: mc stress-VaR, backtest-under-stress, RL env
+# --------------------------------------------------------------------------
+
+class TestMcStress:
+    def test_unstressed_path_parity_pinned(self, rng):
+        """stress=None must trace to the exact pre-stress program: pin the
+        full stats block against a manual re-composition."""
+        from ai_crypto_trader_tpu import mc
+
+        key = jax.random.PRNGKey(11)
+        rets = rng.normal(0.0005, 0.02, 500).astype(np.float32)
+        out = mc.run_simulation(key, 100.0, rets, days=30, num_sims=256)
+        mu, sigma = mc.estimate_mu_sigma(jnp.asarray(rets))
+        paths_ref = mc.simulate_gbm(key, 100.0, mu, sigma, 30, 256)
+        ref = mc.path_statistics(paths_ref, 100.0, 0.95)
+        np.testing.assert_array_equal(np.asarray(out["paths"]),
+                                      np.asarray(paths_ref))
+        np.testing.assert_array_equal(np.asarray(out["var"]),
+                                      np.asarray(ref["var"]))
+        assert out["stress"] is None
+
+    def test_stress_mode_fattens_the_left_tail(self, rng):
+        from ai_crypto_trader_tpu import mc
+
+        key = jax.random.PRNGKey(12)
+        rets = rng.normal(0.0005, 0.01, 500).astype(np.float32)
+        kw = dict(days=30, num_sims=2048)
+        base = mc.run_simulation(key, 100.0, rets, **kw)
+        crash = mc.run_simulation(key, 100.0, rets, stress="flash_crash",
+                                  **kw)
+        assert crash["stress"] == "flash_crash"
+        assert float(crash["var"]) < float(base["var"])      # var is signed pct
+        assert float(crash["cvar"]) < float(base["cvar"])
+        assert (float(crash["max_drawdown_mean"])
+                > float(base["max_drawdown_mean"]))
+
+    def test_bootstrap_stress_mode(self, rng):
+        from ai_crypto_trader_tpu import mc
+
+        key = jax.random.PRNGKey(13)
+        rets = rng.normal(0.0, 0.01, 400).astype(np.float32)
+        out = mc.run_simulation(key, 100.0, rets, days=20, num_sims=512,
+                                method="bootstrap", stress="black_swan")
+        assert np.asarray(out["paths"]).shape == (512, 20)
+
+    def test_stress_var_cvar_report(self, rng):
+        from ai_crypto_trader_tpu import risk
+
+        rets = rng.normal(0.0005, 0.01, 500).astype(np.float32)
+        rep = risk.stress_var_cvar(jax.random.PRNGKey(14), 100.0, rets,
+                                   stress="flash_crash", days=30,
+                                   num_sims=1024)
+        assert rep["stress"] == "flash_crash"
+        assert rep["stress_var_pct"] >= rep["var_pct"]
+        assert rep["stress_cvar_pct"] >= rep["stress_var_pct"]
+        # uplift is the SIGNED tail shift, immune to the positive-loss clamp
+        assert rep["var_uplift_pct"] == pytest.approx(
+            rep["var_signed_pct"] - rep["stress_var_signed_pct"])
+        assert rep["var_uplift_pct"] > 0
+
+
+class TestBacktestUnderStress:
+    def test_scenario_batch_stats(self):
+        stats, summary = engine.backtest_under_stress(
+            jax.random.PRNGKey(20), scenario=["calm", "flash_crash"],
+            num_scenarios=8, steps=512)
+        assert np.asarray(stats.final_balance).shape == (8,)
+        assert summary["final_balance_p05"] <= summary["final_balance_p95"]
+        assert summary["worst_final_balance"] > 0
+        assert len(summary["labels"]) == 8
+
+    def test_population_axis(self):
+        from ai_crypto_trader_tpu.backtest import sample_params
+
+        params = sample_params(jax.random.PRNGKey(0), 4)
+        stats, _ = engine.backtest_under_stress(
+            jax.random.PRNGKey(21), scenario="flash_crash",
+            num_scenarios=6, steps=512, params=params)
+        assert np.asarray(stats.final_balance).shape == (6, 4)
+
+
+class TestScenarioRLEnv:
+    def test_env_params_carry_scenario_axis(self):
+        from ai_crypto_trader_tpu.rl import env_reset, env_step
+
+        p, labels = engine.scenario_env_params(
+            jax.random.PRNGKey(30), scenario=["calm", "flash_crash"],
+            num_scenarios=4, steps=512, episode_len=32)
+        assert p.close.shape == (4, 512)
+        assert p.obs_table.shape == (4, 512, 8)
+        assert len(labels) == 4
+        keys = jax.random.split(jax.random.PRNGKey(0), 64)
+        states, obs = jax.vmap(lambda k: env_reset(p, k))(keys)
+        scen = np.asarray(states.scen)
+        assert obs.shape == (64, 10)
+        assert scen.min() >= 0 and scen.max() <= 3
+        assert len(np.unique(scen)) > 1            # actually samples lanes
+        s2, obs2, r, done = jax.vmap(
+            lambda s: env_step(p, s, jnp.asarray(1)))(states)
+        assert obs2.shape == (64, 10)
+        np.testing.assert_array_equal(np.asarray(s2.scen), scen)
+
+    def test_single_path_env_unchanged(self, ohlcv):
+        from ai_crypto_trader_tpu import ops
+        from ai_crypto_trader_tpu.rl import env_reset, env_step, make_env_params
+        from ai_crypto_trader_tpu.rl.env import BUY
+
+        arrays = {k: jnp.asarray(v[:512]) for k, v in ohlcv.items()
+                  if k != "regime"}
+        p = make_env_params(ops.compute_indicators(arrays), episode_len=64)
+        s, obs = env_reset(p, jax.random.PRNGKey(0))
+        assert obs.shape == (10,) and int(s.scen) == 0
+        t0 = int(s.t)
+        s, _, r, _ = env_step(p, s, jnp.asarray(BUY))
+        expect = ((float(p.close[t0 + 1]) - float(p.close[t0]))
+                  / float(p.close[t0]))
+        np.testing.assert_allclose(float(r), expect, rtol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# slow tier: the full-scale sweep and scenario-diverse DQN training
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestFullScaleSweep:
+    def test_10k_scenarios_single_dispatch(self):
+        out = engine.sweep(jax.random.PRNGKey(0), scenario="mixed",
+                           num_scenarios=10_000, steps=1024)
+        s = out["summary"]
+        assert s["final_equity"].shape == (10_000,)
+        assert np.isfinite(s["final_equity"]).all()
+        assert out["stats"]["dispatches"] == 1
+        assert (s["n_fills"] > 0).mean() > 0.2      # the market gets traded
+        # the fill log is a bounded ring: a busy tail scenario may overflow
+        # it (counted, balances unaffected), but it must stay a tail event
+        assert (s["dropped_fills"] > 0).mean() < 0.05
+
+    def test_dqn_trains_on_scenario_env(self):
+        from ai_crypto_trader_tpu.rl import DQNConfig, dqn_init, train_iterations
+
+        p, _ = engine.scenario_env_params(
+            jax.random.PRNGKey(40), scenario="mixed", num_scenarios=16,
+            steps=768, episode_len=128)
+        cfg = DQNConfig(num_envs=32, rollout_len=8)
+        st = dqn_init(jax.random.PRNGKey(1), p, cfg)
+        st, metrics = train_iterations(p, st, cfg, n_iters=4)
+        assert np.isfinite(np.asarray(metrics["loss"])).all()
+        # envs really spread across scenario lanes
+        assert len(np.unique(np.asarray(st.env_states.scen))) > 1
