@@ -296,7 +296,8 @@ def cmd_trade(args):
                            enable_tracing=bool(args.trace_jsonl),
                            trace_jsonl=args.trace_jsonl,
                            journal_path=args.journal,
-                           enable_devprof=args.devprof)
+                           enable_devprof=args.devprof,
+                           flightrec_path=args.flightrec)
     if args.full_stack:
         from ai_crypto_trader_tpu.shell.stack import build_full_stack
         from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
@@ -353,6 +354,43 @@ def cmd_trade(args):
         if server is not None:
             server.stop()
         system.shutdown()          # deactivate tracer + close span JSONL
+
+
+def cmd_why(args):
+    """Decision provenance for one symbol (obs/flightrec.py): the last N
+    decisions with their rejecting gate or execution chain
+    (signal → client_order_id → fill → closure PnL) plus the structured
+    explanation narrative.  Reads the checksummed decision JSONL a run
+    wrote (`trade --flightrec PATH`), or queries a live dashboard server's
+    /decisions endpoint with --url."""
+    from ai_crypto_trader_tpu.obs.flightrec import format_why, load_decisions
+
+    if args.url:
+        import urllib.parse
+        import urllib.request
+
+        query = urllib.parse.urlencode(
+            {"symbol": args.symbol, "limit": args.last})
+        with urllib.request.urlopen(f"{args.url}/decisions?{query}",
+                                    timeout=10) as resp:
+            records = json.loads(resp.read())
+    else:
+        if not os.path.exists(args.file):
+            print(f"no decision journal at {args.file} — run "
+                  f"`trade --paper --flightrec {args.file}` first, "
+                  f"or query a live server with --url")
+            return
+        records, stats = load_decisions(args.file)
+        records = [r for r in records if r.get("symbol") == args.symbol]
+        records = list(reversed(records[-args.last:]))
+        if stats.get("corrupt_records") or stats.get("torn_tail"):
+            print(f"(journal: {stats['corrupt_records']} corrupt records "
+                  f"skipped, torn tail={stats['torn_tail']})")
+    if not records:
+        print(f"no recorded decisions for {args.symbol}")
+        return
+    for line in format_why(records):
+        print(line)
 
 
 def cmd_profile(args):
@@ -538,7 +576,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="device-runtime observatory (utils/devprof.py): "
                          "program cost cards + donation verification, "
                          "live-memory watermarks, latency SLO gauges")
+    sp.add_argument("--flightrec", default=None, metavar="PATH",
+                    help="persist the decision-provenance flight recorder "
+                         "(obs/flightrec.py) as checksummed JSONL to PATH "
+                         "— queryable offline via `why --file PATH`")
     sp.set_defaults(fn=cmd_trade)
+    sp = sub.add_parser("why", help="decision provenance for a symbol "
+                                    "(flight-recorder query)")
+    sp.add_argument("symbol")
+    sp.add_argument("--file", default="decisions.jsonl",
+                    help="decision JSONL written by trade --flightrec")
+    sp.add_argument("--url", default=None,
+                    help="query a live dashboard server instead "
+                         "(e.g. http://127.0.0.1:8050)")
+    sp.add_argument("--last", type=int, default=10)
+    sp.set_defaults(fn=cmd_why)
     sp = sub.add_parser("profile",
                         help="capture a TensorBoard XPlane device profile "
                              "of a short paper-trading burst")

@@ -73,6 +73,13 @@ class PredictionService:
     checkpoint_dir: str | None = None
     key: any = None
     name: str = "nn"
+    # Live quality gate (obs/scorecard.py): when attached, an HPO winner
+    # of a DIFFERENT architecture must not have a known-worse live score
+    # than the incumbent it would replace — the registry/hot-swap quality
+    # gate.  `registry` (strategy/registry.py ModelRegistry) versions each
+    # HPO winner; blocked candidates are registered as "shadow".
+    scorecard: any = None
+    registry: any = None
 
     # When True, the synchronous JAX work (training / HPO / inference) runs
     # in a worker thread via asyncio.to_thread so a 24 h-retrain tick cannot
@@ -158,7 +165,8 @@ class PredictionService:
         return (now - prev.get("reference_time", -1e18)) >= half
 
     def _run_hpo(self, symbol: str, interval: str, feats, now: float):
-        """HPO + adoption of the winner; returns the optimization record."""
+        """HPO + scorecard-gated adoption of the winner; returns the
+        optimization record (including the adoption verdict)."""
         from ai_crypto_trader_tpu.models.hpo import optimize_hyperparameters
 
         self.key, k = jax.random.split(self.key)
@@ -174,18 +182,41 @@ class PredictionService:
                 seq_len=self.seq_len, target_col=3,
                 precision=self.precision))
         best = hpo["best_params"]
-        self.key, k2 = jax.random.split(self.key)
-        result = train_model(
-            k2, feats, best["model_type"], seq_len=self.seq_len,
-            units=best["units"], dropout=best["dropout"],
-            learning_rate=best["learning_rate"],
-            batch_size=best["batch_size"], epochs=self.epochs,
-            target_col=3, precision=self.precision)
-        self.models[(symbol, interval)] = result
-        self.train_count += 1
-        self._snapshot(symbol, interval, result)
+        # live quality gate: the candidate architecture must not be
+        # measurably WORSE live than the incumbent it would replace —
+        # val loss on the training window says nothing about whether the
+        # incumbent's real predictions were coming true (obs/scorecard.py)
+        incumbent = self.models.get((symbol, interval))
+        adoption, gate_reason = "adopted", None
+        if self.scorecard is not None and incumbent is not None:
+            allowed, gate_reason = self.scorecard.adoption_gate(
+                best["model_type"], incumbent.model_type, symbol, interval)
+            if not allowed:
+                adoption = "blocked_by_scorecard"
+        version = None
+        if self.registry is not None:
+            version = self.registry.register(
+                "nn_model", dict(best),
+                metadata={"symbol": symbol, "interval": interval})
+            self.registry.update_performance(
+                version, {"val_loss": float(hpo["best_val_loss"])})
+            self.registry.set_status(
+                version, "active" if adoption == "adopted" else "shadow")
+        if adoption == "adopted":
+            self.key, k2 = jax.random.split(self.key)
+            result = train_model(
+                k2, feats, best["model_type"], seq_len=self.seq_len,
+                units=best["units"], dropout=best["dropout"],
+                learning_rate=best["learning_rate"],
+                batch_size=best["batch_size"], epochs=self.epochs,
+                target_col=3, precision=self.precision)
+            self.models[(symbol, interval)] = result
+            self.train_count += 1
+            self._snapshot(symbol, interval, result)
         return {"at": now, "best": best,
-                "val_loss": float(hpo["best_val_loss"])}
+                "val_loss": float(hpo["best_val_loss"]),
+                "adoption": adoption, "adoption_reason": gate_reason,
+                "version": version}
 
     def _compute(self, now: float, hpo_req: dict | None) -> dict:
         """ALL synchronous JAX work for one cadence step. Bus access is
@@ -204,9 +235,11 @@ class PredictionService:
                 pass
             else:
                 rec = self._run_hpo(symbol, interval, feats, now)
-                # the adopted winner IS this pair's training for the cycle —
-                # without this the retrain loop below would immediately
-                # clobber it with a default-config model
+                # this cycle IS the pair's training — adopted or blocked.
+                # Without the refresh, a blocked adoption would leave the
+                # cadence stale and the retrain loop below could clobber
+                # the very incumbent the gate just protected, the same
+                # tick, with a default-config model.
                 self._last_training[(symbol, interval)] = now
                 out["kv"].append(
                     (f"nn_last_optimization_{symbol}_{interval}", rec))
@@ -221,6 +254,20 @@ class PredictionService:
             for interval in self.intervals:
                 last = self._last_training.get((symbol, interval))
                 if last is not None and now - last < self.retrain_interval_s:
+                    continue
+                # the regular retrain trains the service's DEFAULT
+                # architecture — when that would REPLACE a different-arch
+                # incumbent (an adopted HPO winner), it is an architecture
+                # swap and must pass the same live quality gate as an HPO
+                # candidate; blocked = the incumbent keeps serving and is
+                # re-vetted next cadence
+                incumbent = self.models.get((symbol, interval))
+                if (self.scorecard is not None and incumbent is not None
+                        and incumbent.model_type != self.model_type
+                        and not self.scorecard.adoption_gate(
+                            self.model_type, incumbent.model_type,
+                            symbol, interval)[0]):
+                    self._last_training[(symbol, interval)] = now
                     continue
                 if self._train_one(symbol, interval) is not None:
                     self._last_training[(symbol, interval)] = now
@@ -242,11 +289,21 @@ class PredictionService:
                 jobs.append((symbol, interval, result, feats))
         for (symbol, interval, result, feats), pred in zip(
                 jobs, self._predict_jobs(jobs)):
+            rows = self.bus.get(f"historical_data_{symbol}_{interval}") or []
             payload = {
                 "symbol": symbol, "interval": interval,
                 "predicted_price": float(np.ravel(pred["predicted_price"])[0]),
                 "confidence": pred["confidence"],
                 "reference_time": now,
+                # explicit outcome-resolution provenance (obs/scorecard.py):
+                # the snapshot used to keep only the value, which made
+                # "did this prediction come true?" unanswerable — the
+                # kline timestamp anchors resolution clock-independently
+                "predicted_at": now,
+                "horizon_s": float(INTERVAL_SECONDS.get(interval, 3600)),
+                "reference_ts": float(rows[-1][0]) if rows else None,
+                "reference_price": float(feats[-1, 3]),
+                "model_type": result.model_type,
             }
             out["kv"].append((f"nn_prediction_{symbol}_{interval}", payload))
             out["events"].append({"type": "prediction", **payload})

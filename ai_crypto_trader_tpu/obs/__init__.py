@@ -1,0 +1,37 @@
+"""Decision provenance & model-quality observatory.
+
+PR 6 instrumented the DEVICE runtime (cost cards, donation checks,
+latency SLOs); this package instruments the TRADING axis — why a trade
+fired or was vetoed, whether the zoo models' predictions are actually
+correct once their horizon elapses, which signal family the realized
+PnL comes from, and whether the live feature distribution has drifted
+from its reference.  Four instruments:
+
+  * flightrec    — signal→order→fill→PnL flight recorder: one compact
+                   record per (symbol, tick) decision, bounded ring +
+                   checksummed append-only JSONL (utils/journal format)
+  * scorecard    — live prediction outcome scoring: hit-rate,
+                   directional accuracy and Brier calibration per
+                   (architecture, symbol, interval), resolved against
+                   the realized candle when the horizon elapses
+  * drift        — the per-feature PSI spec the fused tick dispatch
+                   computes on-device (ops/tick_engine.py)
+  * attribution  — realized-PnL / win-rate folding of journal closures
+                   by entry signal family / strategy / model
+"""
+
+from ai_crypto_trader_tpu.obs.attribution import PnLAttribution
+from ai_crypto_trader_tpu.obs.drift import (
+    DRIFT_FEATURES,
+    N_BINS,
+    PSI_ALERT_THRESHOLD,
+    reference_histogram,
+)
+from ai_crypto_trader_tpu.obs.flightrec import FlightRecorder, load_decisions
+from ai_crypto_trader_tpu.obs.scorecard import Scorecard
+
+__all__ = [
+    "DRIFT_FEATURES", "N_BINS", "PSI_ALERT_THRESHOLD",
+    "FlightRecorder", "PnLAttribution", "Scorecard",
+    "load_decisions", "reference_histogram",
+]

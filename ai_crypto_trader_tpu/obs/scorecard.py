@@ -1,0 +1,223 @@
+"""Live prediction-outcome scoring: the model-quality scorecard.
+
+The prediction service publishes a price forecast per (symbol, interval)
+with an explicit horizon; nothing ever checked whether those forecasts
+come true.  The scorecard closes the loop ON THE DATA ALREADY IN MEMORY:
+when a prediction's horizon elapses, the realized candle is read from
+the monitor's bus kline window (no extra venue I/O) and the outcome
+feeds rolling windows per (architecture, symbol, interval):
+
+  * directional accuracy — sign(predicted − reference) vs realized,
+  * hit rate             — |predicted − realized| within ``hit_tolerance``,
+  * Brier score          — mean (confidence − correct)², the calibration
+                           error the ``ModelCalibrationBreach`` alert
+                           watches (a model that says 0.9 and is right
+                           half the time scores ~0.33).
+
+Everything is keyed by the klines' own timestamps (milliseconds), so a
+virtual paper clock and a real wall clock behave identically.
+
+The scorecard is also the live half of the registry/hot-swap quality
+gate: ``adoption_gate`` compares a candidate architecture's live score
+against the incumbent's, and the prediction service refuses an HPO
+winner that is measurably WORSE live than what it would replace
+(models/service.py).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+def _sign(x: float) -> int:
+    return (x > 0) - (x < 0)
+
+
+@dataclass
+class Scorecard:
+    bus: object = None
+    metrics: object = None
+    now_fn: object = time.time
+    window: int = 256              # outcomes kept per (arch, symbol, interval)
+    min_samples: int = 16          # below this, scores are not "live" yet
+    hit_tolerance: float = 0.005   # |pred-realized|/realized for a "hit"
+    # a prediction whose realized candle never shows up (symbol dropped,
+    # venue gap) expires after this many horizons instead of leaking
+    expire_horizons: float = 50.0
+
+    _pending: dict = field(default_factory=dict)   # (s, iv, ref_ts) -> payload
+    _last_ref: dict = field(default_factory=dict)  # (s, iv) -> newest ref_ts
+    _stats: dict = field(default_factory=dict)     # (arch, s, iv) -> deque
+    resolved_total: int = 0
+    expired_total: int = 0
+
+    # -- intake --------------------------------------------------------------
+    def record_prediction(self, payload: dict) -> bool:
+        """Register one prediction for future resolution.  Needs the
+        explicit provenance fields the service now snapshots:
+        ``reference_ts`` (ms), ``horizon_s``, ``reference_price``,
+        ``predicted_price``, ``model_type``.  Returns True if queued."""
+        s, iv = payload.get("symbol"), payload.get("interval")
+        ref_ts = payload.get("reference_ts")
+        if not s or not iv or ref_ts is None \
+                or payload.get("horizon_s") is None \
+                or payload.get("reference_price") is None:
+            return False
+        if self._last_ref.get((s, iv), -1) >= ref_ts:
+            return False                   # already registered this forecast
+        self._last_ref[(s, iv)] = ref_ts
+        self._pending[(s, iv, ref_ts)] = dict(payload)
+        return True
+
+    def observe_bus(self) -> int:
+        """Sweep every ``nn_prediction_*`` bus key (the launcher drives
+        this each tick — polling KV state like every other consumer, no
+        subscription plumbing; whatever (symbol, interval) pairs the
+        prediction service serves are picked up automatically)."""
+        if self.bus is None:
+            return 0
+        n = 0
+        for key in self.bus.keys("nn_prediction_*"):
+            p = self.bus.get(key)
+            if isinstance(p, dict) and self.record_prediction(p):
+                n += 1
+        return n
+
+    # -- resolution ----------------------------------------------------------
+    def _klines(self, symbol: str, interval: str):
+        if self.bus is None:
+            return None
+        return self.bus.get(f"historical_data_{symbol}_{interval}")
+
+    def resolve_due(self, klines_fn=None) -> int:
+        """Resolve every pending prediction whose horizon has elapsed in
+        KLINE TIME: realized price = close of the first candle at/after
+        reference_ts + horizon.  The window the monitor already holds is
+        the only data source — zero additional I/O."""
+        klines_fn = klines_fn or self._klines
+        resolved = 0
+        for key, p in list(self._pending.items()):
+            s, iv, ref_ts = key
+            horizon_ms = float(p["horizon_s"]) * 1000.0
+            rows = klines_fn(s, iv)
+            if not rows:
+                continue
+            due_ts = ref_ts + horizon_ms
+            realized = None
+            for j, row in enumerate(rows):
+                if float(row[0]) >= due_ts:
+                    # never score against the NEWEST row: live venues
+                    # include the still-forming candle as the last kline,
+                    # whose close is a transient mid-candle price.  A later
+                    # row existing proves this one closed.
+                    if j < len(rows) - 1:
+                        realized = float(row[4])   # close column
+                    break
+            if realized is None:
+                newest = float(rows[-1][0])
+                if newest - ref_ts > horizon_ms * self.expire_horizons:
+                    self._pending.pop(key, None)   # unresolvable: expire
+                    self.expired_total += 1
+                continue
+            self._pending.pop(key, None)
+            self._score(p, realized)
+            resolved += 1
+        return resolved
+
+    def _score(self, p: dict, realized: float) -> None:
+        arch = p.get("model_type") or "unknown"
+        s, iv = p["symbol"], p["interval"]
+        ref = float(p["reference_price"])
+        pred = float(p["predicted_price"])
+        conf = min(max(float(p.get("confidence") or 0.0), 0.0), 1.0)
+        correct = _sign(pred - ref) == _sign(realized - ref)
+        denom = max(abs(realized), 1e-9)
+        hit = abs(pred - realized) / denom <= self.hit_tolerance
+        brier = (conf - (1.0 if correct else 0.0)) ** 2
+        q = self._stats.setdefault((arch, s, iv), deque(maxlen=self.window))
+        q.append((bool(correct), bool(hit), float(brier)))
+        self.resolved_total += 1
+        if self.metrics is not None:
+            self.metrics.inc("model_outcomes_resolved_total",
+                             arch=arch, symbol=s, interval=iv)
+
+    # -- scores --------------------------------------------------------------
+    def scores(self) -> dict:
+        out = {}
+        for (arch, s, iv), q in self._stats.items():
+            n = len(q)
+            if n == 0:
+                continue
+            out[(arch, s, iv)] = {
+                "n": n,
+                "directional_accuracy": sum(c for c, _, _ in q) / n,
+                "hit_rate": sum(h for _, h, _ in q) / n,
+                "brier": sum(b for _, _, b in q) / n,
+                "live": n >= self.min_samples,
+            }
+        return out
+
+    def live_score(self, arch: str, symbol: str, interval: str) -> float | None:
+        """The adoption-gate score: directional accuracy over the window,
+        None until ``min_samples`` outcomes have resolved."""
+        q = self._stats.get((arch, symbol, interval))
+        if not q or len(q) < self.min_samples:
+            return None
+        return sum(c for c, _, _ in q) / len(q)
+
+    def adoption_gate(self, candidate_arch: str, incumbent_arch: str,
+                      symbol: str, interval: str) -> tuple[bool, str]:
+        """May ``candidate_arch`` replace ``incumbent_arch`` live?
+
+        Blocks only a candidate with a KNOWN-WORSE live score than a
+        scored incumbent; an unscored candidate passes flagged (it has
+        never served, so it has no live score to compare — the registry
+        records the adoption as shadow-grade)."""
+        if candidate_arch == incumbent_arch:
+            return True, "same_architecture"
+        inc = self.live_score(incumbent_arch, symbol, interval)
+        cand = self.live_score(candidate_arch, symbol, interval)
+        if inc is None:
+            return True, "incumbent_unscored"
+        if cand is None:
+            return True, "candidate_unscored"
+        if cand > inc:
+            return True, "candidate_better"
+        return False, (f"candidate {candidate_arch} live score {cand:.3f} "
+                       f"<= incumbent {incumbent_arch} {inc:.3f}")
+
+    # -- export --------------------------------------------------------------
+    def export(self) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        for (arch, s, iv), sc in self.scores().items():
+            m.set_gauge("model_directional_accuracy",
+                        sc["directional_accuracy"],
+                        arch=arch, symbol=s, interval=iv)
+            m.set_gauge("model_hit_rate", sc["hit_rate"],
+                        arch=arch, symbol=s, interval=iv)
+            m.set_gauge("model_brier_score", sc["brier"],
+                        arch=arch, symbol=s, interval=iv)
+        m.set_gauge("model_predictions_pending", len(self._pending))
+
+    def alert_state(self) -> dict:
+        """Worst-case inputs for the in-process alert rules, only from
+        windows with ``min_samples`` outcomes (a 2-sample window must not
+        page)."""
+        live = [sc for sc in self.scores().values() if sc["live"]]
+        out = {}
+        if live:
+            out["model_accuracy_worst"] = min(
+                sc["directional_accuracy"] for sc in live)
+            out["model_brier_worst"] = max(sc["brier"] for sc in live)
+        return out
+
+    def status(self) -> dict:
+        return {"pending": len(self._pending),
+                "resolved": self.resolved_total,
+                "expired": self.expired_total,
+                "groups": {f"{a}:{s}:{iv}": sc for (a, s, iv), sc
+                           in self.scores().items()}}

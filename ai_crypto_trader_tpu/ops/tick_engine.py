@@ -46,6 +46,7 @@ import numpy as np
 from ai_crypto_trader_tpu import ops
 from ai_crypto_trader_tpu.utils import devprof
 from ai_crypto_trader_tpu.backtest import compute_signal_features, reference_signal
+from ai_crypto_trader_tpu.obs.drift import DRIFT_FEATURES, N_BINS, PSI_EPS
 from ai_crypto_trader_tpu.ops.combinations import (
     combination_signal,
     combined_indicators,
@@ -77,8 +78,20 @@ def _pad_frames(n: int) -> int:
     return max(n, 4)
 
 
+def _drift_hist(x, lo, hi):
+    """[..., T] feature series → [..., N_BINS] window histogram
+    probabilities against the fixed edges (obs/drift.py spec).  NaNs
+    (warm-up lanes) land in bin 0; those lanes are masked invalid
+    downstream anyway."""
+    T = x.shape[-1]
+    idx = jnp.clip((x - lo) / (hi - lo) * N_BINS, 0, N_BINS - 1)
+    idx = jnp.nan_to_num(idx).astype(jnp.int32)
+    onehot = idx[..., None] == jnp.arange(N_BINS, dtype=jnp.int32)
+    return onehot.sum(axis=-2) / T
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
-def _tick_program(ring, base, rows, s_ix, f_ix, pos, valid):
+def _tick_program(ring, base, rows, s_ix, f_ix, pos, valid, drift_ref):
     """Scatter row updates into the donated ring, then compute the whole
     last-candle feature table for every (symbol, frame) lane.
 
@@ -90,6 +103,10 @@ def _tick_program(ring, base, rows, s_ix, f_ix, pos, valid):
                         slot (dropped by mode="drop")
     valid [S, F]        warm frames; cold lanes get NaN outputs in-program
                         (int outputs 0) so warm-up never changes the shape
+    drift_ref [S, F, K, B]  per-feature reference histograms (training-time
+                        stats, or the first full window captured host-side);
+                        PSI vs the live window rides the SAME output pytree —
+                        zero extra dispatches, zero extra host readbacks
     """
     S, F, T, _ = ring.shape
     ring = ring.at[s_ix, f_ix, pos].set(rows, mode="drop")
@@ -106,6 +123,21 @@ def _tick_program(ring, base, rows, s_ix, f_ix, pos, valid):
     combos = combined_indicators(ind)
     confluence = combination_signal(combos)
     close = ohlcv["close"]
+
+    # on-device drift: per-feature window histograms + PSI vs drift_ref
+    drift_series = {
+        "rsi": ind["rsi"],
+        "stoch_k": ind["stoch_k"],
+        "bb_position": ind["bb_position"],
+        "macd_norm": jnp.where(close != 0.0, ind["macd"] / close, 0.0),
+        "volatility": feats.volatility,
+    }
+    live_hist = jnp.stack(
+        [_drift_hist(drift_series[name], lo, hi)
+         for name, lo, hi in DRIFT_FEATURES], axis=-2)     # [S, F, K, B]
+    p = live_hist + PSI_EPS
+    q = drift_ref + PSI_EPS
+    drift_psi = ((p - q) * jnp.log(p / q)).sum(-1)          # [S, F, K]
 
     def chg(n):
         # same guard as the host-side chg(): windows shorter than n → 0.0
@@ -137,6 +169,10 @@ def _tick_program(ring, base, rows, s_ix, f_ix, pos, valid):
         "value_area_high": fm(vp["value_area_high"]),
         "confluence": fm(confluence[..., -1]),
         "combo": {k: fm(v[..., -1]) for k, v in combos.items()},
+        # popped by step() into last_drift — never part of the published
+        # feature payload, so fused↔per-symbol parity is untouched
+        "drift_psi": jnp.where(valid[..., None], drift_psi, jnp.nan),
+        "drift_hist": live_hist,
     }
     return ring, out
 
@@ -173,6 +209,16 @@ class TickEngine:
         self._base = np.zeros((S, F), np.int32)
         self._count = np.zeros((S, F), np.int32)
         self._ring = None                      # device buffer, donated
+        # drift reference histograms (obs/drift.py): uniform until a
+        # training-time reference is installed (set_drift_reference) or the
+        # first full window is captured per lane; kept device-resident and
+        # re-uploaded only when a reference changes — never per tick
+        K, B = len(DRIFT_FEATURES), N_BINS
+        self._drift_ref_np = np.full((S, F, K, B), 1.0 / B, np.float32)
+        self._drift_ref_set = np.zeros((S, F), bool)
+        self._drift_ref = None
+        self.drift_ref_uploads = 0
+        self.last_drift: dict = {}
         # queued writes this poll, keyed (s, f, pos) so a second ingest of
         # the same slot between steps overwrites rather than duplicates —
         # duplicate scatter indices pick an implementation-defined winner
@@ -196,6 +242,19 @@ class TickEngine:
         # queued incremental writes for this slot are superseded
         self._pending = {k: v for k, v in self._pending.items()
                          if not (k[0] == s and k[1] == f)}
+
+    # -- drift reference ------------------------------------------------------
+    def set_drift_reference(self, symbol: str, interval: str,
+                            probs: np.ndarray) -> None:
+        """Install training-time reference stats ([K, N_BINS] probabilities,
+        obs/drift.reference_histogram) for one (symbol, interval) lane.
+        One device_put per change — a transfer, never a recompile."""
+        s = self.sym_index[symbol]
+        f = self.iv_index[interval]
+        self._drift_ref_np[s, f] = np.asarray(probs, np.float32)
+        self._drift_ref_set[s, f] = True
+        self._drift_ref = jnp.asarray(self._drift_ref_np)
+        self.drift_ref_uploads += 1
 
     def ingest(self, symbol: str, interval: str, klines: list) -> None:
         """Diff one (symbol, frame) kline window against the device ring and
@@ -265,22 +324,42 @@ class TickEngine:
             upload_bytes += (rows.nbytes + s_ix.nbytes + f_ix.nbytes
                              + pos.nbytes)
         valid = self._count >= T
+        if self._drift_ref is None:
+            self._drift_ref = jnp.asarray(self._drift_ref_np)
         # one-shot cost card + donation verification on the first carded
         # dispatch (utils/devprof.py; disabled = one attribute read)
         carding = (devprof.active() is not None
                    and not devprof.has_card("tick_engine"))
         if carding:
             devprof.cost_card("tick_engine", _tick_program, self._ring,
-                              self._base, rows, s_ix, f_ix, pos, valid)
+                              self._base, rows, s_ix, f_ix, pos, valid,
+                              self._drift_ref)
         donated_ring = self._ring if carding else None
         self._ring, out = _tick_program(self._ring, self._base, rows, s_ix,
-                                        f_ix, pos, valid)
+                                        f_ix, pos, valid, self._drift_ref)
         if donated_ring is not None:
             devprof.verify_donation("tick_engine", donated_ring)
         self.dispatch_count += 1
         self._need_seed = False
         self.last_valid = valid
         host = host_read(out)
+        # drift outputs ride the same readback; pop them into last_drift so
+        # the published feature payload (and the fused↔per-symbol parity
+        # contract) is unchanged.  PSI is only meaningful where a reference
+        # existed BEFORE this dispatch; lanes past warm-up with no reference
+        # capture this window's histogram as their baseline (one device_put,
+        # no recompile — pathology stays array content).
+        drift_hist = host.pop("drift_hist")
+        drift_psi = host.pop("drift_psi")
+        ref_was_set = self._drift_ref_set.copy()
+        newly = valid & ~self._drift_ref_set
+        if newly.any():
+            self._drift_ref_np[newly] = drift_hist[newly]
+            self._drift_ref_set |= valid
+            self._drift_ref = jnp.asarray(self._drift_ref_np)
+            self.drift_ref_uploads += 1
+        self.last_drift = {"psi": drift_psi, "hist": drift_hist,
+                           "ref_set": ref_was_set}
         self.last_stats = {
             "dispatches": 1, "upload_rows": int(n_writes),
             "upload_bytes": int(upload_bytes), "full_seed": bool(seeded),

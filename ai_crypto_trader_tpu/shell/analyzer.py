@@ -44,7 +44,35 @@ class SignalAnalyzer:
     # `services/model_integration.py:220-288`).
     outcome_model: any = None
     min_success_probability: float = 0.45
+    # Decision-provenance flight recorder (obs/flightrec.py), wired by the
+    # launcher (default-on there).  None = disabled: every call site below
+    # is a single attribute check, the tracing/devprof discipline.
+    flightrec: any = None
     _last_analysis: dict = field(default_factory=dict)
+
+    def _decision_features(self, update: dict) -> dict:
+        """The compact feature/confluence slice the flight recorder keeps
+        per decision — enough to answer `cli why` without replaying the
+        whole market payload."""
+        keys = ("current_price", "signal", "signal_strength", "confluence",
+                "rsi", "macd", "volatility", "trend", "trend_strength",
+                "top_family", "top_family_score", "structure_version",
+                "structure_blend")
+        return {k: update[k] for k in keys if k in update}
+
+    def _prediction_snapshot(self, symbol: str) -> dict:
+        """Each architecture's live prediction for this symbol (the
+        nn_prediction_* bus keys the prediction service maintains)."""
+        out = {}
+        for key in self.bus.keys(f"nn_prediction_{symbol}_*"):
+            p = self.bus.get(key)
+            if not isinstance(p, dict):
+                continue
+            tag = f"{p.get('model_type', 'nn')}:{p.get('interval', '?')}"
+            out[tag] = {k: p[k] for k in ("predicted_price", "confidence",
+                                          "reference_price", "horizon_s")
+                        if k in p}
+        return out
 
     def _build_context(self, update: dict) -> dict:
         """Market context string/dict (`ai_analyzer_service.py:153-380`) —
@@ -67,9 +95,21 @@ class SignalAnalyzer:
         when gated."""
         symbol = update["symbol"]
         now = self.now_fn()
+        fr = self.flightrec
+        rec_id = None
         if now - self._last_analysis.get(symbol, -1e18) < self.analysis_interval_s:
+            # throttle hit — the COMMON path (every poll between analysis
+            # cadences).  Counted, not recorded: no feature slice, no
+            # bus-wide prediction-snapshot scan, no ring slot — the hot
+            # path stays O(1) and real decisions own the ring.
+            if fr is not None:
+                fr.throttled(symbol)
             return None
         self._last_analysis[symbol] = now
+        if fr is not None:
+            rec_id = fr.begin(symbol,
+                              features=self._decision_features(update),
+                              predictions=self._prediction_snapshot(symbol))
 
         ctx = self._build_context(update)
         analysis = await self.trader.analyze_trade_opportunity(ctx)
@@ -85,7 +125,15 @@ class SignalAnalyzer:
             "confidence": float(analysis.get("confidence", 0.0)),
             "reasoning": analysis.get("reasoning", ""),
             "model_version": analysis.get("model_version"),
+            # entry-signal provenance riding to the executor and, for
+            # executed trades, into the journal closure records the PnL
+            # attribution folds (obs/attribution.py)
+            "top_family": update.get("top_family"),
+            "structure_version": update.get("structure_version"),
         }
+        if rec_id is not None:
+            signal["decision_id"] = rec_id
+        outcome_veto = None
         if self.outcome_model is not None and signal["decision"] == "BUY":
             outcome = self.outcome_model.predict_trade_outcome(
                 _flat_features(ctx))
@@ -98,6 +146,10 @@ class SignalAnalyzer:
                     f"{signal['reasoning']} [outcome gate: win probability "
                     f"{outcome['success_probability']:.2f} < "
                     f"{self.min_success_probability:.2f}]").strip()
+                # the veto is TERMINAL (journals the record) — deferred
+                # until after set_verdict below so the durable copy carries
+                # the verdict + explanation, not just the gate
+                outcome_veto = f"p={outcome['success_probability']:.2f}"
         await self.bus.publish("trading_signals", signal)
         self.bus.set(f"latest_signal_{symbol}", signal)
         # structured explanation per signal (AIExplainabilityService consumes
@@ -105,11 +157,21 @@ class SignalAnalyzer:
         # the dashboard's drill-down panel renders this bounded history)
         from ai_crypto_trader_tpu.strategy.explain import explain_signal
 
-        explanation = explain_signal(signal)
+        explanation = explain_signal({**update, **signal})
         self.bus.set(f"explanation_{symbol}", explanation)
         history = self.bus.get("explanations") or []
         history.append(explanation)
         self.bus.set("explanations", history[-50:])
+        if fr is not None:
+            # the verdict + structured explanation land on the decision
+            # record BEFORE the executor finalizes it (veto/execution)
+            fr.set_verdict(rec_id, {
+                "decision": signal["decision"],
+                "confidence": signal["confidence"],
+                "model_version": signal.get("model_version"),
+            }, explanation=explanation)
+            if outcome_veto is not None:
+                fr.veto(rec_id, "outcome_probability", detail=outcome_veto)
         return signal
 
     def _queue(self):

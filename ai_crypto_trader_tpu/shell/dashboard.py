@@ -437,6 +437,7 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
                      symbol: str | None = None,
                      symbol_links: list | None = None,
                      traces: list | None = None,
+                     decisions: list | None = None,
                      now_fn=time.time) -> str:
     """Return the dashboard HTML. Every section is optional — sections
     render from whatever state exists (like the reference's per-callback
@@ -565,11 +566,35 @@ def render_dashboard(bus=None, *, price_series=None, equity_curve=None,
             bus.get("pattern_analysis_report"))
         if pattern_panel:
             sections.append(pattern_panel)
+        # --- trading-quality observatory (obs/) ---
+        attribution = bus.get("pnl_attribution")
+        if attribution and attribution.get("family"):
+            rows = {src: f"pnl {v['pnl']:+,.2f} · {v['trades']} trades · "
+                         f"win {v['win_rate']:.0%}"
+                    for src, v in sorted(
+                        attribution["family"].items(),
+                        key=lambda kv: -kv[1]["pnl"])}
+            sections.append(_table(rows, "PnL attribution (signal family)"))
+        scorecard = bus.get("model_scorecard")
+        if scorecard:
+            rows = {group: (f"dir {sc['directional_accuracy']:.0%} · hit "
+                            f"{sc['hit_rate']:.0%} · brier {sc['brier']:.3f}"
+                            f" · n={sc['n']}")
+                    for group, sc in sorted(scorecard.items())}
+            sections.append(_table(rows, "Model scorecard (live outcomes)"))
     if signals:
         rows = {f"{s.get('symbol')} @ {s.get('timestamp', 0):.0f}":
                 f"{s.get('decision')} ({s.get('confidence', 0):.2f})"
                 for s in signals[-10:]}
         sections.append(_table(rows, "Recent signals"))
+    if decisions:
+        from ai_crypto_trader_tpu.obs.flightrec import format_why
+
+        rows = "".join(f"<div style='font-family:monospace;font-size:12px'>"
+                       f"{html.escape(line)}</div>"
+                       for line in format_why(decisions))
+        sections.append(f"<div class='card'><h3>Recent decisions "
+                        f"(flight recorder)</h3>{rows}</div>")
     if traces:
         trace_panel = _traces_html(traces)
         if trace_panel:

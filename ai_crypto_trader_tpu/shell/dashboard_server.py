@@ -104,6 +104,19 @@ class DashboardServer:
                         self._send(json.dumps(outer.traces(limit),
                                               default=str).encode(),
                                    "application/json")
+                    elif path == "/decisions":
+                        # decision-provenance query (obs/flightrec.py):
+                        # ?symbol=X&trace_id=Y&limit=N over the recorder's
+                        # ring — signal→order→fill→PnL per decision
+                        try:
+                            limit = max(int(q.get("limit", [50])[0]), 0)
+                        except ValueError:
+                            limit = 50
+                        self._send(json.dumps(outer.decisions(
+                            symbol=q.get("symbol", [None])[0],
+                            trace_id=q.get("trace_id", [None])[0],
+                            limit=limit), default=str).encode(),
+                                   "application/json")
                     elif path == "/profile":
                         try:
                             seconds = float(q.get("seconds", ["1"])[0])
@@ -177,6 +190,7 @@ class DashboardServer:
                     if registry is not None else None)
         traces = self.traces(limit=8)
         return render_dashboard(
+            decisions=self.decisions(symbol=sym, limit=8) or None,
             traces=traces or None,
             bus=system.bus,
             klines=klines,
@@ -200,6 +214,13 @@ class DashboardServer:
     def traces(self, limit: int = 20) -> list:
         tracer = getattr(self.system, "tracer", None)
         return tracer.traces(limit=limit) if tracer is not None else []
+
+    def decisions(self, symbol: str | None = None,
+                  trace_id: str | None = None, limit: int = 50) -> list:
+        fr = getattr(self.system, "flightrec", None)
+        if fr is None:
+            return []
+        return fr.query(symbol=symbol, trace_id=trace_id, limit=limit)
 
     def profile(self, seconds: float) -> dict | None:
         """On-demand XPlane capture: `jax.profiler.trace` for ``seconds``
@@ -236,6 +257,14 @@ class DashboardServer:
         if devprof is not None:
             # cost cards / SLO summaries / donation results / watermarks
             out["devprof"] = devprof.status()
+        flightrec = getattr(system, "flightrec", None)
+        if flightrec is not None:
+            out["flightrec"] = flightrec.status()
+        scorecard = getattr(system, "scorecard", None)
+        if scorecard is not None:
+            sc = scorecard.status()
+            out["scorecard"] = {k: v for k, v in sc.items() if k != "groups"} \
+                | {"groups": {k: dict(v) for k, v in sc["groups"].items()}}
         return out
 
     def health(self) -> dict:

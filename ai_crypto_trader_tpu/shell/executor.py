@@ -51,6 +51,10 @@ class ActiveTrade:
     entry_coid: str | None = None
     stop_coid: str | None = None
     tp_coid: str | None = None
+    # entry-signal provenance (decision id, dominant combination family,
+    # structure/model versions) — journaled with the trade and carried
+    # onto the closure record for PnL attribution (obs/attribution.py)
+    source: dict | None = None
 
 
 @dataclass
@@ -68,6 +72,9 @@ class TradeExecutor:
     # this into books and reconciles them against venue ground truth.
     journal: object = None
     coid_prefix: str = "wj"
+    # Decision-provenance flight recorder (obs/flightrec.py), wired by the
+    # launcher; None = disabled (one attribute check per call site).
+    flightrec: object = None
     # intents whose venue outcome is UNKNOWN (placement raised mid-flight,
     # or journaled intent with no ack found at recovery), keyed by
     # client_order_id; entry for a symbol is blocked while one is pending
@@ -108,30 +115,43 @@ class TradeExecutor:
         return f"{self.coid_prefix}-{tag}-{symbol}-{self._coid_seq}"
 
     # --- gates (strategy_tester.py:371-401 / trade_executor_service.py) ----
-    def should_execute(self, signal: dict) -> bool:
+    def veto_reason(self, signal: dict) -> str | None:
+        """WHICH gate rejects this signal (None = executable) — the single
+        source of truth behind ``should_execute`` AND the flight
+        recorder's per-decision rejection reason, so the recorded gate can
+        never drift from the gate actually applied."""
         # poisoned-payload gate: a NaN/zero price reaching the sizer would
         # turn into a NaN-quantity order and poison the venue balances —
         # reject non-finite numerics at the door (docs/RESILIENCE.md)
         price = signal.get("current_price", 0.0)
         if not (np.isfinite(price) and price > 0.0):
-            return False
+            return "nan_gate"
         if not all(np.isfinite(signal.get(k, 0.0)) for k in
                    ("confidence", "signal_strength", "volatility",
                     "avg_volume")):
-            return False
-        return (
-            signal.get("confidence", 0.0) >= self.trading.ai_confidence_threshold
-            and signal.get("signal_strength", 0.0) >= self.trading.min_signal_strength
-            and signal.get("signal") == signal.get("decision")
-            and signal.get("decision") == "BUY"
-            and signal["symbol"] not in self.active_trades
-            # an unresolved intent means the venue MAY already hold a
-            # position for this symbol — entering again would be the exact
-            # double-order the journal exists to prevent
-            and signal["symbol"] not in {i.get("symbol")
-                                         for i in self.pending_intents.values()}
-            and len(self.active_trades) < self.trading.max_positions
-        )
+            return "nan_gate"
+        if signal.get("confidence", 0.0) < self.trading.ai_confidence_threshold:
+            return "confidence_floor"
+        if signal.get("signal_strength", 0.0) < self.trading.min_signal_strength:
+            return "strength_floor"
+        if signal.get("decision") != "BUY":
+            return "not_buy"
+        if signal.get("signal") != signal.get("decision"):
+            return "signal_disagreement"
+        if signal["symbol"] in self.active_trades:
+            return "position_open"
+        # an unresolved intent means the venue MAY already hold a
+        # position for this symbol — entering again would be the exact
+        # double-order the journal exists to prevent
+        if signal["symbol"] in {i.get("symbol")
+                                for i in self.pending_intents.values()}:
+            return "pending_intent"
+        if len(self.active_trades) >= self.trading.max_positions:
+            return "max_positions"
+        return None
+
+    def should_execute(self, signal: dict) -> bool:
+        return self.veto_reason(signal) is None
 
     def _social_factors(self, symbol: str) -> dict:
         snap = self.bus.get(f"social_snapshot_{symbol}")
@@ -145,7 +165,12 @@ class TradeExecutor:
 
     async def handle_signal(self, signal: dict) -> ActiveTrade | None:
         """`execute_trade` (:816-1046)."""
-        if not self.should_execute(signal):
+        fr = self.flightrec
+        did = signal.get("decision_id")
+        reason = self.veto_reason(signal)
+        if reason is not None:
+            if fr is not None:
+                fr.veto(did, reason, symbol=signal.get("symbol"))
             return None
         symbol = signal["symbol"]
         balance = self.exchange.get_balances().get("USDC", 0.0)
@@ -156,6 +181,10 @@ class TradeExecutor:
         size = float(np.asarray(plan.size)) * social["position_size_factor"]
         size = min(size, balance * 0.95)
         if size < self.trading.min_trade_amount:
+            if fr is not None:
+                fr.veto(did, "risk_min_size", symbol=symbol,
+                        detail=f"sized {size:.2f} < "
+                               f"{self.trading.min_trade_amount:.2f}")
             return None
         # sizer fractions interpreted as percent (the corrected semantics;
         # see engine.reference_quirks docs), then socially adjusted
@@ -173,13 +202,25 @@ class TradeExecutor:
 
         qty_req = size / signal["current_price"]
         coid = self._next_coid("ent", symbol)
+        # entry-signal provenance: journaled with the intent and carried
+        # through the trade onto its closure record (PnL attribution)
+        source = {"decision_id": did,
+                  "family": signal.get("top_family"),
+                  "structure_version": signal.get("structure_version"),
+                  "model_version": signal.get("model_version")}
+        if fr is not None:
+            # provenance durable BEFORE the venue can see the order, like
+            # the journal intent below — a kill in the placement window
+            # must not orphan the venue-side fill from its decision
+            fr.execution(did, coid, symbol=symbol, quantity=qty_req,
+                         sl_pct=sl_pct, tp_pct=tp_pct)
         # WAL property: the intent is durable BEFORE the order can reach
         # the venue — a crash in the placement window leaves a journaled
         # intent the reconciler resolves by client id (reached? adopt :
         # never arrived? discard), never a silent double-entry hazard.
         self._j("entry_intent", flush=True, symbol=symbol,
                 client_order_id=coid, quantity=qty_req, sl_pct=sl_pct,
-                tp_pct=tp_pct, coid_seq=self._coid_seq)
+                tp_pct=tp_pct, coid_seq=self._coid_seq, source=source)
         try:
             order = self.exchange.place_order(symbol, "BUY", "MARKET",
                                               quantity=qty_req,
@@ -191,16 +232,22 @@ class TradeExecutor:
             # is reachable again.
             self.pending_intents[coid] = {
                 "phase": "entry", "symbol": symbol, "client_order_id": coid,
-                "quantity": qty_req, "sl_pct": sl_pct, "tp_pct": tp_pct}
+                "quantity": qty_req, "sl_pct": sl_pct, "tp_pct": tp_pct,
+                "source": source}
             self._j("entry_ambiguous", flush=True, symbol=symbol,
                     client_order_id=coid)
             raise
         if order.get("status") != "FILLED":
             self._j("entry_reject", symbol=symbol, client_order_id=coid,
                     status=order.get("status"))
+            if fr is not None:
+                fr.veto(did, "entry_rejected", symbol=symbol,
+                        detail=str(order.get("status")))
             return None
         entry = order["price"]
         qty = order["quantity"]
+        if fr is not None:
+            fr.fill(coid, entry, qty, symbol=symbol)
 
         # Register the position BEFORE placing protective orders: if the
         # exchange dies between the fill and the stop placement, the trade
@@ -216,12 +263,13 @@ class TradeExecutor:
                 entry, stop_price, self.trailing.activation_threshold_pct),
             opened_at=self.now_fn(),
             entry_coid=coid,
+            source=source,
         )
         self.active_trades[symbol] = trade
         self._j("entry_ack", flush=True, symbol=symbol, client_order_id=coid,
                 order_id=order.get("order_id"), price=entry, quantity=qty,
                 sl_pct=sl_pct, tp_pct=tp_pct, opened_at=trade.opened_at,
-                stop=stop_price, coid_seq=self._coid_seq)
+                stop=stop_price, coid_seq=self._coid_seq, source=source)
         try:
             self._ensure_protection(trade)
         except ExchangeUnavailable:
@@ -398,13 +446,26 @@ class TradeExecutor:
                 self._j("orphan_order", flush=True, symbol=symbol,
                         order_id=oid)
         pnl = (exit_price - trade.entry_price) * trade.quantity
-        record = {"symbol": symbol, "entry_price": trade.entry_price,
-                  "exit_price": exit_price, "quantity": trade.quantity,
-                  "pnl": pnl, "reason": reason, "opened_at": trade.opened_at,
-                  "closed_at": self.now_fn()}
+        record = self._closure_record(trade, exit_price, pnl, reason)
         self.closed_trades.append(record)
         self._j("trade_closed", flush=True, **record)
         await self.bus.publish("trade_closures", record)
+
+    def _closure_record(self, trade: ActiveTrade, exit_price: float,
+                        pnl: float, reason: str) -> dict:
+        """One closure record, provenance included: entry_coid + source
+        complete the flight recorder's signal→order→fill→PnL chain and
+        feed PnL attribution — on the live path AND through journal
+        replay after a restart."""
+        record = {"symbol": trade.symbol, "entry_price": trade.entry_price,
+                  "exit_price": exit_price, "quantity": trade.quantity,
+                  "pnl": pnl, "reason": reason, "opened_at": trade.opened_at,
+                  "closed_at": self.now_fn(),
+                  "entry_coid": trade.entry_coid, "source": trade.source}
+        if self.flightrec is not None:
+            self.flightrec.closure(trade.entry_coid, trade.symbol,
+                                   exit_price, pnl, reason)
+        return record
 
     async def close_trade(self, symbol: str, price: float, reason: str) -> None:
         """Pop the trade only AFTER the exit sell succeeds: if the exchange
@@ -474,10 +535,7 @@ class TradeExecutor:
             return
         self.active_trades.pop(symbol, None)
         pnl = (price - trade.entry_price) * trade.quantity
-        record = {"symbol": symbol, "entry_price": trade.entry_price,
-                  "exit_price": price, "quantity": trade.quantity,
-                  "pnl": pnl, "reason": reason, "opened_at": trade.opened_at,
-                  "closed_at": self.now_fn()}
+        record = self._closure_record(trade, price, pnl, reason)
         self.closed_trades.append(record)
         self._j("trade_closed", flush=True, **record)
         await self.bus.publish("trade_closures", record)
@@ -490,7 +548,8 @@ class TradeExecutor:
                 "stop_order_id": t.stop_order_id, "tp_order_id": t.tp_order_id,
                 "stop": float(np.asarray(t.trailing_state.stop)),
                 "opened_at": t.opened_at, "entry_coid": t.entry_coid,
-                "stop_coid": t.stop_coid, "tp_coid": t.tp_coid}
+                "stop_coid": t.stop_coid, "tp_coid": t.tp_coid,
+                "source": t.source}
 
     def _trade_from_dict(self, d: dict) -> ActiveTrade:
         entry = float(d["entry_price"])
@@ -509,7 +568,7 @@ class TradeExecutor:
                 entry, stop, self.trailing.activation_threshold_pct),
             opened_at=float(d.get("opened_at", 0.0)),
             entry_coid=d.get("entry_coid"), stop_coid=d.get("stop_coid"),
-            tp_coid=d.get("tp_coid"))
+            tp_coid=d.get("tp_coid"), source=d.get("source"))
 
     def closed_count(self) -> int:
         """Total closed trades over the process LINEAGE (snapshot rotation
@@ -673,17 +732,33 @@ class TradeExecutor:
                         "symbol": symbol, "entry_price": entry,
                         "quantity": filled_qty, "stop_loss_pct": sl,
                         "take_profit_pct": tp, "opened_at": self.now_fn(),
-                        "entry_coid": coid})
+                        "entry_coid": coid, "source": intent.get("source")})
                     self._j("entry_ack", flush=True, symbol=symbol,
                             client_order_id=coid, price=entry,
                             quantity=filled_qty, sl_pct=sl, tp_pct=tp,
                             opened_at=self.now_fn(),
                             order_id=found.get("order_id"),
-                            stop=entry * (1 - sl / 100.0))
+                            stop=entry * (1 - sl / 100.0),
+                            source=intent.get("source"))
+                    if self.flightrec is not None:
+                        # the fill that landed while we were down completes
+                        # the provenance chain for the recovered entry
+                        self.flightrec.fill(coid, entry, filled_qty,
+                                            symbol=symbol)
                     out["adopted"] += 1
                 else:
                     self._j("intent_resolved", symbol=symbol,
                             client_order_id=coid, resolution="not_placed")
+                    if self.flightrec is not None:
+                        # the durable decision record says "executed" (it
+                        # flushed before placement) but the order never
+                        # reached the venue — finalize it as a veto so
+                        # replay can't show a phantom execution
+                        self.flightrec.veto(
+                            (intent.get("source") or {}).get("decision_id"),
+                            "entry_rejected", symbol=symbol,
+                            detail="intent discarded: order never reached "
+                                   "the venue")
                     out["discarded"] += 1
             else:                                           # exit
                 trade = self.active_trades.get(symbol)
@@ -695,14 +770,9 @@ class TradeExecutor:
                     trade = self.active_trades.pop(symbol, None)
                     if trade is not None:
                         pnl = (price - trade.entry_price) * trade.quantity
-                        record = {"symbol": symbol,
-                                  "entry_price": trade.entry_price,
-                                  "exit_price": price,
-                                  "quantity": trade.quantity, "pnl": pnl,
-                                  "reason": intent.get("reason",
-                                                       "Recovered Exit"),
-                                  "opened_at": trade.opened_at,
-                                  "closed_at": self.now_fn()}
+                        record = self._closure_record(
+                            trade, price, pnl,
+                            intent.get("reason", "Recovered Exit"))
                         self.closed_trades.append(record)
                         self._j("trade_closed", flush=True, **record)
                         await self.bus.publish("trade_closures", record)

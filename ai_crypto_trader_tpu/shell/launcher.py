@@ -66,6 +66,14 @@ class TradingSystem:
     # write-ahead-journals every order intent/ack/closure here, and
     # `recover()` replays + reconciles it after a restart.
     journal_path: str | None = None
+    # Decision provenance & model quality (obs/): the flight recorder is
+    # DEFAULT-ON — one compact record per (symbol, tick) decision in a
+    # bounded ring (dashboard /decisions, `cli why`); `flightrec_path`
+    # additionally appends each terminal decision/fill/closure as a
+    # checksummed JSONL record that survives restarts.  The prediction
+    # scorecard and PnL attribution ride the same flag.
+    enable_flightrec: bool = True
+    flightrec_path: str | None = None
     # Stage supervision (utils/supervision.py): a non-ExchangeUnavailable
     # exception inside monitor/analyzer/executor is isolated with
     # exponential backoff; N consecutive failures quarantine the stage
@@ -119,10 +127,28 @@ class TradingSystem:
         self.alerts = AlertManager(now_fn=self.now_fn)
         self.heartbeats = HeartbeatRegistry(now_fn=self.now_fn,
                                             log=self.log.child("health"))
+        # decision provenance & model quality (obs/): flight recorder +
+        # prediction scorecard + PnL attribution, default-on (the trading
+        # twin of the device observatory; disabled path = one None check)
+        self.flightrec = None
+        self.scorecard = None
+        self.attribution = None
+        if self.enable_flightrec or self.flightrec_path:
+            from ai_crypto_trader_tpu.obs.attribution import PnLAttribution
+            from ai_crypto_trader_tpu.obs.flightrec import FlightRecorder
+            from ai_crypto_trader_tpu.obs.scorecard import Scorecard
+
+            self.flightrec = FlightRecorder(path=self.flightrec_path,
+                                            metrics=self.metrics,
+                                            now_fn=self.now_fn)
+            self.scorecard = Scorecard(bus=self.bus, metrics=self.metrics,
+                                       now_fn=self.now_fn)
+            self.attribution = PnLAttribution(metrics=self.metrics)
+        self._attr_cursor = 0
         self.monitor = MarketMonitor(self.bus, self.exchange,
                                      symbols=self.symbols, now_fn=self.now_fn)
         self.analyzer = SignalAnalyzer(
-            self.bus, now_fn=self.now_fn,
+            self.bus, now_fn=self.now_fn, flightrec=self.flightrec,
             analysis_interval_s=self.config.trading.ai_analysis_interval)
         self.journal = None
         if self.journal_path:
@@ -134,7 +160,8 @@ class TradingSystem:
                                       trading=self.config.trading,
                                       trailing=self.config.risk.trailing_stop,
                                       now_fn=self.now_fn,
-                                      journal=self.journal)
+                                      journal=self.journal,
+                                      flightrec=self.flightrec)
         from ai_crypto_trader_tpu.utils.supervision import StageBreaker
 
         self.stage_breakers = {
@@ -210,6 +237,11 @@ class TradingSystem:
         br = self.stage_breakers[name]
         now = self.now_fn()
         if not br.should_run(now):
+            if (name == "executor" and br.quarantined
+                    and self.flightrec is not None):
+                # published decisions the quarantined executor will not
+                # drain record their gate instead of dangling "open"
+                self.flightrec.mark_open("quarantine")
             return None                    # backoff/quarantine window
         try:
             out = await fn()
@@ -231,6 +263,8 @@ class TradingSystem:
                 "at": self.now_fn()})
             if tripped:
                 self.metrics.inc("stage_quarantines_total", stage=name)
+                if name == "executor" and self.flightrec is not None:
+                    self.flightrec.mark_open("quarantine")
                 await self.bus.publish("alerts", {
                     "name": "ServiceCrashLoop", "severity": "critical",
                     "service": name, "failures": br.failures,
@@ -301,6 +335,7 @@ class TradingSystem:
                     "executed": executed, "alerts": 1 + len(fired),
                     "skipped": str(exc)}
         await self._run_extra_services()
+        self._observe_trading_quality()
         # total portfolio value: quote balances + base holdings marked at the
         # latest price (free USDC alone would show a phantom loss while a
         # position is open); dedup by base asset via the shared helper
@@ -359,6 +394,38 @@ class TradingSystem:
             self._render_dashboard()
         return {"published": published, "analyzed": analyzed,
                 "executed": executed, "alerts": len(fired)}
+
+    def _observe_trading_quality(self):
+        """Per-tick drive of the trading-quality observatory (obs/):
+
+        * scorecard — register fresh predictions off the bus, resolve the
+          ones whose horizon elapsed against the kline windows already in
+          memory, export hit-rate/accuracy/Brier gauges;
+        * drift — export the monitor's on-device PSI as
+          ``feature_psi{symbol, feature}`` gauges (SignalDrift input);
+        * attribution — fold new journal closures into per-source
+          realized-PnL / win-rate gauges + the dashboard card's bus key;
+        * flight recorder — ring-size gauge.
+        """
+        sc = self.scorecard
+        if sc is not None:
+            sc.observe_bus()
+            sc.resolve_due()
+            sc.export()
+            self.bus.set("model_scorecard", sc.status()["groups"])
+        for symbol, feats in self.monitor.last_drift.items():
+            for feature, value in feats.items():
+                self.metrics.set_gauge("feature_psi", value,
+                                       symbol=symbol, feature=feature)
+        closed = self.executor.closed_trades
+        self._attr_cursor = min(self._attr_cursor, len(closed))
+        if self.attribution is not None and self._attr_cursor < len(closed):
+            self._attr_cursor = self.attribution.fold_new(closed,
+                                                          self._attr_cursor)
+            self.attribution.export()
+            self.bus.set("pnl_attribution", self.attribution.summary())
+        if self.flightrec is not None:
+            self.flightrec.export()
 
     def _emit_health_gauges(self):
         """Health/alert-rule gauges (monitoring/alert_rules.yml). Emitted on
@@ -454,6 +521,14 @@ class TradingSystem:
         if self.devprof is not None:
             state["slo_burn_rates"] = self.devprof.burn_rates()
             state["donation_failures"] = list(self.devprof.donation_failures)
+        # trading-quality observatory inputs (obs/): worst live model
+        # calibration/accuracy and the max on-device feature PSI
+        if self.scorecard is not None:
+            state.update(self.scorecard.alert_state())
+        psi_values = [v for feats in self.monitor.last_drift.values()
+                      for v in feats.values()]
+        if psi_values:
+            state["feature_psi_max"] = max(psi_values)
         confidences = [
             s.get("confidence", 0.0)
             for s in (self.bus.get(f"latest_signal_{sym}")
@@ -547,6 +622,8 @@ class TradingSystem:
             #                                left alone (tracer pattern)
         if self.journal is not None:
             self.journal.close()           # flush the buffered tail
+        if self.flightrec is not None:
+            self.flightrec.close()         # flush the decision JSONL tail
 
     async def run(self, duration_s: float | None = None,
                   tick_interval_s: float = 5.0):

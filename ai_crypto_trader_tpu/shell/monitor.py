@@ -70,6 +70,10 @@ class MarketMonitor:
     # (kept as the parity oracle and for ad-hoc off-universe polls).
     fused: bool = True
     max_new: int = 8                    # ring rows per (s, f) before re-seed
+    # per-symbol primary-frame feature drift ({symbol: {feature: PSI}}),
+    # refreshed by each fused poll from the engine's on-device PSI output
+    # (obs/drift.py); the launcher exports feature_psi gauges from this
+    last_drift: dict = field(default_factory=dict)
     _engine: TickEngine | None = field(default=None, repr=False)
     _last_pub: dict = field(default_factory=dict)
     _warming: set = field(default_factory=set)
@@ -231,6 +235,19 @@ class MarketMonitor:
                 "structure_signal": signal,
                 "structure_version": payload.get("version")}
 
+    @staticmethod
+    def _family_view(combo_last: dict) -> dict:
+        """Dominant combination family at this tick (the strongest of the
+        15 family scores) — stamped on every update so the analyzer's
+        signal, the executor's trade record and the journal closure all
+        carry entry-signal provenance for PnL attribution
+        (obs/attribution.py)."""
+        if not combo_last:
+            return {}
+        fam = max(combo_last, key=lambda k: combo_last[k])
+        return {"top_family": fam,
+                "top_family_score": float(combo_last[fam])}
+
     def _fetch(self, symbol: str, interval: str):
         """Breaker-guarded per-interval fetch. Each frame is requested at
         its NATIVE interval with limit = kline_limit — the reference's
@@ -341,6 +358,7 @@ class MarketMonitor:
             sp.set_attribute("symbols", len(due))
             for k, v in eng.last_stats.items():
                 sp.set_attribute(k, v)
+        self._expose_drift(eng, due)
         blend_iv = self._blend_iv()
         published = 0
         for symbol in due:
@@ -356,6 +374,7 @@ class MarketMonitor:
                     continue
                 combo_last = update.pop("_combo_last", None)
                 if combo_last:
+                    update.update(self._family_view(combo_last))
                     update.update(self._structure_view(combo_last))
                 self.bus.set(f"historical_data_{symbol}_{iv0}", kl)
                 # The 0.6/0.4 trend blend pairs the primary frame with 5m
@@ -388,6 +407,30 @@ class MarketMonitor:
             raise fetch_error
         return published
 
+    def _expose_drift(self, eng: TickEngine, due: list) -> None:
+        """Primary-frame PSI per polled symbol from the engine's on-device
+        drift output (already in the one host readback — this is a pure
+        numpy slice).  Lanes whose reference was captured only THIS step
+        are skipped: their PSI was computed against the placeholder."""
+        import math
+
+        from ai_crypto_trader_tpu.obs.drift import feature_names
+
+        drift = eng.last_drift
+        if not drift:
+            return
+        psi, ref_set = drift["psi"], drift["ref_set"]
+        names = feature_names()
+        for symbol in due:
+            s = eng.sym_index.get(symbol)
+            if s is None or not ref_set[s, 0] or not eng.last_valid[s, 0]:
+                continue
+            row = {name: float(psi[s, 0, k])
+                   for k, name in enumerate(names)
+                   if math.isfinite(float(psi[s, 0, k]))}
+            if row:
+                self.last_drift[symbol] = row
+
     def _blend_iv(self) -> str | None:
         """The secondary frame the 0.6/0.4 trend blend pairs with: 5m when
         configured (`market_monitor_service.py:273`), else the first
@@ -415,6 +458,7 @@ class MarketMonitor:
             return 0
         combo_last = update.pop("_combo_last", None)
         if combo_last:
+            update.update(self._family_view(combo_last))
             update.update(self._structure_view(combo_last))
         self.bus.set(f"historical_data_{symbol}_{self.intervals[0]}",
                      klines[-self.kline_limit:])

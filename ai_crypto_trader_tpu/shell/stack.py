@@ -230,8 +230,13 @@ def build_full_stack(system, *, registry=None, llm=None,
     if nn:
         from ai_crypto_trader_tpu.models.service import PredictionService
 
-        services.append(PredictionService(bus, symbols, now_fn=now_fn,
-                                          **kw("nn")))
+        # live quality gate + versioning: the system's scorecard judges
+        # HPO winners against the incumbent's live outcomes, the registry
+        # records every candidate (blocked ones as "shadow")
+        services.append(PredictionService(
+            bus, symbols, now_fn=now_fn,
+            **kw("nn", scorecard=getattr(system, "scorecard", None),
+                 registry=registry)))
     if evolver:
         from ai_crypto_trader_tpu.config import EvolutionParams
 

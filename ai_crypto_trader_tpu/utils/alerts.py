@@ -78,6 +78,25 @@ def default_rules() -> list[AlertRule]:
                   lambda s: bool(s.get("donation_failures")),
                   "a donated input buffer survived its dispatch "
                   "(XLA fell back to a silent copy — doubles HBM)"),
+        # --- trading-quality observatory (obs/) ---
+        # PSI > 0.25 is the classic "significant shift" reading; the
+        # feature histograms come out of the fused tick dispatch itself
+        # (ops/tick_engine.py), so this fires on live serving data.
+        AlertRule("SignalDrift", "warning",
+                  lambda s: s.get("feature_psi_max", 0.0) > 0.25,
+                  "a live feature distribution drifted from its "
+                  "reference (PSI > 0.25)"),
+        # scorecard inputs only exist once a window holds min_samples
+        # resolved outcomes (obs/scorecard.py alert_state), so a cold
+        # start can never page.  Brier 0.35 ≈ a confident model that is
+        # wrong more often than it claims; accuracy 0.45 = worse than a
+        # coin on direction.
+        AlertRule("ModelCalibrationBreach", "warning",
+                  lambda s: s.get("model_brier_worst", 0.0) > 0.35,
+                  "a model's live calibration error (Brier) breached 0.35"),
+        AlertRule("ModelAccuracyDegraded", "warning",
+                  lambda s: s.get("model_accuracy_worst", 1.0) < 0.45,
+                  "a model's live directional accuracy fell below 0.45"),
     ]
 
 

@@ -40,20 +40,50 @@ class MetricsRegistry:
     histograms: dict = field(default_factory=lambda: defaultdict(
         lambda: {"buckets": defaultdict(int), "sum": 0.0, "count": 0}))
     now_fn: any = time.time
+    # Bounded label cardinality: per-(arch, symbol, interval) scorecard
+    # gauges and per-(kind, source) attribution series scale with live
+    # data, and an unguarded registry would grow without bound (the
+    # classic Prometheus cardinality explosion — OOM at the scraper, not
+    # here).  Once a metric family holds `max_series_per_metric` distinct
+    # label sets, NEW series are dropped and counted on
+    # `metric_cardinality_dropped_total{metric=...}` instead of silently
+    # accepted; existing series keep updating.
+    max_series_per_metric: int = 512
+    _series_count: dict = field(default_factory=lambda: defaultdict(int))
 
     def _key(self, name: str, labels: dict | None):
         lbl = ",".join(f'{k}="{escape_label_value(v)}"'
                        for k, v in sorted((labels or {}).items()))
         return f"{self.namespace}_{name}{{{lbl}}}" if lbl else f"{self.namespace}_{name}"
 
+    def _admit(self, name: str, key: str, store) -> bool:
+        """True iff `key` may land in `store` (exists, or family has
+        headroom).  The drop counter bypasses the guard: its own
+        cardinality is bounded by the number of metric FAMILIES."""
+        if key in store:
+            return True
+        if self._series_count[name] >= self.max_series_per_metric:
+            if name != "metric_cardinality_dropped_total":
+                self.inc("metric_cardinality_dropped_total", metric=name)
+            return False
+        self._series_count[name] += 1
+        return True
+
     def inc(self, name: str, value: float = 1.0, **labels):
-        self.counters[self._key(name, labels)] += value
+        key = self._key(name, labels)
+        if self._admit(name, key, self.counters):
+            self.counters[key] += value
 
     def set_gauge(self, name: str, value: float, **labels):
-        self.gauges[self._key(name, labels)] = value
+        key = self._key(name, labels)
+        if self._admit(name, key, self.gauges):
+            self.gauges[key] = value
 
     def observe(self, name: str, value: float, **labels):
-        h = self.histograms[self._key(name, labels)]
+        key = self._key(name, labels)
+        if not self._admit(name, key, self.histograms):
+            return
+        h = self.histograms[key]
         h["sum"] += value
         h["count"] += 1
         # Prometheus histogram semantics: buckets are CUMULATIVE — every

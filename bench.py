@@ -14,6 +14,10 @@ The other target rows print one JSON line each ahead of it:
   tick_pipeline           fused tick-engine poll (ONE dispatch + ONE host
                           sync for S=64 symbols × 4 frames, ring-buffer
                           row deltas) vs the per-symbol feature loop
+  flightrec               decision-provenance recorder (obs/flightrec.py):
+                          records/s through ring + checksummed JSONL, and
+                          % overhead on the fused tick path (recorder on
+                          vs off — the ≤5% default-on budget)
   ga_backtests_per_sec    GA generations with real backtest fitness
                           (`services/genetic_algorithm.py:119-133`'s
                           sequential loop, as one device program/gen)
@@ -141,7 +145,9 @@ def append_history(rows: list, path: str | None = None,
     run_id = run_id or time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     scale = {k: os.environ[k] for k in
              ("BENCH_T", "BENCH_POP", "BENCH_TICK_SYMBOLS",
-              "BENCH_SIM_SCENARIOS", "BENCH_SIM_STEPS")
+              "BENCH_SIM_SCENARIOS", "BENCH_SIM_STEPS",
+              "BENCH_FLIGHTREC_N", "BENCH_FLIGHTREC_SYMBOLS",
+              "BENCH_RECOVERY_TRADES")
              if os.environ.get(k)}
     with open(path, "a", encoding="utf-8") as f:
         for row in rows:
@@ -946,6 +952,89 @@ def bench_tick():
          upload_bytes=eng.last_stats.get("upload_bytes"))
 
 
+def bench_flightrec():
+    """flightrec row: decision-provenance recorder cost (obs/flightrec.py).
+
+    Two numbers: raw recorder throughput (begin+veto pairs through the
+    ring AND the checksummed JSONL sink, records/s — the headline value),
+    and the measured overhead of the default-ON recorder on the fused
+    tick path: one engine dispatch + one decision record per symbol,
+    recorder on vs off, median of 3 interleaved.  The acceptance budget
+    is overhead ≤ 5% of the fused tick p50 — a default-on flight
+    recorder must be held to a measured cost, not an assumed one."""
+    import tempfile
+
+    from ai_crypto_trader_tpu.data.ingest import OHLCV
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.obs.flightrec import FlightRecorder
+    from ai_crypto_trader_tpu.ops.tick_engine import TickEngine
+    from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+
+    # -- raw recorder throughput (ring + JSONL, batched fsync) -------------
+    n = int(os.environ.get("BENCH_FLIGHTREC_N", "20000"))
+    feats = {"current_price": 42_000.0, "signal": "BUY",
+             "signal_strength": 55.0, "confluence": 0.4, "rsi": 31.0,
+             "top_family": "rsi_macd"}
+    with tempfile.TemporaryDirectory() as td:
+        fr = FlightRecorder(path=os.path.join(td, "dec.jsonl"),
+                            fsync_every=1024)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            rid = fr.begin("BTCUSDC", features=feats)
+            fr.veto(rid, "confidence_floor")
+        fr.close()
+        rps = n / (time.perf_counter() - t0)
+    log(f"flightrec: {n} begin+veto decisions (ring + JSONL) → "
+        f"{rps:,.0f} records/s")
+
+    # -- overhead on the fused tick path (recorder on vs off) --------------
+    S, T = int(os.environ.get("BENCH_FLIGHTREC_SYMBOLS", "16")), 256
+    frames = ("1m", "3m", "5m", "15m")
+    n_hist = T * 15 + 32
+    d = generate_ohlcv(n=n_hist, seed=7)
+    series = {f"F{i:03d}USDC": OHLCV(
+        timestamp=np.arange(n_hist, dtype=np.int64) * 60_000,
+        open=d["open"] * (1 + 0.02 * i), high=d["high"] * (1 + 0.02 * i),
+        low=d["low"] * (1 + 0.02 * i), close=d["close"] * (1 + 0.02 * i),
+        volume=d["volume"], symbol=f"F{i:03d}USDC") for i in range(S)}
+    ex = FakeExchange(series)
+    ex.advance(steps=n_hist - 16)
+    syms = sorted(series)
+    eng = TickEngine(syms, frames, window=T)
+    fr = FlightRecorder()                    # ring-only, like the launcher
+
+    def tick(recorder):
+        for s in syms:
+            for iv in frames:
+                eng.ingest(s, iv, ex.get_klines(s, iv, T)[-T:])
+        eng.step()
+        if recorder is not None:
+            for s in syms:
+                rid = recorder.begin(s, features=feats)
+                recorder.veto(rid, "confidence_floor")
+
+    tick(None)                               # compile + seed
+    reps_off, reps_on = [], []
+    for _ in range(3):
+        ex.advance(steps=1)
+        t0 = time.perf_counter()
+        tick(None)
+        reps_off.append((time.perf_counter() - t0) * 1e3)
+        ex.advance(steps=1)
+        t0 = time.perf_counter()
+        tick(fr)
+        reps_on.append((time.perf_counter() - t0) * 1e3)
+    off_ms = float(np.median(reps_off))
+    on_ms = float(np.median(reps_on))
+    overhead_pct = max((on_ms - off_ms) / off_ms * 100.0, 0.0)
+    log(f"flightrec: fused tick {off_ms:.2f} ms off vs {on_ms:.2f} ms on "
+        f"(S={S}) → overhead {overhead_pct:.2f}% of tick p50")
+    emit("flightrec", rps, "records/s", None, symbols=S,
+         overhead_pct=round(overhead_pct, 3),
+         tick_ms_recorder_off=round(off_ms, 3),
+         tick_ms_recorder_on=round(on_ms, 3))
+
+
 def bench_ga(arrays):
     """BASELINE row: GA population sweep with REAL backtest fitness (the
     reference's sequential evaluate loop, genetic_algorithm.py:119-133)."""
@@ -1108,6 +1197,7 @@ def run_worker():
 
     secondary = [
         ("tick", bench_tick),
+        ("flightrec", bench_flightrec),
         ("ga", ga_row),
         ("rl", lambda: bench_rl(ind)),
         ("mc", bench_mc),

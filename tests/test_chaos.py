@@ -11,7 +11,11 @@ FakeExchange ground truth:
   * ledger conserved (venue balances re-derive exactly from the fill log;
     closed trades durable across restarts; open books backed by inventory),
   * the system ends healthy (no quarantined stage, fresh heartbeats,
-    no unresolved intents).
+    no unresolved intents),
+  * decision provenance is complete (obs/flightrec.py): every entry fill
+    on the venue chains trace → decision record → client_order_id →
+    fill → (for closed trades) closure PnL across every kill/restart,
+    and every vetoed decision records its rejecting gate.
 
 The tier-1 smoke variant runs a budgeted schedule; the full soak is
 `slow` (pytest -m slow tests/test_chaos.py).
@@ -58,6 +62,7 @@ class SoakRig:
         self.chaos = ChaosExchange(self.inner, self.schedule,
                                    sleep=self._sleep, latency_s=2.0)
         self.journal_path = str(tmp_path / "chaos.journal")
+        self.flightrec_path = str(tmp_path / "decisions.jsonl")
         self.fused = fused
         self.closed_durable: set = set()   # closures that must survive kills
         self.restarts = 0
@@ -76,6 +81,7 @@ class SoakRig:
                                max_block_s=30.0)
         system = TradingSystem(ex, self.symbols, now_fn=self._now,
                                journal_path=self.journal_path,
+                               flightrec_path=self.flightrec_path,
                                stage_backoff_s=0.0, stage_quarantine_s=300.0)
         system.monitor.fused = self.fused
         system.executor.trading = TradingParams(
@@ -92,6 +98,9 @@ class SoakRig:
             (r["symbol"], r["opened_at"]) for r in
             self.system.executor.closed_trades}   # flushed ⇒ must survive
         self.system.journal.simulate_crash()
+        # the flight recorder dies with the process too: its buffered
+        # (non-flushed) veto tail is lost, exactly like a real SIGKILL
+        self.system.flightrec.journal.simulate_crash()
         self.restarts += 1
 
     async def restart_and_recover(self) -> dict:
@@ -187,6 +196,33 @@ def check_invariants(rig: SoakRig, final_tick: dict):
         assert t.stop_order_id is not None and t.tp_order_id is not None
         assert inner.order_is_open(sym, t.stop_order_id)
         assert inner.order_is_open(sym, t.tp_order_id)
+
+    # -- decision provenance complete across every kill/restart -------------
+    from ai_crypto_trader_tpu.obs.flightrec import load_decisions
+
+    system.flightrec.close()                 # flush the batched veto tail
+    decisions, _ = load_decisions(rig.flightrec_path)
+    assert decisions, "flight recorder recorded nothing over the soak"
+    by_coid = {(r.get("exec") or {}).get("client_order_id"): r
+               for r in decisions if r.get("exec")}
+    closed_by_coid = {r.get("entry_coid"): r
+                      for r in executor.closed_trades if r.get("entry_coid")}
+    for f in ent_fills:
+        coid = f["client_order_id"]
+        rec = by_coid.get(coid)
+        assert rec is not None, f"entry fill {coid} has no decision record"
+        assert rec.get("trace_id") or rec.get("id"), coid
+        assert rec.get("fills"), f"entry fill {coid} has no fill record"
+        closed_rec = closed_by_coid.get(coid)
+        if closed_rec is not None:
+            closure = rec.get("closure")
+            assert closure is not None, f"closed {coid} has no closure record"
+            np.testing.assert_allclose(closure["pnl"], closed_rec["pnl"],
+                                       rtol=1e-9, atol=1e-9)
+    #    ... and every vetoed decision names its rejecting gate
+    for rec in decisions:
+        if rec.get("status") == "vetoed":
+            assert rec.get("gate"), f"vetoed decision without a gate: {rec}"
 
     # -- system ends healthy ------------------------------------------------
     assert "skipped" not in final_tick

@@ -1,0 +1,889 @@
+"""Decision provenance & model-quality observatory (obs/).
+
+Covers the flight recorder (write → checksummed replay → query,
+gate vocabulary, provenance chain), prediction outcome resolution
+against a scripted candle future, on-device drift detection (PSI out of
+the fused tick dispatch, host/device parity, alert coherence extending
+the PR 1 suite), PnL attribution folding, the metrics cardinality
+guard, and the scorecard-gated HPO adoption path.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.obs.attribution import PnLAttribution
+from ai_crypto_trader_tpu.obs.drift import (
+    DRIFT_FEATURES,
+    N_BINS,
+    feature_names,
+    psi,
+    reference_histogram,
+)
+from ai_crypto_trader_tpu.obs.flightrec import (
+    GATES,
+    FlightRecorder,
+    format_why,
+    load_decisions,
+)
+from ai_crypto_trader_tpu.obs.scorecard import Scorecard
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_round_trip_write_replay_query(self, tmp_path):
+        """Write vetoed + executed + closed decisions, replay the
+        checksummed JSONL, and query the joined records — the full
+        signal→order→fill→PnL chain survives the file."""
+        path = str(tmp_path / "dec.jsonl")
+        fr = FlightRecorder(path=path, now_fn=lambda: 1000.0)
+        # vetoed decision
+        v = fr.begin("BTCUSDC", features={"signal": "BUY"})
+        fr.set_verdict(v, {"decision": "BUY", "confidence": 0.3},
+                       explanation={"narrative": "weak setup",
+                                    "supporting_factors": ["rsi"]})
+        fr.veto(v, "confidence_floor", detail="0.30 < 0.70")
+        # executed + closed decision
+        e = fr.begin("BTCUSDC", features={"signal": "BUY"})
+        fr.set_verdict(e, {"decision": "BUY", "confidence": 0.9})
+        fr.execution(e, "wj-ent-BTCUSDC-1", symbol="BTCUSDC", quantity=0.5)
+        fr.fill("wj-ent-BTCUSDC-1", 42_000.0, 0.5, symbol="BTCUSDC")
+        fr.closure("wj-ent-BTCUSDC-1", "BTCUSDC", 43_000.0, 500.0,
+                   "Take Profit")
+        fr.close()
+
+        records, stats = load_decisions(path)
+        assert stats["replayed"] >= 4 and stats["corrupt_records"] == 0
+        assert len(records) == 2
+        vetoed = next(r for r in records if r["status"] == "vetoed")
+        assert vetoed["gate"] == "confidence_floor"
+        assert vetoed["gate_detail"] == "0.30 < 0.70"
+        assert vetoed["explanation"]["narrative"] == "weak setup"
+        closed = next(r for r in records if r["status"] == "closed")
+        assert closed["exec"]["client_order_id"] == "wj-ent-BTCUSDC-1"
+        assert closed["fills"][0]["price"] == 42_000.0
+        assert closed["closure"]["pnl"] == 500.0
+        assert closed["trace_id"]
+
+        # in-memory query mirrors the file
+        hits = fr.query(symbol="BTCUSDC")
+        assert len(hits) == 2
+        by_trace = fr.query(trace_id=hits[0]["trace_id"])
+        assert by_trace and by_trace[0]["id"] == hits[0]["id"]
+        why = fr.why("BTCUSDC")
+        assert any("VETO [confidence_floor]" in line for line in why)
+        assert any("Take Profit" in line for line in why)
+
+    def test_corrupt_line_skipped_not_trusted(self, tmp_path):
+        path = str(tmp_path / "dec.jsonl")
+        fr = FlightRecorder(path=path)
+        for i in range(3):
+            fr.veto(fr.begin("ETHUSDC"), "not_buy")
+        fr.close()
+        lines = open(path).read().splitlines()
+        # bit-rot the middle record; append a torn tail
+        lines[1] = lines[1][:-10] + '"corrupted"'
+        open(path, "w").write("\n".join(lines) + "\n" + lines[0][:17])
+        records, stats = load_decisions(path)
+        assert stats["corrupt_records"] == 1 and stats["torn_tail"]
+        assert len(records) == 2
+
+    def test_throttle_hits_counted_not_recorded(self, tmp_path):
+        """analysis_interval fires per symbol per POLL: it is a counter
+        (rate series + why() summary), never a ring slot or JSONL record
+        — real decisions own both."""
+        m = MetricsRegistry()
+        path = str(tmp_path / "dec.jsonl")
+        fr = FlightRecorder(path=path, metrics=m)
+        for _ in range(5):
+            fr.throttled("BTCUSDC")
+        fr.veto(fr.begin("BTCUSDC"), "strength_floor")
+        fr.close()
+        records, _ = load_decisions(path)
+        assert [r["gate"] for r in records] == ["strength_floor"]
+        assert len(fr.query(symbol="BTCUSDC", limit=0)) == 1
+        assert fr.status()["throttled"] == 5
+        key = m._key("decision_vetoes_total", {"gate": "analysis_interval"})
+        assert m.counters[key] == 5.0
+        assert any("5 polls throttled" in line for line in fr.why("BTCUSDC"))
+
+    def test_execution_supersedes_quarantine_veto(self):
+        """A decision parked by mark_open('quarantine') that the executor
+        later drains must not keep the provisional gate — an executed
+        record never carries one, in the ring OR through replay."""
+        fr = FlightRecorder()
+        rid = fr.begin("BTCUSDC")
+        fr.mark_open("quarantine")
+        assert fr.query(symbol="BTCUSDC")[0]["status"] == "vetoed"
+        fr.execution(rid, "wj-ent-BTCUSDC-3", symbol="BTCUSDC")
+        rec = fr.query(symbol="BTCUSDC")[0]
+        assert rec["status"] == "executed"
+        assert rec["gate"] is None and rec["gate_detail"] is None
+        assert fr.vetoed == 0
+
+    def test_quarantine_then_execution_replay_clears_gate(self, tmp_path):
+        path = str(tmp_path / "dec.jsonl")
+        fr = FlightRecorder(path=path)
+        rid = fr.begin("ETHUSDC", features={"signal": "BUY"})
+        fr.veto(rid, "quarantine")           # journaled provisional veto
+        fr.execution(rid, "wj-ent-ETHUSDC-1", symbol="ETHUSDC")
+        fr.close()
+        records, _ = load_decisions(path)
+        assert len(records) == 1
+        assert records[0]["status"] == "executed"
+        assert records[0]["gate"] is None
+        assert records[0]["features"] == {"signal": "BUY"}
+
+    def test_synthetic_veto_does_not_clobber_executed_record(self, tmp_path):
+        """Crash-in-placement-window twin: the execution journaled (flush
+        before place_order), the process died, and AFTER restart — ring
+        lost — recovery resolves the intent as never-placed and vetoes by
+        decision_id.  Replay must show the veto while keeping the original
+        record's features, exec and trace."""
+        path = str(tmp_path / "dec.jsonl")
+        fr = FlightRecorder(path=path)
+        rid = fr.begin("BTCUSDC", features={"signal": "BUY"})
+        fr.execution(rid, "wj-ent-BTCUSDC-7", symbol="BTCUSDC")
+        trace = fr.query(symbol="BTCUSDC")[0]["trace_id"]
+        fr.close()
+        fr2 = FlightRecorder(path=path)          # restart: empty ring
+        fr2.veto(rid, "entry_rejected", symbol="BTCUSDC",
+                 detail="intent discarded: order never reached the venue")
+        fr2.close()
+        records, _ = load_decisions(path)
+        assert len(records) == 1
+        rec = records[0]
+        assert rec["status"] == "vetoed"
+        assert rec["gate"] == "entry_rejected"
+        assert rec["features"] == {"signal": "BUY"}       # not clobbered
+        assert rec["exec"]["client_order_id"] == "wj-ent-BTCUSDC-7"
+        assert rec["trace_id"] == trace
+
+    def test_outcome_veto_journal_record_carries_verdict(self, tmp_path):
+        """The outcome-probability veto is terminal (journals the record):
+        it must land AFTER set_verdict so the durable copy matches the
+        ring — verdict and explanation included."""
+        from ai_crypto_trader_tpu.shell.analyzer import SignalAnalyzer
+
+        class BullTrader:
+            async def analyze_trade_opportunity(self, ctx):
+                return {"decision": "BUY", "confidence": 0.9,
+                        "reasoning": "test", "model_version": "t1"}
+
+        class Pessimist:
+            def predict_trade_outcome(self, feats):
+                return {"status": "success", "success_probability": 0.05}
+
+        path = str(tmp_path / "dec.jsonl")
+        fr = FlightRecorder(path=path)
+        an = SignalAnalyzer(EventBus(), now_fn=lambda: 1_000.0,
+                            flightrec=fr, trader=BullTrader(),
+                            outcome_model=Pessimist())
+        signal = asyncio.run(an.handle_update({
+            "symbol": "BTCUSDC", "current_price": 100.0, "signal": "BUY",
+            "signal_strength": 80.0, "volatility": 0.01,
+            "avg_volume": 1000.0, "rsi": 25.0}))
+        assert signal["decision"] == "HOLD"      # downgraded by the gate
+        fr.close()
+        records, _ = load_decisions(path)
+        rec = next(r for r in records if r["gate"] == "outcome_probability")
+        assert rec["verdict"]["decision"] == "HOLD"
+        assert rec["explanation"]["narrative"]
+
+    def test_not_placed_recovery_vetoes_flight_record(self, tmp_path):
+        """Executor integration for the crash-window discard: a pending
+        entry intent whose order never reached the venue finalizes its
+        decision record as a veto at resolution time."""
+        from ai_crypto_trader_tpu.config import TradingParams
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.executor import TradeExecutor
+
+        series = from_dict(generate_ohlcv(n=300, seed=5), symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000)
+        ex.advance(steps=64)
+        path = str(tmp_path / "dec.jsonl")
+        fr = FlightRecorder(path=path)
+        exe = TradeExecutor(EventBus(), ex, trading=TradingParams(),
+                            flightrec=fr)
+        rid = fr.begin("BTCUSDC")
+        fr.execution(rid, "wj-ent-BTCUSDC-1", symbol="BTCUSDC")
+        exe.pending_intents["wj-ent-BTCUSDC-1"] = {
+            "phase": "entry", "symbol": "BTCUSDC",
+            "client_order_id": "wj-ent-BTCUSDC-1", "quantity": 0.1,
+            "sl_pct": 2.0, "tp_pct": 4.0,
+            "source": {"decision_id": rid, "family": "rsi_macd"}}
+        report = asyncio.run(exe.resolve_pending_intents())
+        assert report["discarded"] == 1
+        fr.close()
+        records, _ = load_decisions(path)
+        rec = next(r for r in records if r["id"] == rid)
+        assert rec["status"] == "vetoed"
+        assert rec["gate"] == "entry_rejected"
+
+    def test_first_gate_wins(self):
+        fr = FlightRecorder()
+        rid = fr.begin("BTCUSDC")
+        fr.veto(rid, "outcome_probability")
+        fr.veto(rid, "not_buy")             # executor's later, blunter gate
+        rec = fr.query(symbol="BTCUSDC")[0]
+        assert rec["gate"] == "outcome_probability"
+
+    def test_ring_bounded_and_coid_index_pruned(self):
+        fr = FlightRecorder(ring_size=8)
+        for i in range(20):
+            rid = fr.begin("BTCUSDC")
+            fr.execution(rid, f"wj-ent-BTCUSDC-{i}")
+        assert len(fr.query(limit=0)) == 8
+        assert len(fr._by_coid) == 8        # evicted entries release index
+
+    def test_veto_metrics_use_known_gates(self):
+        m = MetricsRegistry()
+        fr = FlightRecorder(metrics=m)
+        fr.veto(fr.begin("BTCUSDC"), "pending_intent")
+        key = [k for k in m.counters if "decision_vetoes_total" in k]
+        assert key and 'gate="pending_intent"' in key[0]
+        assert "pending_intent" in GATES
+
+
+class TestExecutorGateVocabulary:
+    def test_veto_reason_covers_every_should_execute_path(self):
+        """veto_reason is the single source behind should_execute: each
+        rejecting configuration returns a gate from the documented
+        vocabulary, and None ⇔ executable."""
+        from ai_crypto_trader_tpu.config import TradingParams
+        from ai_crypto_trader_tpu.shell.executor import TradeExecutor
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+
+        series = from_dict(generate_ohlcv(n=300, seed=1), symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000)
+        exe = TradeExecutor(EventBus(), ex, trading=TradingParams(
+            ai_confidence_threshold=0.7, min_signal_strength=70.0,
+            max_positions=1))
+        good = {"symbol": "BTCUSDC", "signal": "BUY", "decision": "BUY",
+                "confidence": 0.9, "signal_strength": 80.0,
+                "current_price": 100.0, "volatility": 0.01,
+                "avg_volume": 1000.0}
+        assert exe.veto_reason(good) is None
+        assert exe.should_execute(good)
+        cases = [
+            ({"current_price": float("nan")}, "nan_gate"),
+            ({"current_price": 0.0}, "nan_gate"),
+            ({"volatility": float("inf")}, "nan_gate"),
+            ({"confidence": 0.2}, "confidence_floor"),
+            ({"signal_strength": 10.0}, "strength_floor"),
+            ({"decision": "HOLD", "signal": "HOLD"}, "not_buy"),
+            ({"signal": "NEUTRAL"}, "signal_disagreement"),
+        ]
+        for patch, gate in cases:
+            sig = {**good, **patch}
+            assert exe.veto_reason(sig) == gate, (patch, gate)
+            assert not exe.should_execute(sig)
+            assert gate in GATES
+        exe.pending_intents["c1"] = {"symbol": "BTCUSDC"}
+        assert exe.veto_reason(good) == "pending_intent"
+        exe.pending_intents.clear()
+
+
+# ---------------------------------------------------------------------------
+# scorecard: outcome resolution against a scripted candle future
+# ---------------------------------------------------------------------------
+
+def _kline(ts_ms, close):
+    return [ts_ms, close, close, close, close, 10.0]
+
+
+class TestScorecardResolution:
+    def _card(self, bus):
+        return Scorecard(bus=bus, min_samples=2, hit_tolerance=0.01)
+
+    def test_resolution_against_scripted_future(self):
+        """Two predictions: one directionally correct & within tolerance,
+        one wrong — accuracy 0.5, hit-rate 0.5, Brier from confidences."""
+        bus = EventBus()
+        sc = self._card(bus)
+        base = 1_000_000
+        # prediction 1: up from 100 → realized 101 (correct, hit at 1%)
+        sc.record_prediction({
+            "symbol": "BTCUSDC", "interval": "1m", "model_type": "lstm",
+            "predicted_price": 101.0, "confidence": 0.8,
+            "reference_ts": base, "horizon_s": 60.0,
+            "reference_price": 100.0})
+        # prediction 2 (later ref): up from 101 → realized 95 (wrong)
+        sc.record_prediction({
+            "symbol": "BTCUSDC", "interval": "1m", "model_type": "lstm",
+            "predicted_price": 103.0, "confidence": 0.9,
+            "reference_ts": base + 60_000, "horizon_s": 60.0,
+            "reference_price": 101.0})
+        # nothing resolves before the horizon candle exists
+        bus.set("historical_data_BTCUSDC_1m", [_kline(base, 100.0)])
+        assert sc.resolve_due() == 0
+        # prediction 2's horizon candle is the NEWEST row → possibly still
+        # forming on a live venue, so it must NOT resolve yet
+        bus.set("historical_data_BTCUSDC_1m", [
+            _kline(base, 100.0), _kline(base + 60_000, 101.0),
+            _kline(base + 120_000, 95.0)])
+        assert sc.resolve_due() == 1
+        # the next candle arriving proves it closed → resolves at 95
+        bus.set("historical_data_BTCUSDC_1m", [
+            _kline(base, 100.0), _kline(base + 60_000, 101.0),
+            _kline(base + 120_000, 95.0), _kline(base + 180_000, 96.0)])
+        assert sc.resolve_due() == 1
+        score = sc.scores()[("lstm", "BTCUSDC", "1m")]
+        assert score["n"] == 2 and score["live"]
+        assert score["directional_accuracy"] == pytest.approx(0.5)
+        assert score["hit_rate"] == pytest.approx(0.5)
+        # Brier: correct@0.8 → 0.04; wrong@0.9 → 0.81 → mean 0.425
+        assert score["brier"] == pytest.approx((0.04 + 0.81) / 2)
+        assert sc.alert_state()["model_brier_worst"] == pytest.approx(0.425)
+        assert sc.alert_state()["model_accuracy_worst"] == pytest.approx(0.5)
+
+    def test_same_forecast_not_double_registered(self):
+        bus = EventBus()
+        sc = self._card(bus)
+        p = {"symbol": "BTCUSDC", "interval": "1m", "model_type": "gru",
+             "predicted_price": 1.0, "confidence": 0.5,
+             "reference_ts": 5_000, "horizon_s": 60.0,
+             "reference_price": 1.0}
+        assert sc.record_prediction(p)
+        assert not sc.record_prediction(p)     # idempotent per reference_ts
+        assert len(sc._pending) == 1
+
+    def test_legacy_payload_without_provenance_ignored(self):
+        sc = self._card(EventBus())
+        assert not sc.record_prediction({
+            "symbol": "BTCUSDC", "interval": "1m",
+            "predicted_price": 1.0, "confidence": 0.5})
+
+    def test_unresolvable_prediction_expires(self):
+        bus = EventBus()
+        sc = self._card(bus)
+        sc.expire_horizons = 2.0
+        sc.record_prediction({
+            "symbol": "BTCUSDC", "interval": "1m", "model_type": "lstm",
+            "predicted_price": 1.0, "confidence": 0.5,
+            "reference_ts": 0, "horizon_s": 60.0, "reference_price": 1.0})
+        # venue gap: candles jump far past the horizon with none at it
+        bus.set("historical_data_BTCUSDC_1m", [_kline(-60_000, 1.0)])
+        sc.resolve_due()
+        assert len(sc._pending) == 1           # not yet expired
+        # the window only ever holds candles BEFORE the horizon, but time
+        # moved far past it → expire
+        bus.set("historical_data_BTCUSDC_1m",
+                [_kline(-60_000, 1.0), _kline(-1, 1.0)])
+        sc.expire_horizons = -1.0              # force the expiry branch
+        sc.resolve_due()
+        assert len(sc._pending) == 0 and sc.expired_total == 1
+
+    def test_adoption_gate(self):
+        sc = Scorecard(min_samples=2)
+        for correct in (True, True, True, False):   # lstm: 0.75
+            sc._score({"symbol": "B", "interval": "1m",
+                       "model_type": "lstm", "reference_price": 100.0,
+                       "predicted_price": 101.0, "confidence": 0.5},
+                      101.0 if correct else 99.0)
+        for correct in (True, False, False, False):  # gru: 0.25
+            sc._score({"symbol": "B", "interval": "1m",
+                       "model_type": "gru", "reference_price": 100.0,
+                       "predicted_price": 101.0, "confidence": 0.5},
+                      101.0 if correct else 99.0)
+        ok, why = sc.adoption_gate("gru", "lstm", "B", "1m")
+        assert not ok and "live score" in why
+        ok, why = sc.adoption_gate("lstm", "gru", "B", "1m")
+        assert ok and why == "candidate_better"
+        ok, why = sc.adoption_gate("tcn", "lstm", "B", "1m")
+        assert ok and why == "candidate_unscored"
+        ok, why = sc.adoption_gate("lstm", "lstm", "B", "1m")
+        assert ok and why == "same_architecture"
+
+    def test_hpo_adoption_blocked_by_scorecard(self):
+        """The registry/hot-swap path consults the live scorecard: an HPO
+        winner with a known-worse live score than the incumbent is NOT
+        adopted and lands in the registry as shadow."""
+        from ai_crypto_trader_tpu.models.service import PredictionService
+        from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+        bus = EventBus()
+        sc = Scorecard(bus=bus, min_samples=1)
+        reg = ModelRegistry()
+        svc = PredictionService(bus, ["BTCUSDC"], intervals=("1m",),
+                                now_fn=lambda: 1000.0, epochs=1,
+                                scorecard=sc, registry=reg,
+                                hpo_trials=2, seq_len=8)
+
+        class Incumbent:
+            model_type = "lstm"
+
+        svc.models[("BTCUSDC", "1m")] = Incumbent()
+        # live scores: incumbent great, candidate terrible
+        sc._score({"symbol": "BTCUSDC", "interval": "1m",
+                   "model_type": "lstm", "reference_price": 100.0,
+                   "predicted_price": 101.0, "confidence": 0.5}, 101.0)
+        sc._score({"symbol": "BTCUSDC", "interval": "1m",
+                   "model_type": "gru", "reference_price": 100.0,
+                   "predicted_price": 101.0, "confidence": 0.5}, 99.0)
+
+        import ai_crypto_trader_tpu.models.hpo as hpo_mod
+        orig = hpo_mod.optimize_hyperparameters
+
+        def fake_hpo(*a, **kw):
+            return {"best_params": {"model_type": "gru", "units": 8,
+                                    "dropout": 0.0, "learning_rate": 1e-3,
+                                    "batch_size": 8},
+                    "best_val_loss": 0.001}
+
+        hpo_mod.optimize_hyperparameters = fake_hpo
+        try:
+            rec = svc._run_hpo("BTCUSDC", "1m",
+                               np.ones((64, 5), np.float32), 1000.0)
+        finally:
+            hpo_mod.optimize_hyperparameters = orig
+        assert rec["adoption"] == "blocked_by_scorecard"
+        assert "live score" in rec["adoption_reason"]
+        # incumbent still serving; candidate versioned as shadow
+        assert svc.models[("BTCUSDC", "1m")].model_type == "lstm"
+        entry = reg.entries[rec["version"]]
+        assert entry["status"] == "shadow"
+
+    def test_periodic_retrain_cannot_clobber_gated_incumbent(self):
+        """The regular retrain trains the service's DEFAULT architecture;
+        when that would replace a different-arch incumbent it is an
+        architecture swap and must pass the same live gate — otherwise a
+        blocked HPO candidate's arch sneaks in via the 24h cadence."""
+        import jax
+
+        from ai_crypto_trader_tpu.models.service import PredictionService
+        from ai_crypto_trader_tpu.models.train import train_model
+
+        bus = EventBus()
+        sc = Scorecard(bus=bus, min_samples=1)
+        feats = np.cumsum(np.abs(np.random.default_rng(1)
+                                 .normal(1, 0.1, (96, 5))), axis=0) \
+            .astype(np.float32)
+        rows = [_kline(i * 60_000, float(feats[i, 3])) for i in range(96)]
+        bus.set("historical_data_BTCUSDC_1m", rows)
+        svc = PredictionService(bus, ["BTCUSDC"], intervals=("1m",),
+                                now_fn=lambda: 1000.0, epochs=1, seq_len=8,
+                                units=4, model_type="gru", scorecard=sc)
+        incumbent = train_model(jax.random.PRNGKey(0), feats, "lstm",
+                                seq_len=8, epochs=1, units=4, target_col=3)
+        svc.models[("BTCUSDC", "1m")] = incumbent
+        # live scores: lstm incumbent good, gru (the default arch) bad
+        for arch, realized in (("lstm", 101.0), ("gru", 99.0)):
+            sc._score({"symbol": "BTCUSDC", "interval": "1m",
+                       "model_type": arch, "reference_price": 100.0,
+                       "predicted_price": 101.0, "confidence": 0.5},
+                      realized)
+        out = svc._compute(1000.0, None)     # retrain cadence is due
+        assert out["trained"] == 0
+        assert svc.models[("BTCUSDC", "1m")] is incumbent
+        # ... and the pair is deferred, not retried every tick
+        assert svc._last_training[("BTCUSDC", "1m")] == 1000.0
+
+    def test_prediction_payload_carries_resolution_provenance(self):
+        """Satellite: the service snapshot records explicit timestamps,
+        horizon and reference price — previously only the value."""
+        import jax
+
+        from ai_crypto_trader_tpu.models.service import PredictionService
+        from ai_crypto_trader_tpu.models.train import train_model
+
+        bus = EventBus()
+        base = 7_000_000
+        feats = np.cumsum(np.abs(np.random.default_rng(0)
+                                 .normal(1, 0.1, (96, 5))), axis=0) \
+            .astype(np.float32)
+        rows = [_kline(base + i * 60_000, float(feats[i, 3]))
+                for i in range(96)]
+        bus.set("historical_data_BTCUSDC_1m", rows)
+        svc = PredictionService(bus, ["BTCUSDC"], intervals=("1m",),
+                                now_fn=lambda: 12_345.0, epochs=1,
+                                seq_len=8, units=4, model_type="gru")
+        svc.models[("BTCUSDC", "1m")] = train_model(
+            jax.random.PRNGKey(0), feats, "gru", seq_len=8, epochs=1,
+            units=4, target_col=3)
+        asyncio.run(svc.run_once())
+        p = bus.get("nn_prediction_BTCUSDC_1m")
+        assert p["predicted_at"] == 12_345.0
+        assert p["horizon_s"] == 60.0
+        assert p["reference_ts"] == float(rows[-1][0])
+        assert p["reference_price"] == pytest.approx(float(feats[-1, 3]))
+        assert p["model_type"] == "gru"
+        # and the scorecard can ingest it directly
+        sc = Scorecard(bus=bus)
+        assert sc.observe_bus() == 1
+
+
+# ---------------------------------------------------------------------------
+# on-device drift
+# ---------------------------------------------------------------------------
+
+LIMIT = 128
+
+
+def _engine_with_window(seed=3, shift=0.0, scale=1.0):
+    """A 1-symbol engine fed a full window; optional distribution shift."""
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.ops.tick_engine import TickEngine
+
+    d = generate_ohlcv(n=LIMIT + 8, seed=seed)
+    rows = [[i * 60_000,
+             float(d["open"][i]) * scale + shift,
+             float(d["high"][i]) * scale + shift,
+             float(d["low"][i]) * scale + shift,
+             float(d["close"][i]) * scale + shift,
+             float(d["volume"][i])]
+            for i in range(LIMIT)]
+    eng = TickEngine(["BTCUSDC"], ("1m",), window=LIMIT)
+    eng.ingest("BTCUSDC", "1m", rows)
+    return eng, rows
+
+
+class TestOnDeviceDrift:
+    def test_reference_capture_then_stable_psi_near_zero(self):
+        eng, rows = _engine_with_window()
+        eng.step()
+        drift = eng.last_drift
+        # first step: reference captured AFTER the dispatch — not yet set
+        assert not drift["ref_set"][0, 0]
+        assert eng._drift_ref_set[0, 0]
+        eng.ingest("BTCUSDC", "1m", rows)      # identical window
+        eng.step()
+        drift = eng.last_drift
+        assert drift["ref_set"][0, 0]
+        vals = drift["psi"][0, 0]
+        assert np.isfinite(vals).all()
+        assert float(np.max(np.abs(vals))) < 1e-5   # same window ⇒ no drift
+
+    def test_shifted_distribution_raises_psi_above_alert(self):
+        """Re-seed the lane with a price regime whose indicator
+        distributions differ → PSI crosses the SignalDrift threshold for
+        at least one feature, while the reference is retained."""
+        eng, rows = _engine_with_window()
+        eng.step()
+        eng.ingest("BTCUSDC", "1m", rows)
+        eng.step()                              # reference now live
+        base_psi = eng.last_drift["psi"][0, 0].copy()
+        # monotone ramp: RSI pins high, bb_position pins top — a real
+        # distribution shift vs the stationary synthetic regime
+        ramp = [[(LIMIT + i) * 60_000, 100.0 + i, 101.0 + i, 99.0 + i,
+                 100.5 + i, 50.0] for i in range(LIMIT)]
+        eng.ingest("BTCUSDC", "1m", ramp)
+        eng.step()
+        drift = eng.last_drift
+        assert drift["ref_set"][0, 0]
+        shifted = drift["psi"][0, 0]
+        assert float(np.max(shifted)) > 0.25, (base_psi, shifted)
+
+    def test_device_psi_matches_host_twin(self):
+        """The in-program PSI equals obs.drift.psi over the same
+        histograms — the device computation is pinned to the spec."""
+        eng, rows = _engine_with_window()
+        eng.step()
+        eng.ingest("BTCUSDC", "1m", rows)
+        eng.step()
+        drift = eng.last_drift
+        host = psi(drift["hist"][0, 0], eng._drift_ref_np[0, 0])
+        np.testing.assert_allclose(drift["psi"][0, 0], host,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_training_time_reference_installs(self):
+        eng, rows = _engine_with_window()
+        ref = reference_histogram({"rsi": np.full(64, 99.0)})  # pinned high
+        eng.set_drift_reference("BTCUSDC", "1m", ref)
+        eng.step()
+        drift = eng.last_drift
+        assert drift["ref_set"][0, 0]          # set BEFORE the dispatch
+        k = feature_names().index("rsi")
+        # live RSI is nowhere near a point-mass at 99 → large PSI
+        assert float(drift["psi"][0, 0, k]) > 0.25
+
+    def test_monitor_exposes_drift_and_launcher_alerts(self):
+        """End-to-end: fused poll → monitor.last_drift → feature_psi
+        gauges + SignalDrift in-process alert."""
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        clock = {"t": 1_000_000.0}
+        d = generate_ohlcv(n=1200, seed=3)
+        series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                           symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000)
+        ex.advance("BTCUSDC", steps=600)
+        sys_ = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: clock["t"])
+
+        async def go():
+            for _ in range(3):
+                ex.advance("BTCUSDC")
+                clock["t"] += 60.0
+                await sys_.tick()
+
+        asyncio.run(go())
+        assert "BTCUSDC" in sys_.monitor.last_drift
+        row = sys_.monitor.last_drift["BTCUSDC"]
+        assert set(row) <= set(feature_names())
+        text = sys_.metrics.exposition()
+        assert 'crypto_trader_tpu_feature_psi{feature="rsi"' in text
+        # alert rule coherence: forcing a huge PSI fires SignalDrift
+        sys_.monitor.last_drift["BTCUSDC"] = {"rsi": 1.0}
+        fired = sys_.alerts.evaluate(sys_._alert_state())
+        assert any(a["name"] == "SignalDrift" for a in fired)
+
+    def test_one_dispatch_contract_preserved(self, monkeypatch):
+        """Drift adds ZERO host readbacks: one step stays one host_read,
+        one dispatch (the acceptance criterion's contract)."""
+        from ai_crypto_trader_tpu.ops import tick_engine
+
+        eng, rows = _engine_with_window()
+        syncs = {"n": 0}
+        real = tick_engine.host_read
+
+        def counting(tree):
+            syncs["n"] += 1
+            return real(tree)
+
+        monkeypatch.setattr(tick_engine, "host_read", counting)
+        eng.step()
+        assert syncs["n"] == 1 and eng.dispatch_count == 1
+
+
+class TestAlertRuleCoherence:
+    """Extends the PR 1 coherence suite: the three new alerts exist in
+    BOTH rule engines (in-process + PromQL) under the same names."""
+
+    NEW_ALERTS = ("SignalDrift", "ModelCalibrationBreach",
+                  "ModelAccuracyDegraded")
+
+    def test_in_process_rules_exist_and_fire(self):
+        from ai_crypto_trader_tpu.utils.alerts import AlertManager
+
+        mgr = AlertManager()
+        names = {r.name for r in mgr.rules}
+        assert set(self.NEW_ALERTS) <= names
+        fired = mgr.evaluate({"feature_psi_max": 0.9,
+                              "model_brier_worst": 0.9,
+                              "model_accuracy_worst": 0.1})
+        assert set(self.NEW_ALERTS) <= {a["name"] for a in fired}
+        # and resolve when healthy
+        mgr.evaluate({"feature_psi_max": 0.01, "model_brier_worst": 0.05,
+                      "model_accuracy_worst": 0.8})
+        assert not set(self.NEW_ALERTS) & set(mgr.active)
+
+    def test_promql_twins_exist(self):
+        import yaml
+
+        rules = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/alert_rules.yml")))
+        names = {r.get("alert") for g in rules["groups"]
+                 for r in g["rules"]}
+        assert set(self.NEW_ALERTS) <= names
+        assert "MetricCardinalityClipped" in names
+
+
+# ---------------------------------------------------------------------------
+# PnL attribution
+# ---------------------------------------------------------------------------
+
+class TestAttribution:
+    def _rec(self, pnl, family="rsi_macd", reason="Take Profit"):
+        return {"symbol": "BTCUSDC", "pnl": pnl, "reason": reason,
+                "source": {"family": family, "structure_version": "v1",
+                           "model_version": "heuristic-1"}}
+
+    def test_fold_by_family_and_win_rate(self):
+        m = MetricsRegistry()
+        attr = PnLAttribution(metrics=m)
+        cursor = attr.fold_new([self._rec(10.0), self._rec(-4.0),
+                                self._rec(6.0, family="bb_stoch")], 0)
+        assert cursor == 3
+        fam = attr.summary("family")["family"]
+        assert fam["rsi_macd"]["pnl"] == pytest.approx(6.0)
+        assert fam["rsi_macd"]["trades"] == 2
+        assert fam["rsi_macd"]["win_rate"] == pytest.approx(0.5)
+        assert fam["bb_stoch"]["win_rate"] == 1.0
+        attr.export()
+        text = m.exposition()
+        assert ('crypto_trader_tpu_source_realized_pnl{kind="family",'
+                'source="rsi_macd"}') in text
+        assert "crypto_trader_tpu_source_trades_total" in text
+
+    def test_unattributed_closures_still_fold(self):
+        attr = PnLAttribution()
+        attr.fold_record({"symbol": "X", "pnl": 1.0, "reason": "Stop Loss"})
+        assert attr.summary("family")["family"]["unattributed"]["trades"] == 1
+
+    def test_closure_records_carry_provenance_live(self):
+        """Executor → closure record → attribution: the family stamped on
+        the signal survives to the closure and folds."""
+        from ai_crypto_trader_tpu.config import TradingParams
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.executor import TradeExecutor
+
+        series = from_dict(generate_ohlcv(n=400, seed=2), symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000,
+                          fee_rate=0.0)
+        ex.advance(steps=64)
+        fr = FlightRecorder()
+        exe = TradeExecutor(EventBus(), ex, trading=TradingParams(
+            ai_confidence_threshold=0.0, min_signal_strength=0.0,
+            min_trade_amount=1.0), flightrec=fr)
+
+        async def go():
+            price = ex.get_ticker("BTCUSDC")["price"]
+            trade = await exe.handle_signal({
+                "symbol": "BTCUSDC", "signal": "BUY", "decision": "BUY",
+                "confidence": 1.0, "signal_strength": 100.0,
+                "current_price": price, "volatility": 0.01,
+                "avg_volume": 50_000.0, "top_family": "macd_vol",
+                "structure_version": "s9", "model_version": "m2",
+                "decision_id": fr.begin("BTCUSDC")})
+            assert trade is not None
+            assert trade.source["family"] == "macd_vol"
+            await exe.close_trade("BTCUSDC",
+                                  ex.get_ticker("BTCUSDC")["price"], "Test")
+
+        asyncio.run(go())
+        rec = exe.closed_trades[-1]
+        assert rec["source"]["family"] == "macd_vol"
+        assert rec["entry_coid"].startswith("wj-ent-")
+        # the flight recorder chained the closure onto the decision
+        d = fr.query(symbol="BTCUSDC", limit=1)[0]
+        assert d["status"] == "closed" and d["closure"]["reason"] == "Test"
+        attr = PnLAttribution()
+        attr.fold_new(exe.closed_trades, 0)
+        assert "macd_vol" in attr.summary("family")["family"]
+        assert attr.summary("structure")["structure"]["s9"]["trades"] == 1
+
+
+# ---------------------------------------------------------------------------
+# metrics cardinality guard
+# ---------------------------------------------------------------------------
+
+class TestCardinalityGuard:
+    def test_cap_drops_new_series_and_counts(self):
+        m = MetricsRegistry(max_series_per_metric=4)
+        for i in range(10):
+            m.set_gauge("model_hit_rate", 0.5, symbol=f"S{i}")
+        kept = [k for k in m.gauges if "model_hit_rate" in k]
+        assert len(kept) == 4
+        dropped = [v for k, v in m.counters.items()
+                   if "metric_cardinality_dropped_total" in k
+                   and 'metric="model_hit_rate"' in k]
+        assert dropped == [6.0]
+
+    def test_existing_series_keep_updating_past_cap(self):
+        m = MetricsRegistry(max_series_per_metric=2)
+        m.inc("errors_total", kind="a")
+        m.inc("errors_total", kind="b")
+        m.inc("errors_total", kind="c")       # dropped
+        m.inc("errors_total", kind="a")       # still counts
+        assert m.counters[m._key("errors_total", {"kind": "a"})] == 2.0
+        assert m._key("errors_total", {"kind": "c"}) not in m.counters
+
+    def test_histograms_guarded_and_drop_counter_exposed(self):
+        m = MetricsRegistry(max_series_per_metric=1)
+        m.observe("lat_seconds", 0.1, stage="a")
+        m.observe("lat_seconds", 0.1, stage="b")
+        text = m.exposition()
+        assert 'stage="b"' not in text
+        assert ("crypto_trader_tpu_metric_cardinality_dropped_total"
+                '{metric="lat_seconds"} 1.0') in text
+
+    def test_default_cap_far_above_normal_usage(self):
+        assert MetricsRegistry().max_series_per_metric >= 256
+
+
+# ---------------------------------------------------------------------------
+# endpoint + explain wiring
+# ---------------------------------------------------------------------------
+
+class TestDecisionsEndpoint:
+    def test_dashboard_serves_decisions(self):
+        import urllib.request
+
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.dashboard_server import DashboardServer
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        clock = {"t": 1_000.0}
+        series = from_dict(generate_ohlcv(n=900, seed=4), symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series}, quote_balance=10_000)
+        ex.advance("BTCUSDC", steps=600)
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: clock["t"])
+        server = DashboardServer(system, port=0).start()
+        try:
+            async def go():
+                for _ in range(2):
+                    ex.advance("BTCUSDC")
+                    clock["t"] += 120.0
+                    await system.tick()
+
+            asyncio.run(go())
+            url = (f"http://127.0.0.1:{server.port}/decisions"
+                   f"?symbol=BTCUSDC&limit=5")
+            rows = json.loads(urllib.request.urlopen(url, timeout=10).read())
+            assert rows and rows[0]["symbol"] == "BTCUSDC"
+            assert rows[0]["status"] in ("vetoed", "executed", "closed",
+                                         "open")
+            # trace filter round-trips
+            tid = rows[0]["trace_id"]
+            url2 = (f"http://127.0.0.1:{server.port}/decisions"
+                    f"?trace_id={tid}")
+            rows2 = json.loads(urllib.request.urlopen(url2,
+                                                      timeout=10).read())
+            assert rows2 and all(r["trace_id"] == tid for r in rows2)
+            # explanation (strategy/explain.py) rode the decision record
+            analyzed = [r for r in rows if r.get("explanation")]
+            assert analyzed, "no decision carried an explanation"
+            assert analyzed[0]["explanation"]["narrative"]
+        finally:
+            server.stop()
+            system.shutdown()
+
+    def test_explanation_factors_use_real_market_values(self):
+        """Satellite: explain_signal now sees the update's indicator
+        values (rsi/stoch/trend), not bare-signal defaults."""
+        from ai_crypto_trader_tpu.shell.analyzer import SignalAnalyzer
+
+        bus = EventBus()
+        fr = FlightRecorder()
+        an = SignalAnalyzer(bus, now_fn=lambda: 10_000.0, flightrec=fr)
+
+        async def go():
+            return await an.handle_update({
+                "symbol": "BTCUSDC", "current_price": 100.0,
+                "signal": "BUY", "signal_strength": 80.0,
+                "volatility": 0.01, "avg_volume": 500_000.0,
+                "rsi": 22.5, "stoch_k": 11.0, "macd": 1.5,
+                "trend": "uptrend", "trend_strength": 3.0,
+                "top_family": "rsi_stoch"})
+
+        signal = asyncio.run(go())
+        assert signal is not None
+        assert signal["top_family"] == "rsi_stoch"
+        expl = bus.get("explanation_BTCUSDC")
+        assert expl["factors"]["rsi"]["value"] == 22.5
+        assert expl["factors"]["rsi"]["reading"] == "oversold"
+        rec = fr.query(symbol="BTCUSDC", limit=1)[0]
+        assert rec["verdict"]["decision"] == signal["decision"]
+        assert "rsi" in (rec["explanation"]["narrative"] or "")
+        assert rec["features"]["top_family"] == "rsi_stoch"
