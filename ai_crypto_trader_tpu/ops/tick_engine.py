@@ -256,6 +256,47 @@ class TickEngine:
         self._drift_ref = jnp.asarray(self._drift_ref_np)
         self.drift_ref_uploads += 1
 
+    def ingest_row(self, symbol: str, interval: str, row: list) -> bool:
+        """Streamed-row upload seam: apply ONE candle row to a warm lane —
+        O(1) scatter-list work instead of a full-window diff.
+
+        Returns True when applied (in-progress-bar replacement, or an
+        append that advances the ring by exactly one candle).  False means
+        the caller must seed/backfill the lane through the full-window
+        ``ingest`` path: lane still warming, timestamp gap, or an
+        out-of-order row — a streamed row can NEVER tear the ring."""
+        s = self.sym_index.get(symbol)
+        f = self.iv_index.get(interval)
+        if s is None or f is None:
+            return False
+        T = self.window
+        if self._count[s, f] < T:
+            return False                       # warming: needs a full seed
+        ts = int(row[0])
+        arr = np.asarray(row[1:6], np.float32)
+        tail = self._ts[s, f]
+        if ts == int(tail[-1]):                # in-progress bar update
+            if np.array_equal(self._win[s, f, -1], arr):
+                return True                    # exact duplicate: no write
+            self._win[s, f, -1] = arr
+            pos = (int(self._base[s, f]) + T - 1) % T
+        elif ts > int(tail[-1]):
+            step = int(tail[-1] - tail[-2]) if T >= 2 else 0
+            if step <= 0 or ts != int(tail[-1]) + step:
+                return False                   # gap/misalignment: re-seed
+            self._ts[s, f] = np.roll(tail, -1)
+            self._ts[s, f, -1] = ts
+            self._win[s, f] = np.roll(self._win[s, f], -1, axis=0)
+            self._win[s, f, -1] = arr
+            base = (int(self._base[s, f]) + 1) % T
+            self._base[s, f] = base
+            pos = (base + T - 1) % T
+        else:
+            return False                       # older than the window tail
+        self._ring_np[s, f, pos] = arr
+        self._pending[(s, f, pos)] = arr       # latest write wins
+        return True
+
     def ingest(self, symbol: str, interval: str, klines: list) -> None:
         """Diff one (symbol, frame) kline window against the device ring and
         queue only the new/changed rows for the next step()."""

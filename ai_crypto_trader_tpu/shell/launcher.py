@@ -82,6 +82,14 @@ class TradingSystem:
     stage_max_failures: int = 3
     stage_backoff_s: float = 2.0
     stage_quarantine_s: float = 300.0
+    # Streaming ingest (shell/stream.py, wired via attach_stream): while a
+    # stream is attached AND healthy, the websocket feed carries market
+    # data (zero REST kline calls) and the polling monitor stands down;
+    # quarantine or staleness past the supervisor's budget degrades back
+    # to REST polling, and a SL/TP ticker price older than
+    # `ticker_fence_s` (exchange EVENT time) is fenced off — a delayed
+    # feed must not drive stop maintenance with stale prices.
+    ticker_fence_s: float = 10.0
 
     @classmethod
     def with_discovery(cls, exchange, scanner=None, **kw):
@@ -179,6 +187,26 @@ class TradingSystem:
         self.executor._queue()
         self._last_market_update = self.now_fn()
         self._logged_closures = 0
+        self.stream = None                 # StreamSupervisor via attach_stream
+        self._stream_degraded = True       # polling until the feed is healthy
+
+    def attach_stream(self, supervisor) -> None:
+        """Register a shell/stream.StreamSupervisor as the market-data
+        path: its `step()` runs as a supervised stage each tick, the
+        polling monitor automatically resumes while the stream is
+        quarantined or stale, and hands back when it recovers."""
+        from ai_crypto_trader_tpu.utils.supervision import StageBreaker
+
+        if supervisor.bus is None:
+            supervisor.bus = self.bus
+        if supervisor.metrics is None:
+            supervisor.metrics = self.metrics
+        self.stream = supervisor
+        self.stage_breakers["stream"] = StageBreaker(
+            "stream", max_failures=self.stage_max_failures,
+            base_backoff_s=self.stage_backoff_s,
+            quarantine_s=self.stage_quarantine_s)
+        self.heartbeats.expect("stream")
 
     async def recover(self, journal_path: str | None = None) -> dict:
         """Restart recovery: replay the write-ahead journal into the
@@ -281,12 +309,76 @@ class TradingSystem:
         self.heartbeats.beat(name)
         return out
 
+    async def _poll_market(self) -> int:
+        """Market-data stage with the degradation ladder.
+
+        No stream attached → the REST polling monitor (unchanged).  With a
+        stream: the supervised `stream` stage drains queued frames through
+        the monitor's publication path (the stream's candle books as the
+        kline source — zero REST on the happy path); while the stage is
+        quarantined or the feed is stale beyond its budget the polling
+        monitor AUTOMATICALLY resumes, and hands back once the stream is
+        healthy again.  The `stream_mode` gauge (1 = streaming,
+        0 = degraded to poll) makes every transition observable."""
+        if self.stream is None:
+            return await self._run_stage("monitor", self.monitor.poll) or 0
+        published = await self._run_stage("stream", self._stream_stage) or 0
+        # gauges are re-exported here, NOT only inside step(): a failing or
+        # quarantined stage never reaches step()'s export, and Prometheus
+        # would keep scraping the last healthy-looking stream_* values
+        # during exactly the outage the PromQL alerts exist for
+        self.stream.export(self.now_fn())
+        degraded = (self.stage_breakers["stream"].quarantined
+                    or self.stream.degraded(self.now_fn()))
+        if degraded != self._stream_degraded:
+            self._stream_degraded = degraded
+            if degraded:
+                self.log.warning("stream degraded; monitor resuming REST "
+                                 "polling", staleness_s=round(
+                                     self.stream.staleness(self.now_fn()), 1))
+            else:
+                self.log.info("stream healthy; polling monitor stands down")
+        self.metrics.set_gauge("stream_mode", 0.0 if degraded else 1.0)
+        if degraded:
+            published += await self._run_stage("monitor",
+                                               self.monitor.poll) or 0
+        return published
+
+    async def _stream_stage(self):
+        n = await self.stream.step()
+        if not self.stream.degraded(self.now_fn()):
+            # the monitor's DUTY (market-data publication) was genuinely
+            # served through the healthy stream's drain — beat its
+            # heartbeat.  While DEGRADED the polling monitor beats for
+            # itself (or doesn't), so a total market-data outage still
+            # fires ServiceDown(monitor).
+            self.heartbeats.beat("monitor")
+        return n
+
+    def _sl_tp_price(self, symbol: str, now: float) -> float | None:
+        """Price driving the executor's SL/TP maintenance: the stream's
+        sub-candle ticker when its EXCHANGE EVENT time is fresh (within
+        `ticker_fence_s`), else the last published candle close.  A stale
+        stream price is fenced off — event time, not receive time, is the
+        authority (a delayed feed stamps old events with fresh arrivals)."""
+        md = self.bus.get(f"market_data_{symbol}")
+        price = md.get("current_price") if md else None
+        tick = self.bus.get(f"ticker_{symbol}")
+        if tick is not None:
+            event_t = tick.get("event_time", tick.get("timestamp", 0.0))
+            if now - event_t <= self.ticker_fence_s:
+                price = tick.get("price", price)
+        return price
+
     async def _executor_stage(self):
         executed = await self.executor.run_once()
+        now = self.now_fn()
         for symbol in self.symbols:
-            md = self.bus.get(f"market_data_{symbol}")
-            if md and symbol in self.executor.active_trades:
-                await self.executor.on_price(symbol, md["current_price"])
+            if symbol not in self.executor.active_trades:
+                continue
+            price = self._sl_tp_price(symbol, now)
+            if price is not None:
+                await self.executor.on_price(symbol, price)
         return executed
 
     async def _tick_inner(self) -> dict:
@@ -297,8 +389,7 @@ class TradingSystem:
         #                               clock in paper mode, and the latency
         #                               panel must show real compute time
         try:
-            published = await self._run_stage("monitor",
-                                              self.monitor.poll) or 0
+            published = await self._poll_market()
             if published:
                 self._last_market_update = self.now_fn()
             analyzed = await self._run_stage("analyzer",
@@ -521,6 +612,11 @@ class TradingSystem:
         if self.devprof is not None:
             state["slo_burn_rates"] = self.devprof.burn_rates()
             state["donation_failures"] = list(self.devprof.donation_failures)
+        if self.stream is not None:
+            # degrade-to-poll visibility: the in-process rule engine's
+            # StreamDegradedToPoll input (PromQL twin: stream_mode == 0)
+            state["stream_degraded"] = self._stream_degraded
+            state["stream_staleness_s"] = self.stream.staleness(self.now_fn())
         # trading-quality observatory inputs (obs/): worst live model
         # calibration/accuracy and the max on-device feature PSI
         if self.scorecard is not None:
@@ -627,8 +723,22 @@ class TradingSystem:
 
     async def run(self, duration_s: float | None = None,
                   tick_interval_s: float = 5.0):
-        """Wall-clock loop (the `while running` of run_trader.py:1492)."""
-        start = self.now_fn()
-        while duration_s is None or self.now_fn() - start < duration_s:
-            await self.tick()
-            await asyncio.sleep(tick_interval_s)
+        """Wall-clock loop (the `while running` of run_trader.py:1492).
+        With a stream attached whose supervisor owns a transport
+        (`source_factory`), the reconnecting pump runs as a background
+        task for the duration of the loop."""
+        pump_task = None
+        if self.stream is not None and self.stream.source_factory is not None:
+            pump_task = asyncio.ensure_future(self.stream.pump())
+        try:
+            start = self.now_fn()
+            while duration_s is None or self.now_fn() - start < duration_s:
+                await self.tick()
+                await asyncio.sleep(tick_interval_s)
+        finally:
+            if pump_task is not None:
+                pump_task.cancel()
+                try:
+                    await pump_task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass

@@ -260,12 +260,19 @@ class MarketMonitor:
                                  self.kline_limit)
 
     async def poll(self, force: bool = False,
-                   symbols: list[str] | None = None) -> int:
+                   symbols: list[str] | None = None,
+                   fetch=None) -> int:
         """One monitoring pass; returns #updates published.
 
         ``symbols`` narrows the pass to a subset (the push-feed path:
         shell/stream.py marks symbols dirty and refreshes just those);
         None = the full configured universe (the polling path).
+
+        ``fetch`` overrides the kline source — a ``(symbol, interval) →
+        rows`` callable.  The stream passes its continuity-checked candle
+        books here (`MarketStream.serve_klines`) so a streamed drain
+        publishes through this exact path with ZERO REST kline calls;
+        None = the breaker-protected REST fetch (the polling transport).
 
         Fused mode batches every due in-universe symbol through ONE tick-
         engine dispatch; symbols outside the configured universe (possible
@@ -291,20 +298,21 @@ class MarketMonitor:
             batch = [s for s in due if s in eng.sym_index]
             rest = [s for s in due if s not in eng.sym_index]
             if batch:
-                published += await self._poll_fused(batch, now)
+                published += await self._poll_fused(batch, now, fetch=fetch)
         for symbol in rest:
             with tracing.span("monitor.poll", service="monitor",
                               attributes={"symbol": symbol}):
-                published += await self._poll_symbol(symbol, now)
+                published += await self._poll_symbol(symbol, now, fetch=fetch)
         return published
 
-    async def _poll_fused(self, due: list, now: float) -> int:
+    async def _poll_fused(self, due: list, now: float, fetch=None) -> int:
         """Fetch → ingest deltas → ONE dispatch + ONE readback → publish.
 
         Fetching stays per (symbol × frame) — a real venue serves native
         frames — but ALL device work for the batch is a single program and
         the only device→host sync is the engine's host_read."""
         eng = self._get_engine()
+        fetch = fetch or self._fetch
         iv0 = self.intervals[0]
         fetched: dict = {}
         # Same failure semantics as the per-symbol loop: a raising fetch
@@ -321,7 +329,7 @@ class MarketMonitor:
                               attributes={"symbol": symbol,
                                           "frames": len(self.intervals)}):
                 try:
-                    kl = self._fetch(symbol, iv0)
+                    kl = fetch(symbol, iv0)
                     if kl is None:
                         fetched[(symbol, iv0)] = None
                         continue
@@ -334,7 +342,7 @@ class MarketMonitor:
                         continue        # warming: no publish, like the
                         #                 per-symbol path — skip secondaries
                     for iv in self.intervals[1:]:
-                        res = self._fetch(symbol, iv)
+                        res = fetch(symbol, iv)
                         if res:
                             res = res[-self.kline_limit:]
                             eng.ingest(symbol, iv, res)
@@ -439,14 +447,16 @@ class MarketMonitor:
         return "5m" if "5m" in self.intervals[1:] else (
             self.intervals[1] if len(self.intervals) > 1 else None)
 
-    async def _poll_symbol(self, symbol: str, now: float) -> int:
+    async def _poll_symbol(self, symbol: str, now: float,
+                           fetch=None) -> int:
         """Fetch → features → publish for one symbol — the per-symbol path
         (one jit per frame + scalar pulls); the fused engine replaces this
         for in-universe polls, and the parity tests pin the two equal."""
+        fetch = fetch or self._fetch
         with tracing.span("monitor.fetch", service="monitor",
                           attributes={"symbol": symbol,
                                       "interval": self.intervals[0]}):
-            klines = self._fetch(symbol, self.intervals[0])
+            klines = fetch(symbol, self.intervals[0])
         if klines is None:
             return 0
         self._note_warmup(symbol, self.intervals[0], len(klines))
@@ -468,7 +478,7 @@ class MarketMonitor:
         # columns (rsi_3m, macd_5m, …, :285-298) without re-blending.
         blend_iv = self._blend_iv()
         for iv in self.intervals[1:]:
-            res = self._fetch(symbol, iv)
+            res = fetch(symbol, iv)
             if not res:
                 continue
             res = res[-self.kline_limit:]

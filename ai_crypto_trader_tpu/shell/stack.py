@@ -207,6 +207,29 @@ def build_full_stack(system, *, registry=None, llm=None,
             raise TypeError(f"unknown monitor override {k!r}")
         setattr(system.monitor, k, v)
 
+    # streaming ingest (shell/stream.py): a "stream" cadence entry attaches
+    # the websocket-first market-data path — MarketStream kwargs and
+    # StreamSupervisor kwargs share one dict, split by field name; the
+    # degrade-to-poll ladder in the launcher keeps REST as the fallback.
+    stream_kw = dict(cadences.get("stream") or {})
+    if stream_kw.pop("enabled", bool(stream_kw)):
+        from ai_crypto_trader_tpu.shell.stream import (
+            MarketStream, StreamSupervisor)
+
+        ms_fields = {f.name for f in dataclasses.fields(MarketStream)
+                     if not f.name.startswith("_") and f.name != "monitor"}
+        sup_fields = {f.name for f in dataclasses.fields(StreamSupervisor)
+                      if not f.name.startswith("_") and f.name != "stream"}
+        clock = stream_kw.pop("now_fn", system.now_fn)
+        ms_kw = {k: stream_kw.pop(k) for k in list(stream_kw)
+                 if k in ms_fields and k not in sup_fields}
+        unknown = set(stream_kw) - sup_fields
+        if unknown:
+            raise TypeError(f"unknown stream override(s) {sorted(unknown)!r}")
+        stream = MarketStream(system.monitor, now_fn=clock, **ms_kw)
+        system.attach_stream(StreamSupervisor(stream, now_fn=clock,
+                                              **stream_kw))
+
     bus, symbols, now_fn = system.bus, system.symbols, system.now_fn
     services = [
         SocialMonitorService(bus, symbols, now_fn=now_fn,

@@ -242,6 +242,162 @@ class ChaosBus(EventBus):
         inject_bus_faults(self, schedule or FaultSchedule(), exempt)
 
 
+#: fault kinds ChaosFrameSource understands (the websocket-feed analogue
+#: of READ_FAULTS): connection death, a silent-but-connected socket,
+#: duplicate / out-of-order / malformed / stale frames, and burst floods.
+STREAM_FAULTS = ("fs_disconnect", "fs_silence", "fs_dup", "fs_ooo",
+                 "fs_malformed", "fs_stale", "fs_burst")
+
+
+class ChaosFrameSource:
+    """Seeded fault injection for a websocket frame feed (shell/stream.py).
+
+    Works in both of the supervisor's driving modes:
+
+      * **filter mode** (tick-driven soaks): ``filter(frames)`` applies the
+        schedule to a batch of frames and returns
+        ``(mutated_frames, disconnected)`` — the harness forwards the
+        frames to ``StreamSupervisor.offer`` and calls
+        ``connection_lost`` on a disconnect;
+      * **iterator mode** (``pump()`` tests): ``aiter(inner)`` wraps any
+        async frame iterator, applying the same faults per frame and
+        raising ConnectionError on a disconnect.
+
+    Faults: ``fs_disconnect`` (connection dies, frame lost),
+    ``fs_silence`` (the next ``silence_frames`` frames vanish while the
+    socket stays 'connected' — the watchdog's prey), ``fs_dup`` (exact
+    re-send), ``fs_ooo`` (frame held and re-emitted AFTER its successor),
+    ``fs_malformed`` (truncated JSON), ``fs_stale`` (event/open times
+    rewound ``stale_ms`` — an old candle re-served), ``fs_burst``
+    (one frame floods ``burst`` copies — the queue bound's prey).
+    Deterministic: all decisions come from the shared FaultSchedule.
+    """
+
+    def __init__(self, schedule: FaultSchedule, *, silence_frames: int = 8,
+                 burst: int = 64, stale_ms: int = 600_000):
+        self.schedule = schedule
+        self.silence_frames = silence_frames
+        self.burst = burst
+        self.stale_ms = stale_ms
+        self.disconnects = 0
+        self.silenced = 0
+        self._silence_left = 0
+        self._held: str | None = None
+
+    def _restamp_stale(self, frame: str) -> str:
+        """Rewind the frame's event/open timestamps — a stale re-send the
+        continuity tracker must drop as out-of-order, never apply."""
+        import json
+
+        try:
+            d = json.loads(frame)
+        except ValueError:
+            return frame
+        body = d.get("data", d) if isinstance(d, dict) else None
+        if not isinstance(body, dict):
+            return frame
+        if "E" in body:
+            body["E"] = int(body["E"]) - self.stale_ms
+        k = body.get("k")
+        if isinstance(k, dict) and "t" in k:
+            k["t"] = int(k["t"]) - self.stale_ms
+        return json.dumps(d)
+
+    def filter(self, frames: list) -> tuple[list, bool]:
+        out: list = []
+        disconnected = False
+        for f in frames:
+            if self._silence_left > 0:
+                self._silence_left -= 1
+                self.silenced += 1
+                continue
+            fault = self.schedule.next_fault("stream_frame", STREAM_FAULTS)
+            if fault == "fs_disconnect":
+                self.disconnects += 1
+                disconnected = True
+                continue                     # the frame dies with the socket
+            if fault == "fs_silence":
+                self._silence_left = self.silence_frames
+                self.silenced += 1
+                continue
+            if fault == "fs_ooo":
+                if self._held is None:
+                    self._held = f           # held: re-emitted out of order
+                    continue
+                out.append(f)
+            elif fault == "fs_dup":
+                out.extend((f, f))
+            elif fault == "fs_malformed":
+                out.append(f[: max(len(f) // 2, 1)])
+            elif fault == "fs_stale":
+                out.append(self._restamp_stale(f))
+            elif fault == "fs_burst":
+                out.extend([f] * self.burst)
+            else:
+                out.append(f)
+            if self._held is not None and fault != "fs_ooo":
+                out.append(self._held)       # older frame lands AFTER newer
+                self._held = None
+        return out, disconnected
+
+    async def aiter(self, inner):
+        """Wrap an async frame iterator with the same fault schedule
+        (ConnectionError on disconnect) — the pump()-mode adapter."""
+        async for frame in inner:
+            frames, disconnected = self.filter([frame])
+            for f in frames:
+                yield f
+            if disconnected:
+                raise ConnectionError("chaos: stream connection dropped")
+
+
+def kline_frames_for(exchange, symbols, intervals, *, event_ms=None,
+                     combined: bool = False) -> list:
+    """Current-candle kline frames for every (symbol × interval) straight
+    from an exchange's kline surface — the deterministic 'venue side' of a
+    recorded feed (tests / soaks / bench; zero egress).
+
+    The `x` (bar-closed) flag is honest, like the real stream's: a
+    resampled 3m/5m/15m bar is only final once its last 1m constituent is
+    in — the continuity tracker's torn-bar detection keys off it."""
+    from ai_crypto_trader_tpu.shell.stream import interval_ms, kline_frame
+
+    frames = []
+    for s in symbols:
+        cur_1m = exchange.get_klines(s, "1m", 1)
+        if not cur_1m:
+            continue
+        t_1m = int(cur_1m[-1][0])
+        for iv in intervals:
+            rows = exchange.get_klines(s, iv, 2)
+            if not rows:
+                continue
+            step = interval_ms(iv)
+            closed = (t_1m - int(rows[-1][0])) == step - 60_000
+            frames.append(kline_frame(s, iv, rows[-1], closed=closed,
+                                      event_ms=event_ms, combined=combined))
+    return frames
+
+
+class CountingKlines:
+    """Transport-call counter around an exchange: the zero-REST-on-happy-
+    path assertion (tests/test_stream.py and bench.py's stream_latency row
+    share this ONE definition so they can never assert different things)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.kline_calls = 0
+
+    def get_klines(self, *a, **kw):
+        self.kline_calls += 1
+        return self.inner.get_klines(*a, **kw)
+
+    def __getattr__(self, name):
+        if name == "inner":
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+
 def torn_tail(path: str, keep_bytes: int = 17) -> None:
     """Truncate the file's final line mid-record — the on-disk signature
     of a crash during ``write(2)`` that journal replay must tolerate."""

@@ -57,6 +57,16 @@ def default_rules() -> list[AlertRule]:
         AlertRule("ServiceCrashLoop", "critical",
                   lambda s: bool(s.get("crash_looped_services")),
                   "a pipeline stage is quarantined after repeated crashes"),
+        # --- streaming ingest (shell/stream.py) ---
+        # active while the websocket feed is quarantined or stale beyond
+        # its budget and the monitor is carrying the load over REST; the
+        # edge-triggered StreamDisconnected/StreamFlapping alerts come
+        # from the supervisor itself, the PromQL twins ride stream_mode /
+        # stream_connected / stream_reconnects_total.
+        AlertRule("StreamDegradedToPoll", "warning",
+                  lambda s: bool(s.get("stream_degraded")),
+                  "websocket feed unhealthy; monitor polling REST until "
+                  "it recovers"),
         AlertRule("MaxPositionsReached", "info",
                   lambda s: s.get("open_positions", 0) >= s.get("max_positions", 5),
                   "position slots exhausted"),

@@ -147,7 +147,8 @@ def append_history(rows: list, path: str | None = None,
              ("BENCH_T", "BENCH_POP", "BENCH_TICK_SYMBOLS",
               "BENCH_SIM_SCENARIOS", "BENCH_SIM_STEPS",
               "BENCH_FLIGHTREC_N", "BENCH_FLIGHTREC_SYMBOLS",
-              "BENCH_RECOVERY_TRADES")
+              "BENCH_RECOVERY_TRADES", "BENCH_STREAM_SYMBOLS",
+              "BENCH_STREAM_TICKS")
              if os.environ.get(k)}
     with open(path, "a", encoding="utf-8") as f:
         for row in rows:
@@ -952,6 +953,84 @@ def bench_tick():
          upload_bytes=eng.last_stats.get("upload_bytes"))
 
 
+def bench_stream():
+    """stream_latency row: end-to-end EVENT→SIGNAL latency of the streamed
+    path (shell/stream.py) — the serving-latency story that replaces poll
+    cadence (ROADMAP item 5).
+
+    One sample = the wall time from a tick's kline frames ARRIVING at the
+    supervisor (offer) to the monitor publishing every symbol's
+    market_update off them: frame parse + continuity checks + scatter-list
+    delta upload + ONE fused dispatch + ONE host readback + publication.
+    Happy-path contract asserted inline: after the backfill seed, the
+    timed window performs ZERO REST kline calls (rest_kline_calls_steady
+    rides the row).  p50 is the gated headline (ms, lower-better); p99
+    rides along."""
+    import asyncio
+
+    from ai_crypto_trader_tpu.data.ingest import OHLCV
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.bus import EventBus
+    from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+    from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
+    from ai_crypto_trader_tpu.shell.stream import MarketStream, StreamSupervisor
+    from ai_crypto_trader_tpu.testing.chaos import (CountingKlines,
+                                                    kline_frames_for)
+
+    S = int(os.environ.get("BENCH_STREAM_SYMBOLS", "16"))
+    ticks = int(os.environ.get("BENCH_STREAM_TICKS", "40"))
+    T = 256
+    frames = ("1m", "3m", "5m", "15m")
+    n_hist = T * 15 + ticks + 64              # every frame reaches a full
+    #                                           window → zero-REST reachable
+    d = generate_ohlcv(n=n_hist, seed=17)
+    series = {f"W{i:03d}USDC": OHLCV(
+        timestamp=np.arange(n_hist, dtype=np.int64) * 60_000,
+        open=d["open"] * (1 + 0.02 * i), high=d["high"] * (1 + 0.02 * i),
+        low=d["low"] * (1 + 0.02 * i), close=d["close"] * (1 + 0.02 * i),
+        volume=d["volume"], symbol=f"W{i:03d}USDC") for i in range(S)}
+    ex = FakeExchange(series)
+    ex.advance(steps=n_hist - ticks - 8)
+    syms = sorted(series)
+
+    counting = CountingKlines(ex)
+    mon = MarketMonitor(EventBus(), counting, symbols=syms, kline_limit=T)
+    sup = StreamSupervisor(MarketStream(mon))
+
+    async def run():
+        # seed: first frames mark every lane; the drain REST-backfills the
+        # books + compiles and seeds the fused engine (untimed)
+        for f in kline_frames_for(ex, syms, frames,
+                                  event_ms=int(time.time() * 1000)):
+            sup.offer(f)
+        await sup.step()
+        seed_calls = counting.kline_calls
+        lats = []
+        for _ in range(ticks):
+            ex.advance(steps=1)
+            batch = kline_frames_for(ex, syms, frames,
+                                     event_ms=int(time.time() * 1000))
+            t0 = time.perf_counter()        # the event hits the transport
+            for f in batch:
+                sup.offer(f)
+            await sup.step()
+            lats.append((time.perf_counter() - t0) * 1e3)
+        return lats, counting.kline_calls - seed_calls
+
+    t0 = time.perf_counter()
+    lats, rest_calls = asyncio.run(run())
+    log(f"stream: seed+compile {time.perf_counter()-t0:.1f}s total "
+        f"(S={S} × {len(frames)} frames × T={T}, {ticks} timed ticks)")
+    p50 = float(np.percentile(lats, 50))
+    p99 = float(np.percentile(lats, 99))
+    log(f"stream: event→signal p50 {p50:.2f} ms / p99 {p99:.2f} ms, "
+        f"REST kline calls during timed window: {rest_calls}")
+    emit("stream_latency", p50, "ms", None, engine="stream",
+         symbols=S, ticks=ticks, p99_ms=round(p99, 3),
+         frames_per_tick=S * len(frames),
+         rest_kline_calls_steady=int(rest_calls))
+
+
 def bench_flightrec():
     """flightrec row: decision-provenance recorder cost (obs/flightrec.py).
 
@@ -1197,6 +1276,7 @@ def run_worker():
 
     secondary = [
         ("tick", bench_tick),
+        ("stream", bench_stream),
         ("flightrec", bench_flightrec),
         ("ga", ga_row),
         ("rl", lambda: bench_rl(ind)),

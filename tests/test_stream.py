@@ -1,10 +1,15 @@
-"""Push-feed ingestion: recorded miniTicker frames drive the monitor's
-refresh path with the reference's throttle/filter/batch semantics
-(`services/market_monitor_service.py:374-403,615`; `auto_trader.py:33-123`)
-— zero egress, frames injected through the async-iterator seam."""
+"""Streaming-native ingest: recorded miniTicker AND kline frames drive the
+monitor's refresh path — throttle/filter/batch semantics from the
+reference (`services/market_monitor_service.py:374-403,615`;
+`auto_trader.py:33-123`) plus the supervised feed lifecycle: continuity
+enforcement (duplicate/out-of-order/gap handling vs the poll-path
+oracle), bounded REST backfill, reconnect supervision, degrade-to-poll,
+and the stream chaos soak.  Zero egress — every frame is injected."""
 
 import asyncio
 import json
+import os
+import random
 
 import numpy as np
 import pytest
@@ -17,8 +22,14 @@ from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
 from ai_crypto_trader_tpu.shell.stream import (
     BinanceStreamSource,
     MarketStream,
+    StreamSupervisor,
+    binance_kline_url,
+    kline_frame,
     replay_frames,
 )
+from ai_crypto_trader_tpu.testing.chaos import CountingKlines
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _series(n=600, seed=5, symbol="BTCUSDC"):
@@ -152,3 +163,894 @@ class TestRealSourceGate:
             pass
         with pytest.raises(RuntimeError, match="websockets"):
             BinanceStreamSource()
+
+    def test_binance_source_accepts_connection_params(self):
+        """Satellite: url / ping-interval / connect-timeout are ctor
+        parameters (the gate fires first here, but the signature must
+        accept them — a live deployment tunes all three)."""
+        try:
+            import websockets  # noqa: F401
+        except ImportError:
+            with pytest.raises(RuntimeError, match="websockets"):
+                BinanceStreamSource("wss://example/ws", ping_interval_s=5.0,
+                                    connect_timeout_s=2.0)
+            return
+        src = BinanceStreamSource("wss://example/ws", ping_interval_s=5.0,
+                                  connect_timeout_s=2.0)
+        assert src.url == "wss://example/ws"
+        assert src.ping_interval_s == 5.0 and src.connect_timeout_s == 2.0
+        assert src._websockets is not None          # imported once, cached
+
+    def test_combined_kline_url(self):
+        url = binance_kline_url(["BTCUSDC", "ETHUSDC"], ["1m", "5m"])
+        assert url.endswith("btcusdc@kline_1m/btcusdc@kline_5m/"
+                            "ethusdc@kline_1m/ethusdc@kline_5m")
+        assert url.startswith("wss://")
+
+
+# ---------------------------------------------------------------------------
+# kline-stream ingestion: frames → continuity-checked books → fused engine
+# ---------------------------------------------------------------------------
+
+def _kline_setup(symbols=("BTCUSDC", "ETHUSDC"), n=2400, limit=128,
+                 advance=2200):
+    """Rig where ALL FOUR frames reach a full window (the 15m frame needs
+    15×limit 1m candles) so the zero-REST steady state is reachable."""
+    clock = Clock()
+    bus = EventBus(now_fn=clock)
+    series = {s: _series(n=n, seed=10 + i, symbol=s)
+              for i, s in enumerate(symbols)}
+    ex = FakeExchange(series, quote_balance=10_000)
+    ex.advance(steps=advance)
+    counting = CountingKlines(ex)
+    mon = MarketMonitor(bus, counting, symbols=list(symbols), now_fn=clock,
+                        kline_limit=limit)
+    return clock, bus, mon, ex, counting
+
+
+
+
+def _venue_frames(ex, symbols, intervals, *, event_ms=None):
+    from ai_crypto_trader_tpu.testing.chaos import kline_frames_for
+
+    return kline_frames_for(ex, symbols, intervals, event_ms=event_ms)
+
+
+class TestKlineIngest:
+    def test_kline_frame_round_trip_and_ticker_times(self):
+        clock, bus, mon, ex, _ = _kline_setup()
+        st = MarketStream(mon, now_fn=clock)
+        row = ex.get_klines("BTCUSDC", "1m", 2)[-1]
+        ev_ms = int(clock.t * 1000) - 2500            # exchange 2.5 s behind
+        marked = st.ingest_frame(kline_frame("BTCUSDC", "1m", row,
+                                             closed=True, event_ms=ev_ms))
+        assert marked == ["BTCUSDC"]
+        tick = bus.get("ticker_BTCUSDC")
+        assert tick["price"] == float(row[4])
+        # satellite: BOTH exchange event time and host receive time ride
+        # the ticker entry — the executor's staleness fence needs real data
+        assert tick["event_time"] == pytest.approx(ev_ms / 1000.0)
+        assert tick["recv_time"] == clock.t
+        # the lane needs a seed before continuity can be enforced
+        book = st._books[("BTCUSDC", "1m")]
+        assert book.needs_backfill
+
+    def test_combined_stream_kline_envelope(self):
+        clock, bus, mon, ex, _ = _kline_setup()
+        st = MarketStream(mon, now_fn=clock)
+        row = ex.get_klines("BTCUSDC", "1m", 2)[-1]
+        frame = kline_frame("BTCUSDC", "1m", row, combined=True)
+        assert st.ingest_frame(frame) == ["BTCUSDC"]
+
+    def test_malformed_kline_counted(self):
+        clock, bus, mon, ex, _ = _kline_setup()
+        st = MarketStream(mon, now_fn=clock)
+        assert st.ingest_frame(json.dumps({"e": "kline", "s": "BTCUSDC",
+                                           "k": {"i": "1m"}})) == []
+        assert st.malformed_frames == 1
+
+    def test_exotic_interval_units_parse(self):
+        """Every real Binance kline unit has a continuity step — '1s',
+        '1w', '1M' subscriptions must not KeyError the stage."""
+        from ai_crypto_trader_tpu.shell.stream import interval_ms
+        assert interval_ms("1s") == 1_000
+        assert interval_ms("1w") == 7 * 86_400_000
+        assert interval_ms("1M") == 30 * 86_400_000
+        with pytest.raises(ValueError):
+            interval_ms("7x")
+        with pytest.raises(ValueError):
+            interval_ms("")
+
+    def test_unrecognized_interval_poisons_frame_not_stage(self):
+        """A frame whose interval the step table can't parse is counted
+        malformed and dropped — an escaped exception would quarantine
+        EVERY lane, not just the bad one."""
+        clock, bus, mon, ex, _ = _kline_setup()
+        mon.intervals = ("1m", "7x")                 # operator typo
+        st = MarketStream(mon, now_fn=clock)
+        row = ex.get_klines("BTCUSDC", "1m", 2)[-1]
+        bad = kline_frame("BTCUSDC", "7x", row, closed=True)
+        assert st.ingest_frame(bad) == []            # no crash, no lane
+        assert st.malformed_frames == 1
+        assert ("BTCUSDC", "7x") not in st._books
+        # the good lane keeps working
+        good = kline_frame("BTCUSDC", "1m", row, closed=True)
+        assert st.ingest_frame(good) == ["BTCUSDC"]
+
+    def test_kline_per_candle_volume_not_filtered(self):
+        """min_quote_volume is the miniTicker 24h-volume discovery filter;
+        a kline frame's `q` is ONE candle's quote volume and must never be
+        compared against it (it would reject virtually every frame)."""
+        clock, bus, mon, ex, _ = _kline_setup()
+        st = MarketStream(mon, now_fn=clock, min_quote_volume=1_000_000.0)
+        row = ex.get_klines("BTCUSDC", "1m", 2)[-1]
+        frame = kline_frame("BTCUSDC", "1m", row, closed=True,
+                            quote_volume=700.0)      # ~1M/day per-candle
+        assert st.ingest_frame(frame) == ["BTCUSDC"]
+        assert bus.get("ticker_BTCUSDC") is not None
+
+    def test_unfed_book_lane_never_freezes(self):
+        """A lane the stream is not actually feeding (kline channel missing
+        from the subscription) must keep REST-fetching fresh rows on every
+        drain instead of serving its one-time seed forever."""
+        clock, bus, mon, ex, counting = _kline_setup(symbols=("BTCUSDC",))
+        st = MarketStream(mon, now_fn=clock)
+        first = st.serve_klines("BTCUSDC", "1m")     # seed (REST)
+        calls = counting.kline_calls
+        clock.t += 300.0                             # lane stays silent
+        ex.advance(steps=5)
+        again = st.serve_klines("BTCUSDC", "1m")
+        assert counting.kline_calls > calls          # re-fetched, not frozen
+        assert again[-1][0] > first[-1][0]           # fresh rows served
+        # ... while a live-fed lane serves its book with zero REST
+        row = ex.get_klines("BTCUSDC", "1m", 2)[-1]
+        st.ingest_frame(kline_frame("BTCUSDC", "1m", row, closed=True))
+        calls = counting.kline_calls
+        assert st.serve_klines("BTCUSDC", "1m")[-1][0] == row[0]
+        assert counting.kline_calls == calls
+
+    def test_off_interval_kline_updates_ticker_only(self):
+        clock, bus, mon, ex, _ = _kline_setup()
+        st = MarketStream(mon, now_fn=clock)
+        row = ex.get_klines("BTCUSDC", "1m", 2)[-1]
+        assert st.ingest_frame(kline_frame("BTCUSDC", "1h", row)) == []
+        assert st.frames_ignored == 1
+        assert bus.get("ticker_BTCUSDC") is not None
+        assert ("BTCUSDC", "1h") not in st._books
+
+    def test_continuity_dup_ooo_gap(self):
+        clock, bus, mon, ex, _ = _kline_setup()
+        st = MarketStream(mon, now_fn=clock)
+        book = st._book("BTCUSDC", "1m")
+        rows = ex.get_klines("BTCUSDC", "1m", 128)
+        book.seed(rows)
+        step = 60_000
+        nxt = [rows[-1][0] + step, 1.0, 2.0, 0.5, 1.5, 10.0,
+               0, 0.0, 0, 0.0, 0.0, 0]
+        assert book.apply(nxt) == "append"
+        assert book.apply(list(nxt)) == "dup"          # exact re-send
+        old = list(rows[-3])
+        assert book.apply(old) == "out_of_order"
+        gap = list(nxt)
+        gap[0] = nxt[0] + 3 * step                     # skipped 2 candles
+        assert book.apply(gap) == "gap"
+        assert book.needs_backfill
+        # neither dup, ooo nor the gap row itself landed in the window
+        assert book.rows[-1][0] == nxt[0]
+
+    def test_lost_final_update_flags_backfill_not_torn_bar(self):
+        """The tail bar's final (x=true) update was lost: appending the
+        next candle would freeze the torn bar — the book demands a REST
+        repair instead."""
+        clock, bus, mon, ex, _ = _kline_setup()
+        st = MarketStream(mon, now_fn=clock)
+        book = st._book("BTCUSDC", "1m")
+        book.seed(ex.get_klines("BTCUSDC", "1m", 128))
+        t0 = book.rows[-1][0]
+        bar1 = [t0 + 60_000, 1.0, 2.0, 0.5, 1.5, 10.0, 0, 0.0, 0, 0.0, 0.0, 0]
+        assert book.apply(bar1, closed=False) == "append"  # in-progress
+        # ... its final form never arrives; the NEXT bar shows up
+        bar2 = [t0 + 120_000, 1.5, 2.5, 1.0, 2.0, 9.0, 0, 0.0, 0, 0.0, 0.0, 0]
+        assert book.apply(bar2, closed=True) == "unconfirmed"
+        assert book.needs_backfill
+        # the confirmed path: final update lands, then the append is clean
+        book.needs_backfill = False
+        assert book.apply(list(bar1), closed=True) == "dup"  # flag rides dups
+        assert book.apply(bar2, closed=True) == "append"
+
+    def test_pending_is_ordered_set_and_last_seen_bounded(self):
+        """Satellite: `_pending` dict-backed ordered set (O(1) membership),
+        `_last_seen` LRU-bounded."""
+        clock, bus, mon, ex, _ = _kline_setup()
+        st = MarketStream(mon, now_fn=clock, restrict_to_universe=False,
+                          max_tracked=8)
+        frame = _frame(*[(f"Z{i:03d}USDC", 1.0, 1e6) for i in range(40)])
+        marked = st.ingest_frame(frame)
+        assert marked == [f"Z{i:03d}USDC" for i in range(40)]  # order kept
+        assert list(st._pending) == marked
+        assert len(st._last_seen) <= 8                 # bounded under churn
+        # membership stays O(1)-correct: re-offering doesn't duplicate
+        clock.t += 10.0
+        st.ingest_frame(frame)
+        assert list(st._pending) == marked
+
+
+class TestStreamedDrains:
+    def test_zero_rest_klines_on_happy_path(self):
+        """Tentpole (a): after the one-time backfill seed, streamed drains
+        publish with ZERO REST kline calls and ONE fused dispatch each."""
+        clock, bus, mon, ex, counting = _kline_setup(symbols=("BTCUSDC",))
+        st = MarketStream(mon, now_fn=clock)
+        ivs = mon.intervals
+
+        async def go():
+            # seed drain: books empty → bounded REST backfill (counted)
+            for f in _venue_frames(ex, ["BTCUSDC"], ivs,
+                                   event_ms=int(clock.t * 1000)):
+                st.ingest_frame(f)
+            n = await st.drain()
+            assert n == 1
+            seed_calls = counting.kline_calls
+            assert seed_calls >= len(ivs)              # the backfill seed
+            eng = mon._engine
+            # steady state: frames only, no REST
+            for _ in range(5):
+                ex.advance(steps=1)
+                clock.t += 60.0
+                for f in _venue_frames(ex, ["BTCUSDC"], ivs,
+                                       event_ms=int(clock.t * 1000)):
+                    st.ingest_frame(f)
+                d0 = eng.dispatch_count
+                n = await st.drain()
+                assert n == 1
+                assert eng.dispatch_count == d0 + 1    # ONE dispatch/drain
+                assert not eng.last_stats["full_seed"]
+            assert counting.kline_calls == seed_calls  # ZERO further REST
+            assert st.streamed_rows > 0                # ingest_row fed ring
+            # ring parity: engine window == the venue's own REST answer
+            for iv in ivs:
+                oracle = ex.get_klines("BTCUSDC", iv, mon.kline_limit)
+                want = np.asarray([r[1:6] for r in oracle], np.float32)
+                s, f = eng.sym_index["BTCUSDC"], eng.iv_index[iv]
+                np.testing.assert_array_equal(eng._win[s, f], want)
+                assert list(eng._ts[s, f]) == [r[0] for r in oracle]
+
+        asyncio.run(go())
+
+    def test_gap_triggers_bounded_backfill(self):
+        """Tentpole (c): a reconnect window (missed candles) marks the lane
+        and the next drain REST-backfills it BEFORE any ring upload — the
+        window ends contiguous and equal to the oracle."""
+        clock, bus, mon, ex, counting = _kline_setup(symbols=("BTCUSDC",))
+        st = MarketStream(mon, now_fn=clock)
+        ivs = mon.intervals
+
+        async def go():
+            for f in _venue_frames(ex, ["BTCUSDC"], ivs):
+                st.ingest_frame(f)
+            await st.drain()
+            # a 5-candle outage the stream never saw
+            ex.advance(steps=5)
+            clock.t += 300.0
+            gap_frames = _venue_frames(ex, ["BTCUSDC"], ["1m"])
+            st.ingest_frame(gap_frames[0])
+            assert st.gaps >= 1
+            assert st._books[("BTCUSDC", "1m")].needs_backfill
+            before = counting.kline_calls
+            n = await st.drain()
+            assert n == 1
+            assert counting.kline_calls > before       # REST backfill ran
+            book = st._books[("BTCUSDC", "1m")]
+            oracle = ex.get_klines("BTCUSDC", "1m", mon.kline_limit)
+            assert [r[0] for r in book.rows] == [r[0] for r in oracle]
+            steps = np.diff([r[0] for r in book.rows])
+            assert (steps == 60_000).all()             # contiguous again
+
+        asyncio.run(go())
+
+    def test_fault_injection_never_tears_ring_vs_poll_oracle(self):
+        """Property test: duplicate / out-of-order / malformed / partial /
+        stale frames NEVER change ring contents vs the poll-path oracle."""
+        from ai_crypto_trader_tpu.testing.chaos import (
+            ChaosFrameSource, FaultSchedule)
+
+        clock, bus, mon, ex, counting = _kline_setup(symbols=("BTCUSDC",))
+        st = MarketStream(mon, now_fn=clock)
+        chaos = ChaosFrameSource(FaultSchedule(seed=13, rates={
+            "fs_dup": 0.15, "fs_ooo": 0.15, "fs_malformed": 0.1,
+            "fs_stale": 0.1}))
+        ivs = mon.intervals
+
+        async def go():
+            for f in _venue_frames(ex, ["BTCUSDC"], ivs):
+                st.ingest_frame(f)
+            await st.drain()
+            for _ in range(30):
+                ex.advance(steps=1)
+                clock.t += 60.0
+                frames, _ = chaos.filter(_venue_frames(
+                    ex, ["BTCUSDC"], ivs, event_ms=int(clock.t * 1000)))
+                for f in frames:
+                    st.ingest_frame(f)
+                await st.drain()
+            # the schedule actually injected several kinds
+            kinds = {f for _, _, f in chaos.schedule.injected}
+            assert len(kinds) >= 3, kinds
+            assert st.dup_frames + st.ooo_frames + st.malformed_frames > 0
+            # settle: two fault-free ticks so the CURRENT in-progress bar's
+            # newest update lands (a lost in-progress update legitimately
+            # leaves the unfinished bar one tick stale until the next
+            # frame; closed candles are protected by the unconfirmed-tail
+            # backfill and must match bit-for-bit regardless)
+            chaos.schedule.rates = {}
+            for _ in range(2):
+                ex.advance(steps=1)
+                clock.t += 60.0
+                frames, _ = chaos.filter(_venue_frames(
+                    ex, ["BTCUSDC"], ivs, event_ms=int(clock.t * 1000)))
+                for f in frames:
+                    st.ingest_frame(f)
+                await st.drain()
+            eng = mon._engine
+            for iv in ivs:
+                oracle = ex.get_klines("BTCUSDC", iv, mon.kline_limit)
+                want = np.asarray([r[1:6] for r in oracle], np.float32)
+                s, f = eng.sym_index["BTCUSDC"], eng.iv_index[iv]
+                np.testing.assert_array_equal(eng._win[s, f], want)
+                ts = eng._ts[s, f]
+                assert (np.diff(ts) > 0).all()         # strictly ordered
+                assert len(set(ts.tolist())) == len(ts)  # zero duplicates
+
+        asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# the supervised lifecycle
+# ---------------------------------------------------------------------------
+
+class TestSupervisor:
+    def _sup(self, clock=None, **kw):
+        clock = clock or Clock()
+        bus = EventBus(now_fn=clock)
+        series = {"BTCUSDC": _series(seed=3)}
+        ex = FakeExchange(series)
+        mon = MarketMonitor(bus, ex, symbols=["BTCUSDC"], now_fn=clock,
+                            kline_limit=128, fused=False)
+        st = MarketStream(mon, now_fn=clock)
+        return clock, bus, StreamSupervisor(st, bus=bus, now_fn=clock, **kw)
+
+    def test_bounded_queue_drops_oldest(self):
+        clock, bus, sup = self._sup(queue_max=4)
+        for i in range(10):
+            sup.offer(f"frame{i}")
+        assert len(sup._q) == 4
+        assert list(sup._q) == ["frame6", "frame7", "frame8", "frame9"]
+        assert sup.frames_dropped == 6                 # counted, not silent
+
+    def test_disconnect_edges_and_flapping_alert(self):
+        clock, bus, sup = self._sup(flap_threshold=3, flap_window_s=120.0)
+        q = bus.subscribe("alerts")
+
+        async def go():
+            for _ in range(3):
+                sup.offer("[]")
+                sup.connection_lost("chaos")
+                clock.t += 10.0
+            sup.connection_lost("chaos again")         # no edge: already down
+            await sup.step()
+
+        asyncio.run(go())
+        names = []
+        while not q.empty():
+            names.append(q.get_nowait()["data"]["name"])
+        assert names.count("StreamDisconnected") == 3  # edge-triggered
+        assert names.count("StreamFlapping") == 1
+        assert sup.disconnects == 3 and sup.reconnects == 2
+
+    def test_silence_watchdog_forces_disconnect(self):
+        clock, bus, sup = self._sup(max_silence_s=30.0)
+        sup.offer("[]")
+        assert sup.connected
+        clock.t += 45.0                                # silent past budget
+
+        async def go():
+            await sup.step()
+
+        asyncio.run(go())
+        assert not sup.connected
+        assert sup.degraded()
+        assert sup.disconnects == 1
+
+    def test_degraded_before_first_frame_and_staleness(self):
+        clock, bus, sup = self._sup(stale_after_s=30.0)
+        assert sup.degraded()                          # never connected
+        sup.offer("[]")
+        assert not sup.degraded()
+        clock.t += 31.0
+        assert sup.degraded()                          # stale past budget
+        assert sup.staleness() == pytest.approx(31.0)
+
+    def test_pump_reconnects_with_backoff_and_jitter(self):
+        clock, bus, sup = self._sup()
+        sleeps = []
+
+        async def fake_sleep(s):
+            sleeps.append(s)
+
+        async def dies_after(frames):
+            for f in frames:
+                yield f
+            raise ConnectionError("socket reset")
+
+        sources = [dies_after(['[{"s": "BTCUSDC", "c": "1", "q": "0"}]']),
+                   dies_after(['[{"s": "BTCUSDC", "c": "2", "q": "0"}]'])]
+
+        def factory():
+            return sources.pop(0) if sources else None
+
+        sup.source_factory = factory
+        sup.sleep = fake_sleep
+        asyncio.run(sup.pump())
+        assert sup.frames_offered == 2
+        assert sup.disconnects == 2                    # both sockets died
+        assert sup.reconnects == 1                     # second connect
+        assert len(sleeps) == 2 and all(s > 0 for s in sleeps)
+
+    def test_pump_read_timeout_reconnects(self):
+        clock, bus, sup = self._sup(connect_timeout_s=0.02,
+                                    read_timeout_s=0.02)
+        sleeps = []
+
+        async def fake_sleep(s):
+            sleeps.append(s)
+
+        async def hangs():
+            await asyncio.sleep(5)
+            yield ""                                   # pragma: no cover
+
+        sources = [hangs()]
+
+        def factory():
+            return sources.pop(0) if sources else None
+
+        sup.source_factory = factory
+        sup.sleep = fake_sleep
+        asyncio.run(asyncio.wait_for(sup.pump(), 5))
+        assert len(sleeps) == 1                        # backed off once
+
+    def test_gauges_exported(self):
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        clock, bus, sup = self._sup()
+        sup.metrics = MetricsRegistry(now_fn=clock)
+        sup.offer("not json")
+
+        async def go():
+            await sup.step()
+
+        asyncio.run(go())
+        text = sup.metrics.exposition()
+        for name in ("stream_connected", "stream_staleness_seconds",
+                     "stream_queue_depth", "stream_frames_total",
+                     "stream_malformed_frames_total"):
+            assert f"crypto_trader_tpu_{name}" in text, name
+
+
+# ---------------------------------------------------------------------------
+# the degradation ladder (launcher integration) and the stream chaos soak
+# ---------------------------------------------------------------------------
+
+def _streamed_system(tmp_path=None, symbols=("BTCUSDC",), n=2400, limit=128,
+                     advance=2200, seed0=10):
+    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+    clock = Clock()
+    series = {s: _series(n=n, seed=seed0 + i, symbol=s)
+              for i, s in enumerate(symbols)}
+    ex = FakeExchange(series, quote_balance=10_000)
+    ex.advance(steps=advance)
+    counting = CountingKlines(ex)
+    kw = {}
+    if tmp_path is not None:
+        kw["journal_path"] = str(tmp_path / "stream.journal")
+    sys_ = TradingSystem(counting, list(symbols), now_fn=clock, **kw)
+    sys_.monitor.kline_limit = limit
+    st = MarketStream(sys_.monitor, now_fn=clock)
+    sup = StreamSupervisor(st, now_fn=clock, stale_after_s=45.0,
+                           max_silence_s=90.0)
+    sys_.attach_stream(sup)
+    return clock, sys_, sup, ex, counting
+
+
+class TestDegradationLadder:
+    def test_degrade_to_poll_and_hand_back(self):
+        """Tentpole (d): no frames → the polling monitor carries the load
+        (stream_mode 0); frames arrive → the stream takes over with zero
+        REST klines (stream_mode 1); feed goes silent past budget → the
+        monitor automatically resumes; frames return → hands back."""
+        clock, sys_, sup, ex, counting = _streamed_system()
+        ivs = sys_.monitor.intervals
+        modes = []
+
+        def mode():
+            return sys_.metrics.gauges.get("crypto_trader_tpu_stream_mode")
+
+        async def tick(feed):
+            ex.advance(steps=1)
+            clock.t += 60.0
+            if feed:
+                for f in _venue_frames(ex, list(sys_.symbols), ivs,
+                                       event_ms=int(clock.t * 1000)):
+                    sup.offer(f)
+            out = await sys_.tick()
+            modes.append(mode())
+            return out
+
+        async def go():
+            # phase 1: stream never connected → monitor polls REST
+            out = await tick(feed=False)
+            assert out["published"] == 1
+            assert modes[-1] == 0.0
+            assert sys_._stream_degraded
+            polled_calls = counting.kline_calls
+            assert polled_calls > 0
+            # phase 2: frames flow → stream takes over; monitor stands down
+            await tick(feed=True)              # backfill seed drain (REST)
+            assert modes[-1] == 1.0
+            seed_calls = counting.kline_calls
+            for _ in range(3):
+                out = await tick(feed=True)
+                assert out["published"] == 1
+                assert modes[-1] == 1.0
+            assert counting.kline_calls == seed_calls   # ZERO REST klines
+            # StreamDegradedToPoll resolved in the rule engine
+            assert "StreamDegradedToPoll" not in sys_.alerts.active
+            # phase 3: silence past the budget → degrade back to REST poll
+            out = await tick(feed=False)
+            assert modes[-1] == 0.0
+            assert out["published"] == 1       # the monitor carried the tick
+            assert "StreamDegradedToPoll" in sys_.alerts.active
+            # phase 4: feed recovers → hand back
+            await tick(feed=True)
+            assert modes[-1] == 1.0
+            assert "StreamDegradedToPoll" not in sys_.alerts.active
+            # the monitor heartbeat stayed fresh in BOTH modes
+            assert clock.t - sys_.heartbeats.beats["monitor"] <= 60.0
+            assert clock.t - sys_.heartbeats.beats["stream"] <= 60.0
+
+        asyncio.run(go())
+
+    def test_healthy_stream_does_not_starve_unfed_symbol(self):
+        """A universe symbol the subscription isn't feeding (operator URL
+        drift, a dropped channel) must keep publishing through REST within
+        the lane-staleness budget even while the stream is healthy — the
+        full-universe poll never runs at stream_mode 1, so without
+        mark_starved the lane would freeze forever, unalerted."""
+        clock, bus, mon, ex, counting = _kline_setup()
+        st = MarketStream(mon, now_fn=clock)
+        sup = StreamSupervisor(st, now_fn=clock)
+        ivs = mon.intervals
+
+        async def go():
+            # seed: BOTH symbols feed once
+            for f in _venue_frames(ex, ["BTCUSDC", "ETHUSDC"], ivs,
+                                   event_ms=int(clock.t * 1000)):
+                sup.offer(f)
+            await sup.step()
+            last_eth = mon._last_pub["ETHUSDC"]
+            # ETHUSDC's channel silently drops; only BTCUSDC keeps feeding
+            for _ in range(4):                   # 240s ≫ the 90s budget
+                ex.advance(steps=1)
+                clock.t += 60.0
+                for f in _venue_frames(ex, ["BTCUSDC"], ivs,
+                                       event_ms=int(clock.t * 1000)):
+                    sup.offer(f)
+                await sup.step()
+            assert not sup.degraded(clock.t)     # the stream itself: healthy
+            assert mon._last_pub["ETHUSDC"] > last_eth   # lane served anyway
+            upd = bus.get("market_data_ETHUSDC")
+            assert upd is not None and upd["symbol"] == "ETHUSDC"
+
+        asyncio.run(go())
+
+    def test_quarantined_stream_stage_degrades(self):
+        """A crash-looping stream stage is quarantined by the supervisor
+        (StageBreaker) and the monitor resumes polling."""
+        clock, sys_, sup, ex, counting = _streamed_system()
+
+        async def boom():
+            raise RuntimeError("poisoned frame")
+
+        sup.step = boom
+
+        async def go():
+            for _ in range(sys_.stage_max_failures):
+                ex.advance(steps=1)
+                clock.t += 60.0
+                out = await sys_.tick()
+                assert out["published"] == 1   # monitor carried every tick
+            assert sys_.stage_breakers["stream"].quarantined
+            assert sys_.metrics.gauges[
+                "crypto_trader_tpu_stream_mode"] == 0.0
+            # gauges stay TRUTHFUL while quarantined: step() never runs
+            # (so its export never fires), but the launcher re-exports
+            # every tick — Prometheus must not keep scraping the last
+            # healthy-looking values during exactly this outage
+            stale_before = sys_.metrics.gauges[
+                "crypto_trader_tpu_stream_staleness_seconds"]
+            clock.t += 600.0
+            ex.advance(steps=1)
+            await sys_.tick()
+            assert sys_.metrics.gauges[
+                "crypto_trader_tpu_stream_staleness_seconds"] >= \
+                stale_before + 600.0
+
+        asyncio.run(go())
+
+    def test_degraded_stream_stage_withholds_monitor_heartbeat(self):
+        """While the feed is degraded the stream stage must NOT beat the
+        monitor heartbeat — during a simultaneous REST outage, ServiceDown
+        (monitor) has to be able to fire."""
+        clock, sys_, sup, ex, counting = _streamed_system()
+        assert sup.degraded(clock.t)                 # never connected
+        sys_.heartbeats.beats.pop("monitor", None)
+
+        async def go():
+            await sys_._stream_stage()
+
+        asyncio.run(go())
+        assert "monitor" not in sys_.heartbeats.beats  # withheld
+        # healthy stream → the beat lands
+        sup.offer("[]")
+        asyncio.run(go())
+        assert sys_.heartbeats.beats["monitor"] == clock.t
+
+    def test_pump_read_timeout_bounded_by_silence_budget(self):
+        """The pump's per-read timeout is min(read_timeout_s,
+        max_silence_s): the watchdog and the transport tear down a silent
+        socket on the same clock, so a late frame can't be miscounted as a
+        reconnect of a link that never dropped."""
+        clock = Clock()
+        bus = EventBus(now_fn=clock)
+        mon = MarketMonitor(bus, FakeExchange({"BTCUSDC": _series(seed=3)}),
+                            symbols=["BTCUSDC"], now_fn=clock, fused=False)
+        sup = StreamSupervisor(MarketStream(mon, now_fn=clock), bus=bus,
+                               now_fn=clock, read_timeout_s=60.0,
+                               max_silence_s=0.02, connect_timeout_s=0.02)
+        sleeps = []
+
+        async def fake_sleep(s):
+            sleeps.append(s)
+
+        async def slow():
+            yield "[]"
+            await asyncio.sleep(5)               # silent past the budget
+            yield "[]"                           # pragma: no cover
+
+        sources = [slow()]
+        sup.source_factory = lambda: sources.pop(0) if sources else None
+        sup.sleep = fake_sleep
+        asyncio.run(asyncio.wait_for(sup.pump(), 5))
+        assert sup.frames_offered == 1           # second read timed out fast
+        assert sup.disconnects == 1
+
+    def test_ticker_staleness_fence(self):
+        """Satellite: SL/TP maintenance uses the stream's sub-candle ticker
+        only while its EXCHANGE EVENT time is fresh; a delayed feed's
+        prices are fenced off in favor of the candle close."""
+        clock, sys_, sup, ex, counting = _streamed_system()
+        sys_.bus.set("market_data_BTCUSDC", {"current_price": 100.0})
+        # fresh event time → ticker price wins
+        sys_.bus.set("ticker_BTCUSDC", {"price": 101.5,
+                                        "event_time": clock.t - 2.0,
+                                        "recv_time": clock.t})
+        assert sys_._sl_tp_price("BTCUSDC", clock.t) == 101.5
+        # stale EVENT time (delayed feed), fresh receive time → fenced off
+        sys_.bus.set("ticker_BTCUSDC", {"price": 99.0,
+                                        "event_time": clock.t - 60.0,
+                                        "recv_time": clock.t})
+        assert sys_._sl_tp_price("BTCUSDC", clock.t) == 100.0
+        # no ticker at all → candle close
+        sys_.bus.delete("ticker_BTCUSDC")
+        assert sys_._sl_tp_price("BTCUSDC", clock.t) == 100.0
+
+
+class StreamSoakRig:
+    """Tick-driven stream chaos soak: one venue, a chaos frame feed, the
+    full TradingSystem with the supervised stream attached."""
+
+    def __init__(self, tmp_path, symbols, rates, seed, limit=128, n=3000,
+                 advance=2200):
+        from ai_crypto_trader_tpu.testing.chaos import (
+            ChaosFrameSource, FaultSchedule)
+
+        (self.clock, self.system, self.sup, self.ex,
+         self.counting) = _streamed_system(tmp_path, symbols, n=n,
+                                           limit=limit, advance=advance)
+        self.tmp_path = tmp_path
+        self.symbols = list(symbols)
+        self.chaos = ChaosFrameSource(FaultSchedule(seed=seed, rates=rates),
+                                      silence_frames=4 * len(symbols))
+        self.modes = []
+        self.forced_disconnects = 0
+
+    def mode(self):
+        return self.system.metrics.gauges.get("crypto_trader_tpu_stream_mode")
+
+    async def tick(self, feed=True, disconnect=False):
+        self.ex.advance(steps=1)
+        self.clock.t += 60.0
+        if feed:
+            frames, dropped_conn = self.chaos.filter(_venue_frames(
+                self.ex, self.symbols, self.system.monitor.intervals,
+                event_ms=int(self.clock.t * 1000)))
+            for f in frames:
+                self.sup.offer(f)
+            if dropped_conn:
+                self.sup.connection_lost("chaos: transport died")
+        if disconnect:
+            self.sup.connection_lost("chaos: forced disconnect")
+            self.forced_disconnects += 1
+        out = await self.system.tick()
+        self.modes.append(self.mode())
+        return out
+
+    async def run(self, ticks, disconnect_at=(), silence_at=()):
+        last = None
+        for i in range(ticks):
+            last = await self.tick(feed=i not in silence_at,
+                                   disconnect=i in disconnect_at)
+        return last
+
+    async def settle(self, ticks=4):
+        """Fault-free cool-down: parity asserted about RECOVERY, not an
+        in-flight fault."""
+        self.chaos.schedule.rates = {}
+        last = None
+        for _ in range(ticks):
+            last = await self.tick(feed=True)
+        return last
+
+    def assert_ring_parity(self):
+        """Zero duplicate / out-of-sequence candle rows, every gap
+        backfilled: the engine's window mirrors the venue's own REST
+        answer bit-for-bit on every warm lane."""
+        eng = self.system.monitor._engine
+        assert eng is not None, "the fused engine never ran"
+        limit = self.system.monitor.kline_limit
+        for sym in self.symbols:
+            for iv in self.system.monitor.intervals:
+                oracle = self.ex.get_klines(sym, iv, limit)
+                if len(oracle) < limit:
+                    continue                   # lane legitimately warming
+                s, f = eng.sym_index[sym], eng.iv_index[iv]
+                want = np.asarray([r[1:6] for r in oracle], np.float32)
+                np.testing.assert_array_equal(eng._win[s, f], want,
+                                              err_msg=f"{sym} {iv}")
+                ts = eng._ts[s, f]
+                assert (np.diff(ts) > 0).all(), f"{sym} {iv} out of order"
+                assert len(set(ts.tolist())) == len(ts), f"{sym} {iv} dup"
+
+
+STREAM_CHAOS_RATES = {"fs_dup": 0.06, "fs_ooo": 0.06, "fs_malformed": 0.04,
+                      "fs_stale": 0.03, "fs_burst": 0.01,
+                      "fs_disconnect": 0.01, "fs_silence": 0.01}
+
+
+def test_stream_chaos_soak_smoke(tmp_path):
+    """Tier-1 acceptance soak: ≥3 forced disconnects + a silence window +
+    duplicate/out-of-order/malformed/stale injection over ~90 ticks ends
+    healthy, with poll-path ring parity, every gap backfilled, and the
+    degrade-to-poll → hand-back transition observed via stream_mode."""
+    rig = StreamSoakRig(tmp_path, ["BTCUSDC", "ETHUSDC"],
+                        rates=STREAM_CHAOS_RATES, seed=5)
+
+    async def go():
+        await rig.run(90, disconnect_at={20, 45, 70},
+                      silence_at={30, 31})      # > stale_after_s budget
+        return await rig.settle()
+
+    final = asyncio.run(go())
+
+    # the feed actually suffered: every fault family observed
+    st, sup = rig.sup.stream, rig.sup
+    assert sup.disconnects >= 3 and sup.reconnects >= 3
+    assert st.dup_frames > 0 and st.ooo_frames > 0
+    assert st.malformed_frames > 0
+    assert st.gaps > 0 and st.backfills > 0     # every gap REST-repaired
+
+    # degrade-to-poll → hand-back observed via the gauge trajectory
+    assert 0.0 in rig.modes and 1.0 in rig.modes
+    assert rig.modes[-1] == 1.0                 # handed back, streaming
+
+    # zero REST klines while streaming steady-state: the settle ticks
+    # (healthy stream, no faults) performed no transport polls
+    calls_before = rig.counting.kline_calls
+    asyncio.run(rig.settle(ticks=3))
+    assert rig.counting.kline_calls == calls_before
+
+    # ring parity: no duplicate/out-of-sequence rows, gaps all healed
+    rig.assert_ring_parity()
+
+    # the system ends healthy
+    assert "skipped" not in final
+    assert not any(b.quarantined for b in rig.system.stage_breakers.values())
+    for stage in ("monitor", "analyzer", "executor", "stream"):
+        assert rig.clock.t - rig.system.heartbeats.beats[stage] <= 60.0
+
+
+@pytest.mark.slow
+def test_stream_chaos_soak_full(tmp_path):
+    """The full soak: 2 symbols × 400 ticks of frame chaos, 4 forced
+    disconnects, two silence windows, plus a hard PROCESS kill mid-run —
+    restart recovers the journal, re-attaches a fresh stream (empty books
+    → REST backfill seeds → streaming resumes) and still ends in parity."""
+    rig = StreamSoakRig(tmp_path, ["BTCUSDC", "ETHUSDC"],
+                        rates=STREAM_CHAOS_RATES | {"fs_disconnect": 0.02},
+                        seed=9, n=3600, advance=2400)
+
+    async def go():
+        await rig.run(200, disconnect_at={40, 90}, silence_at={60, 61})
+        # hard kill: journal tail lost, process state abandoned
+        rig.system.journal.simulate_crash()
+        (rig.clock, rig.system, rig.sup, _, rig.counting) = \
+            _streamed_system(rig.tmp_path, rig.symbols, n=3600, limit=128,
+                             advance=0)
+        # the restarted process rides the SAME venue
+        rig.counting.inner = rig.ex
+        await rig.system.recover()
+        await rig.run(200, disconnect_at={40, 90}, silence_at={120})
+        return await rig.settle(6)
+
+    final = asyncio.run(go())
+    assert rig.sup.reconnects >= 2
+    assert 0.0 in rig.modes and rig.modes[-1] == 1.0
+    rig.assert_ring_parity()
+    assert "skipped" not in final
+    assert not any(b.quarantined for b in rig.system.stage_breakers.values())
+
+
+class TestStreamAlertCoherence:
+    """The new stream alerts exist in BOTH rule engines (in-process +
+    PromQL) and the PromQL side only references emitted series — the PR 1
+    coherence suite's guarantee, extended to the feed lifecycle."""
+
+    def test_in_process_degrade_rule_fires_and_resolves(self):
+        from ai_crypto_trader_tpu.utils.alerts import AlertManager
+
+        mgr = AlertManager(now_fn=lambda: 1000.0)
+        fired = mgr.evaluate({"stream_degraded": True})
+        assert any(a["name"] == "StreamDegradedToPoll" for a in fired)
+        mgr.evaluate({"stream_degraded": False})
+        assert "StreamDegradedToPoll" not in mgr.active
+        # absent state (no stream attached) never fires
+        mgr2 = AlertManager(now_fn=lambda: 1000.0)
+        assert not any(a["name"] == "StreamDegradedToPoll"
+                       for a in mgr2.evaluate({}))
+
+    def test_promql_twins_exist(self):
+        import yaml
+
+        rules = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/alert_rules.yml")))
+        names = {r.get("alert") for g in rules["groups"] for r in g["rules"]}
+        assert {"StreamDisconnected", "StreamFlapping",
+                "StreamDegradedToPoll", "StreamSilent",
+                "StreamFrameQueueDropping"} <= names
+
+    def test_supervisor_edge_alerts_reach_the_bus(self):
+        clock, sys_, sup, ex, counting = _streamed_system()
+        q = sys_.bus.subscribe("alerts")
+
+        async def go():
+            sup.offer("[]")
+            sup.connection_lost("test edge")
+            ex.advance(steps=1)
+            clock.t += 60.0
+            await sys_.tick()
+
+        asyncio.run(go())
+        names = []
+        while not q.empty():
+            names.append(q.get_nowait()["data"]["name"])
+        assert "StreamDisconnected" in names
