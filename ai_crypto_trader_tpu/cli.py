@@ -434,6 +434,28 @@ def cmd_profile(args):
         system.shutdown()
 
 
+def cmd_load(args):
+    """Load & capacity harness (testing/loadgen.py): drive N synthetic
+    tenant decision lanes over an S-symbol universe through the real
+    stream → fused tick engine → analyzer/executor path and print the
+    measured tick-latency/saturation report.  `--ramp` runs the
+    closed-loop controller instead: tenants step up a doubling schedule
+    until the p99 tick latency breaches `--slo-ms`, and the report names
+    the max sustainable tenants×symbols point plus the stage the
+    saturation gauges attribute the breach to."""
+    from ai_crypto_trader_tpu.testing.loadgen import (
+        LoadConfig, ramp, run_load)
+
+    cfg = LoadConfig(tenants=args.tenants, symbols=args.symbols,
+                     ticks=args.ticks, window=args.window,
+                     slo_p99_ms=args.slo_ms, seed=args.seed)
+    if args.ramp:
+        out = ramp(cfg)
+    else:
+        out = run_load(cfg)
+    print(json.dumps(out, indent=2, default=str))
+
+
 def cmd_scan(args):
     """Market-wide pair discovery + ranking (CryptoScanner.scan_market,
     `binance_ml_strategy.py:293-468`). Paper mode synthesizes a universe of
@@ -599,6 +621,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--out", default=None,
                     help="artifact directory (default profiles/xplane_<ts>)")
     sp.set_defaults(fn=cmd_profile)
+    sp = sub.add_parser("load", help="tenants×symbols load harness "
+                                     "(saturation report; --ramp finds "
+                                     "the max sustainable point)")
+    sp.add_argument("--tenants", type=int, default=4,
+                    help="tenant decision lanes (the ramp's cap)")
+    sp.add_argument("--symbols", type=int, default=4,
+                    help="synthetic symbol universe size")
+    sp.add_argument("--ticks", type=int, default=12,
+                    help="measured ticks per load point")
+    sp.add_argument("--window", type=int, default=64,
+                    help="candle window (engine/monitor kline_limit)")
+    sp.add_argument("--slo-ms", type=float, default=250.0,
+                    help="p99 tick-latency SLO the ramp holds")
+    sp.add_argument("--ramp", action="store_true",
+                    help="closed-loop ramp: step tenants until the p99 "
+                         "SLO breaches; report max sustainable point + "
+                         "the telemetry-named saturated stage")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.set_defaults(fn=cmd_load)
     sp = sub.add_parser("scan", help="discover + rank tradable pairs")
     sp.add_argument("--pairs", type=int, default=64,
                     help="synthetic universe size (paper mode)")
@@ -619,7 +660,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _JAX_COMMANDS = {"backtest", "train", "evolve", "mc", "trade", "dashboard",
-                 "scan", "profile"}
+                 "scan", "profile", "load"}
 
 
 def main(argv=None):

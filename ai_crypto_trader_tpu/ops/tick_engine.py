@@ -339,6 +339,7 @@ class TickEngine:
         """ONE fused dispatch over every (symbol, frame) lane + ONE host
         readback.  Returns the numpy output pytree ([S, F] per feature);
         per-step transfer/dispatch accounting lands in ``last_stats``."""
+        t_step0 = time.perf_counter()
         S, F, T = self._ring_np.shape[:3]
         W = S * F * self.max_new               # scatter capacity
         if len(self._pending) > W:             # paranoia: spilled capacity
@@ -383,7 +384,9 @@ class TickEngine:
         self.dispatch_count += 1
         self._need_seed = False
         self.last_valid = valid
+        t_hr = time.perf_counter()
         host = host_read(out)
+        host_read_s = time.perf_counter() - t_hr
         # drift outputs ride the same readback; pop them into last_drift so
         # the published feature payload (and the fused↔per-symbol parity
         # contract) is unchanged.  PSI is only meaningful where a reference
@@ -405,5 +408,9 @@ class TickEngine:
             "dispatches": 1, "upload_rows": int(n_writes),
             "upload_bytes": int(upload_bytes), "full_seed": bool(seeded),
             "lanes": int(S * F), "valid_lanes": int(valid.sum()),
+            # saturation telemetry (utils/saturation.py): scatter-list
+            # occupancy headroom and the host-readback share of tick time
+            "scatter_capacity": int(W), "host_read_s": host_read_s,
+            "step_s": time.perf_counter() - t_step0,
         }
         return host

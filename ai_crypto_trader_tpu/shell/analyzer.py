@@ -48,6 +48,13 @@ class SignalAnalyzer:
     # launcher (default-on there).  None = disabled: every call site below
     # is a single attribute check, the tracing/devprof discipline.
     flightrec: any = None
+    # Tenant-lane tag (ROADMAP item 4 / testing/loadgen.py): when set,
+    # signals carry `lane` AND publish on the per-lane channel
+    # `trading_signals.<lane>`, so each tenant's executor subscribes to
+    # exactly its own lane — N tenants cost O(N) bus fanout, not N² with
+    # consumer-side filtering.  None (the default, the one-tenant
+    # launcher) keeps the shared `trading_signals` channel untagged.
+    lane: str | None = None
     _last_analysis: dict = field(default_factory=dict)
 
     def _decision_features(self, update: dict) -> dict:
@@ -131,6 +138,10 @@ class SignalAnalyzer:
             "top_family": update.get("top_family"),
             "structure_version": update.get("structure_version"),
         }
+        channel = "trading_signals"
+        if self.lane is not None:
+            signal["lane"] = self.lane
+            channel = f"trading_signals.{self.lane}"
         if rec_id is not None:
             signal["decision_id"] = rec_id
         outcome_veto = None
@@ -150,7 +161,7 @@ class SignalAnalyzer:
                 # until after set_verdict below so the durable copy carries
                 # the verdict + explanation, not just the gate
                 outcome_veto = f"p={outcome['success_probability']:.2f}"
-        await self.bus.publish("trading_signals", signal)
+        await self.bus.publish(channel, signal)
         self.bus.set(f"latest_signal_{symbol}", signal)
         # structured explanation per signal (AIExplainabilityService consumes
         # trading_signals, `services/ai_explainability_service.py:138-354`;

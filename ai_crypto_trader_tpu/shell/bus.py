@@ -38,8 +38,11 @@ from ai_crypto_trader_tpu.utils import tracing
 #: silently skips a trade.  Default policy "grow": their queues are
 #: unbounded (backlog is surfaced as a warning past the soft limit instead
 #: of discarded).  The other policy is "alert_on_drop": bounded, but every
-#: overflow publishes a MessageLoss alert naming the channel.
-CRITICAL_CHANNELS = {"alerts": "grow", "trading_signals": "grow"}
+#: overflow publishes a MessageLoss alert naming the channel.  The
+#: trading-signals entry is a PATTERN: per-tenant decision lanes publish
+#: on `trading_signals.<lane>` (shell/analyzer.py `lane`), and a lane's
+#: signals are exactly as loss-critical as the shared channel's.
+CRITICAL_CHANNELS = {"alerts": "grow", "trading_signals*": "grow"}
 
 
 class EventBus:
@@ -50,7 +53,8 @@ class EventBus:
     the ``overflow`` ctor arg): "grow" or "alert_on_drop"."""
 
     def __init__(self, max_queue: int = 1024, now_fn=time.time,
-                 metrics=None, log=None, overflow: dict | None = None):
+                 metrics=None, log=None, overflow: dict | None = None,
+                 warn_interval_s: float = 30.0):
         self._subs: dict[str, list[asyncio.Queue]] = defaultdict(list)
         self._kv: dict[str, Any] = {}
         self._max_queue = max_queue
@@ -60,7 +64,27 @@ class EventBus:
         self.overflow = {**CRITICAL_CHANNELS, **(overflow or {})}
         self.published_counts: dict[str, int] = defaultdict(int)
         self.dropped_counts: dict[str, int] = defaultdict(int)
-        self._grow_warned: dict[str, int] = {}
+        # Log rate limiting (edge-trigger + periodic summary): a channel
+        # saturated at thousands of publishes/second must not turn the
+        # structured log into its own denial of service.  The FIRST drop
+        # of an episode logs immediately; further drops within
+        # `warn_interval_s` are counted and folded into the next summary
+        # line (`suppressed_warnings`).  The drop COUNTERS (and metrics)
+        # stay exact — only the log lines are limited.  Wall clock on
+        # purpose: `now_fn` may be a frozen/virtual test clock, which
+        # would either suppress forever or spam per publish.
+        self.warn_interval_s = warn_interval_s
+        self._drop_warn: dict[str, tuple[float, int]] = {}
+        self._grow_warn: dict[str, tuple[float, int]] = {}
+        # per-channel max observed fanout queue depth (the saturation
+        # monitor's bus_queue_high_watermark input)
+        self.depth_watermarks: dict[str, int] = defaultdict(int)
+
+    @property
+    def max_queue(self) -> int:
+        """Bounded-channel queue capacity (the soft limit for "grow"
+        channels) — the denominator of bus_queue_utilization."""
+        return self._max_queue
 
     def _policy(self, channel: str) -> str:
         pol = self.overflow.get(channel)
@@ -114,18 +138,30 @@ class EventBus:
         # metric exists to diagnose
         fanout_s = (time.perf_counter() - fanout_t0
                     if self.metrics is not None else 0.0)
+        if depth > self.depth_watermarks[channel]:
+            self.depth_watermarks[channel] = depth
         if dropped:
             self.dropped_counts[channel] += dropped
             if self.log is not None:
                 # slow-subscriber detection: a full queue means a consumer
                 # is not keeping up with the publish rate; the trace_id ties
-                # this line to the span and metric views of the same moment
-                self.log.warning(
-                    "slow subscriber: dropped oldest message(s)",
-                    channel=channel, dropped=dropped,
-                    total_dropped=self.dropped_counts[channel],
-                    queue_depth=depth,
-                    trace_id=ctx.get("trace_id") if ctx else None)
+                # this line to the span and metric views of the same moment.
+                # Edge-trigger + periodic summary: the first drop of an
+                # episode warns immediately, then at most one summary line
+                # per warn_interval_s carrying the suppressed count.
+                mono = time.monotonic()
+                last, suppressed = self._drop_warn.get(channel, (None, 0))
+                if last is None or mono - last >= self.warn_interval_s:
+                    self.log.warning(
+                        "slow subscriber: dropped oldest message(s)",
+                        channel=channel, dropped=dropped,
+                        suppressed_warnings=suppressed,
+                        total_dropped=self.dropped_counts[channel],
+                        queue_depth=depth,
+                        trace_id=ctx.get("trace_id") if ctx else None)
+                    self._drop_warn[channel] = (mono, 0)
+                else:
+                    self._drop_warn[channel] = (last, suppressed + 1)
             if (self._policy(channel) == "alert_on_drop"
                     and channel != "alerts"):
                 # loss on a critical bounded channel is an INCIDENT, not
@@ -135,15 +171,34 @@ class EventBus:
                     "name": "MessageLoss", "severity": "warning",
                     "channel": channel, "dropped": dropped,
                     "at": self._now()})
-        elif (self._policy(channel) == "grow" and depth > self._max_queue
-              and self.log is not None
-              and depth >= 2 * self._grow_warned.get(channel, 0)):
-            # unbounded critical channel growing past the soft limit:
-            # warn at doubling thresholds, not every publish
-            self._grow_warned[channel] = depth
-            self.log.warning("critical channel backlog growing",
-                             channel=channel, queue_depth=depth,
-                             soft_limit=self._max_queue)
+        else:
+            pending = self._drop_warn.get(channel)
+            if (pending is not None and pending[1]
+                    and self.log is not None
+                    and time.monotonic() - pending[0]
+                    >= self.warn_interval_s):
+                # a drop episode ENDED without its summary landing (the
+                # interval never elapsed while drops kept coming): flush
+                # the suppressed count on the next healthy publish, so
+                # the log — not just the counters — records the loss
+                self.log.warning(
+                    "slow subscriber: drop episode ended",
+                    channel=channel, suppressed_warnings=pending[1],
+                    total_dropped=self.dropped_counts[channel])
+                self._drop_warn[channel] = (time.monotonic(), 0)
+            if (self._policy(channel) == "grow"
+                    and depth > self._max_queue and self.log is not None):
+                # unbounded critical channel growing past the soft limit:
+                # warn on the episode edge and on doublings, then at most
+                # one periodic summary per warn_interval_s while it lasts
+                mono = time.monotonic()
+                last, warned_depth = self._grow_warn.get(channel, (None, 0))
+                if (last is None or depth >= 2 * warned_depth
+                        or mono - last >= self.warn_interval_s):
+                    self._grow_warn[channel] = (mono, depth)
+                    self.log.warning("critical channel backlog growing",
+                                     channel=channel, queue_depth=depth,
+                                     soft_limit=self._max_queue)
         if self.metrics is not None:
             self.metrics.observe("bus_fanout_latency_seconds", fanout_s,
                                  channel=channel)

@@ -260,6 +260,12 @@ class DashboardServer:
         flightrec = getattr(system, "flightrec", None)
         if flightrec is not None:
             out["flightrec"] = flightrec.status()
+        saturation = getattr(system, "saturation", None)
+        if saturation is not None:
+            # load & capacity observatory (utils/saturation.py): stage
+            # duty cycles, bus utilization/watermarks, scatter occupancy,
+            # host-readback share, event-loop lag
+            out["capacity"] = saturation.status()
         scorecard = getattr(system, "scorecard", None)
         if scorecard is not None:
             sc = scorecard.status()

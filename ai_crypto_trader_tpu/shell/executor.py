@@ -72,6 +72,14 @@ class TradeExecutor:
     # this into books and reconciles them against venue ground truth.
     journal: object = None
     coid_prefix: str = "wj"
+    # Tenant-lane tag (ROADMAP item 4 / testing/loadgen.py): a lane-scoped
+    # executor subscribes to its own `trading_signals.<lane>` channel (the
+    # analyzer publishes there — O(N) fanout for N tenants) and drains
+    # only signals tagged with ITS lane (belt-and-braces against a
+    # pattern-subscribed producer).  None = the one-tenant launcher: the
+    # shared `trading_signals` channel, every signal processed, exactly
+    # as before.
+    lane: str | None = None
     # Decision-provenance flight recorder (obs/flightrec.py), wired by the
     # launcher; None = disabled (one attribute check per call site).
     flightrec: object = None
@@ -899,7 +907,9 @@ class TradeExecutor:
     def _queue(self):
         # Persistent subscription (see analyzer._queue).
         if not hasattr(self, "_q"):
-            self._q = self.bus.subscribe("trading_signals")
+            channel = ("trading_signals" if self.lane is None
+                       else f"trading_signals.{self.lane}")
+            self._q = self.bus.subscribe(channel)
         return self._q
 
     async def run_once(self) -> int:
@@ -918,6 +928,10 @@ class TradeExecutor:
         q = self._queue()
         while not q.empty():
             env = q.get_nowait()
+            if (self.lane is not None
+                    and env["data"].get("lane") is not None
+                    and env["data"]["lane"] != self.lane):
+                continue                   # another tenant's decision lane
             try:
                 with tracing.consumer_span(
                         env, "executor.handle_signal", service="executor",

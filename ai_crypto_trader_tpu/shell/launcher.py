@@ -30,8 +30,9 @@ from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
 from ai_crypto_trader_tpu.utils import devprof as devprof_mod
 from ai_crypto_trader_tpu.utils import tracing
 from ai_crypto_trader_tpu.utils.alerts import AlertManager
-from ai_crypto_trader_tpu.utils.health import HeartbeatRegistry
+from ai_crypto_trader_tpu.utils.health import EventLoopLagProbe, HeartbeatRegistry
 from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+from ai_crypto_trader_tpu.utils.saturation import SaturationMonitor
 from ai_crypto_trader_tpu.utils.symbols import QUOTE_ASSETS, base_asset
 
 
@@ -82,6 +83,14 @@ class TradingSystem:
     stage_max_failures: int = 3
     stage_backoff_s: float = 2.0
     stage_quarantine_s: float = 300.0
+    # Saturation telemetry (utils/saturation.py): USE-style per-stage duty
+    # cycles against the tick latency budget, bus queue utilization +
+    # high-watermarks, scatter-list occupancy, host-readback share and
+    # asyncio event-loop lag — the capacity axis ROADMAP item 4 measures.
+    # Default ON: the cost is a handful of perf_counter reads per tick.
+    enable_saturation: bool = True
+    tick_budget_s: float = 1.0        # the tick latency SLO target the
+    #                                   duty cycles are normalized against
     # Streaming ingest (shell/stream.py, wired via attach_stream): while a
     # stream is attached AND healthy, the websocket feed carries market
     # data (zero REST kline calls) and the polling monitor stands down;
@@ -135,6 +144,15 @@ class TradingSystem:
         self.alerts = AlertManager(now_fn=self.now_fn)
         self.heartbeats = HeartbeatRegistry(now_fn=self.now_fn,
                                             log=self.log.child("health"))
+        # load & capacity observatory (utils/saturation.py): per-stage duty
+        # vs the tick budget, bus/scatter/host-readback utilization and the
+        # event-loop lag probe — exported every tick, feeds the
+        # StageSaturated/BusBackpressure/EventLoopLagHigh rules and the
+        # /state.json `capacity` block
+        self.saturation = (SaturationMonitor(metrics=self.metrics,
+                                             tick_budget_s=self.tick_budget_s)
+                           if self.enable_saturation else None)
+        self.loop_lag = EventLoopLagProbe()
         # decision provenance & model quality (obs/): flight recorder +
         # prediction scorecard + PnL attribution, default-on (the trading
         # twin of the device observatory; disabled path = one None check)
@@ -252,6 +270,13 @@ class TradingSystem:
             sp.set_attribute("published", out.get("published", 0))
             sp.set_attribute("analyzed", out.get("analyzed", 0))
             sp.set_attribute("executed", out.get("executed", 0))
+        if self.saturation is not None:
+            # one true loop yield per tick: completes the event-loop-lag
+            # probe's callback (sampled at the top of the tick — a stage
+            # that blocked the loop shows up as the measured delay) and
+            # lets call_soon work queued by stages actually run in
+            # tick-driven harnesses that never otherwise suspend
+            await asyncio.sleep(0)
         return out
 
     async def _run_stage(self, name: str, fn):
@@ -271,6 +296,7 @@ class TradingSystem:
                 # drain record their gate instead of dangling "open"
                 self.flightrec.mark_open("quarantine")
             return None                    # backoff/quarantine window
+        t0 = time.perf_counter()
         try:
             out = await fn()
         except ExchangeUnavailable:
@@ -300,6 +326,13 @@ class TradingSystem:
                                f"{br.failures} consecutive failures",
                     "at": self.now_fn()})
             return None
+        finally:
+            # busy-time accounting on EVERY exit path (success, isolated
+            # failure, outage) — the duty-cycle gauge must charge a stage
+            # for the time it burned even when the tick skips
+            if self.saturation is not None:
+                self.saturation.observe_stage(name,
+                                              time.perf_counter() - t0)
         if br.record_success(self.now_fn()):
             self.log.info("stage recovered from crash loop", stage=name)
             await self.bus.publish("alerts", {
@@ -385,6 +418,11 @@ class TradingSystem:
         from ai_crypto_trader_tpu.shell.exchange import ExchangeUnavailable
 
         published = analyzed = executed = 0
+        if self.saturation is not None:
+            # lag measurement armed BEFORE the stages: a blocking host
+            # call anywhere below delays the callback's completion, and
+            # the next tick's close-out reads the measured delay
+            self.loop_lag.sample()
         t0 = time.perf_counter()      # wall time: now_fn may be a virtual
         #                               clock in paper mode, and the latency
         #                               panel must show real compute time
@@ -410,6 +448,7 @@ class TradingSystem:
                 self.devprof.observe_latency("tick",
                                              time.perf_counter() - t0)
             self._emit_health_gauges()
+            self._observe_saturation(time.perf_counter() - t0)
             self.log.warning("exchange unavailable; tick skipped",
                              error=str(exc))
             await self.bus.publish("alerts", {
@@ -453,6 +492,7 @@ class TradingSystem:
         if self.devprof is not None:
             self.devprof.observe_latency("tick", time.perf_counter() - t0)
         self._emit_health_gauges()
+        self._observe_saturation(time.perf_counter() - t0)
         self._peak_value = max(getattr(self, "_peak_value", total), total)
         self.metrics.set_gauge("drawdown_usd", self._peak_value - total)
         for symbol in self.symbols:
@@ -517,6 +557,22 @@ class TradingSystem:
             self.bus.set("pnl_attribution", self.attribution.summary())
         if self.flightrec is not None:
             self.flightrec.export()
+
+    def _observe_saturation(self, wall_s: float):
+        """Close one tick's saturation sample (both tick paths, like the
+        health gauges): shared-resource snapshots → duty-cycle fold →
+        gauge export.  The loop-lag reading is the probe measurement
+        armed at the top of the tick (one per tick, completed at the
+        tick-end loop yield — any blocking host call in between lands
+        in it)."""
+        sat = self.saturation
+        if sat is None:
+            return
+        eng = getattr(self.monitor, "_engine", None)
+        sat.close_tick(wall_s, bus=self.bus,
+                       engine_stats=eng.last_stats if eng is not None
+                       else None,
+                       lag_s=self.loop_lag.last_lag_s)
 
     def _emit_health_gauges(self):
         """Health/alert-rule gauges (monitoring/alert_rules.yml). Emitted on
@@ -617,6 +673,10 @@ class TradingSystem:
             # StreamDegradedToPoll input (PromQL twin: stream_mode == 0)
             state["stream_degraded"] = self._stream_degraded
             state["stream_staleness_s"] = self.stream.staleness(self.now_fn())
+        if self.saturation is not None:
+            # capacity observatory inputs: saturating stages (windowed,
+            # min-sample gated), backpressured bus channels, loop lag
+            state.update(self.saturation.alert_state())
         # trading-quality observatory inputs (obs/): worst live model
         # calibration/accuracy and the max on-device feature PSI
         if self.scorecard is not None:
@@ -650,6 +710,7 @@ class TradingSystem:
     async def _run_extra_services(self):
         for svc in self.extra_services:
             name = getattr(svc, "name", type(svc).__name__)
+            t0 = time.perf_counter()
             try:
                 await svc.run_once()
             except Exception as exc:       # noqa: BLE001 — service isolation:
@@ -661,6 +722,10 @@ class TradingSystem:
                     "service": name, "message": str(exc),
                     "at": self.now_fn()})
                 continue
+            finally:
+                if self.saturation is not None:
+                    self.saturation.observe_stage(
+                        name, time.perf_counter() - t0)
             self.heartbeats.beat(name)
 
     def _render_dashboard(self):

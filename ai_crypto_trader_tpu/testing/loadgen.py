@@ -1,0 +1,329 @@
+"""Load & capacity observatory: synthetic tenant traffic + the closed-loop
+ramp that finds a host's max sustainable tenants×symbols at a fixed tick
+latency SLO.
+
+ROADMAP item 4's "millions of users" axis gets its first *measured* number
+here: N independent tenant decision lanes driven through the REAL serving
+path — recorded kline frames offered to a `StreamSupervisor`, drained
+through `MarketMonitor.poll` into ONE fused `TickEngine` dispatch, then
+every tenant's `SignalAnalyzer` → `TradeExecutor` lane (each with its own
+FakeExchange venue) on the shared bus.  Nothing is mocked below the frame
+transport: the harness exercises the same parse/continuity/scatter-
+list/dispatch/fan-out machinery production runs, so the latency it
+measures is the latency a host would serve (Podracer, arXiv:2104.06272:
+throughput claims only mean something as a closed loop against a
+latency/utilization budget).
+
+Two layers:
+
+  * **`SyntheticTenantTraffic`** — one deterministic, seeded load point
+    (`tenants × symbols` at full tick rate).  Each tick: advance the
+    venue clock, build the tick's kline frames (`testing/chaos.py
+    kline_frames_for` — the recorded-feed builders), offer them to the
+    supervisor, drain, run every tenant lane, and record the wall-clock
+    event→decision latency.  A `SaturationMonitor` (utils/saturation.py)
+    times every stage against the SLO budget, so a breach is *attributed*
+    by telemetry, never inferred.  `analyzer_lag_s` / `executor_lag_s`
+    inject a per-lane blocking delay (tests force a KNOWN stage to
+    saturate; the event-loop-lag probe sees the block too).
+  * **`ramp()`** — the closed-loop controller: step the tenant count up a
+    schedule, measure each point, stop at the first p99 SLO breach, and
+    report the max sustainable point plus the saturated stage(s) the
+    gauges name at the breach.  `bench.py`'s `capacity` row and
+    `cli load --ramp` both drive this.
+
+Deterministic and wall-clock-honest: market data rides a virtual clock
+(seeded synthetic series), but latencies are `perf_counter` wall time —
+the thing the SLO is written against.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from ai_crypto_trader_tpu.config import TradingParams
+from ai_crypto_trader_tpu.data.ingest import OHLCV
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.shell.analyzer import SignalAnalyzer
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+from ai_crypto_trader_tpu.shell.executor import TradeExecutor
+from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
+from ai_crypto_trader_tpu.shell.stream import (
+    MarketStream,
+    StreamSupervisor,
+    interval_ms,
+)
+from ai_crypto_trader_tpu.testing.chaos import CountingKlines, kline_frames_for
+from ai_crypto_trader_tpu.utils.health import EventLoopLagProbe
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+from ai_crypto_trader_tpu.utils.saturation import SaturationMonitor
+
+
+@dataclass
+class LoadConfig:
+    """One load point: N tenant lanes over an S-symbol universe."""
+
+    tenants: int = 2
+    symbols: int = 4
+    ticks: int = 12                   # measured ticks (after warmup)
+    warmup_ticks: int = 2             # untimed: compile + REST book seeds
+    window: int = 64                  # candle window (engine + monitor)
+    intervals: tuple = ("1m",)
+    seed: int = 0
+    slo_p99_ms: float = 250.0         # the fixed tick-latency SLO the ramp
+    #                                   holds; also the duty-cycle budget
+    min_samples: int = 4              # saturation window gate (short steps)
+    duty_threshold: float = 0.75
+    tick_step_s: float = 60.0         # virtual-clock advance per tick
+    # Per-lane injected BLOCKING delay per tick (seconds) — deterministic
+    # saturation for tests/drills: total stage busy grows linearly with
+    # tenants, so the ramp breaches at a known point and the named stage
+    # is the one that was actually loaded.
+    analyzer_lag_s: float = 0.0
+    executor_lag_s: float = 0.0
+    # Per-tenant execution gates: default params veto most signals (the
+    # decision fan-out IS the load); permissive params open real positions
+    # so the venue/SL-TP path is loaded too.
+    trading: TradingParams | None = None
+
+
+@dataclass
+class _TenantLane:
+    name: str
+    venue: FakeExchange
+    analyzer: SignalAnalyzer
+    executor: TradeExecutor
+
+
+def _synthetic_series(cfg: LoadConfig, n_hist: int) -> dict:
+    d = generate_ohlcv(n=n_hist, seed=cfg.seed + 11)
+    series = {}
+    for i in range(cfg.symbols):
+        sym = f"L{i:03d}USDC"
+        scale = np.float64(1.0 + 0.03 * i)
+        series[sym] = OHLCV(
+            timestamp=np.arange(n_hist, dtype=np.int64) * 60_000,
+            open=d["open"] * scale, high=d["high"] * scale,
+            low=d["low"] * scale, close=d["close"] * scale,
+            volume=d["volume"] * (1.0 + 0.01 * i), symbol=sym)
+    return series
+
+
+class SyntheticTenantTraffic:
+    """One load point, fully assembled: venue → frames → supervisor →
+    fused monitor → N tenant (analyzer, executor) lanes on one bus."""
+
+    def __init__(self, cfg: LoadConfig, metrics: MetricsRegistry | None = None):
+        self.cfg = cfg
+        self.clock = {"t": 0.0}
+        self.metrics = metrics if metrics is not None else MetricsRegistry(
+            now_fn=self._now)
+        mult = max(int(np.ceil(interval_ms(iv) / 60_000))
+                   for iv in cfg.intervals)
+        n_hist = cfg.window * mult + cfg.ticks + cfg.warmup_ticks + 64
+        series = _synthetic_series(cfg, n_hist)
+        self.market = FakeExchange(series)
+        self.market.advance(steps=n_hist - cfg.ticks - cfg.warmup_ticks - 8)
+        self.symbols = sorted(series)
+        # transport-call counter: the steady state must serve from the
+        # stream's candle books, ZERO REST kline calls (the PR 9 contract
+        # — at load, REST fallback would BE the bottleneck)
+        self.counting = CountingKlines(self.market)
+        self.bus = EventBus(now_fn=self._now, metrics=self.metrics)
+        self.monitor = MarketMonitor(self.bus, self.counting,
+                                     symbols=self.symbols,
+                                     intervals=cfg.intervals,
+                                     kline_limit=cfg.window,
+                                     now_fn=self._now)
+        self.stream = MarketStream(self.monitor, now_fn=self._now)
+        self.supervisor = StreamSupervisor(self.stream, bus=self.bus,
+                                           metrics=self.metrics,
+                                           now_fn=self._now)
+        self.saturation = SaturationMonitor(
+            self.metrics, tick_budget_s=cfg.slo_p99_ms / 1e3,
+            min_samples=cfg.min_samples, duty_threshold=cfg.duty_threshold)
+        self.loop_lag = EventLoopLagProbe()
+        self.lanes = [self._lane(i, series) for i in range(cfg.tenants)]
+        self.latencies_ms: list[float] = []
+        self.published = self.analyzed = self.executed = 0
+        self._seed_rest_calls = 0
+
+    def _now(self) -> float:
+        return self.clock["t"]
+
+    def _lane(self, i: int, series: dict) -> _TenantLane:
+        name = f"t{i}"
+        venue = FakeExchange(series, quote_balance=10_000.0)
+        venue.cursor = dict(self.market.cursor)      # lockstep prices
+        analyzer = SignalAnalyzer(self.bus, now_fn=self._now,
+                                  analysis_interval_s=0.0, lane=name)
+        executor = TradeExecutor(self.bus, venue, now_fn=self._now,
+                                 lane=name, coid_prefix=f"ld{i}",
+                                 trading=self.cfg.trading or TradingParams())
+        # subscribe before the first publish (the launcher discipline)
+        analyzer._queue()
+        executor._queue()
+        return _TenantLane(name, venue, analyzer, executor)
+
+    async def tick(self, timed: bool = True) -> float:
+        """One full load tick; returns the wall event→decision latency in
+        ms.  The timed region starts when the tick's frames hit the
+        supervisor (`offer`) and ends when every tenant lane has drained
+        its decisions — frame parse + continuity + scatter-list upload +
+        ONE fused dispatch + ONE host readback + bus fan-out + N×(analyze
+        + execute)."""
+        cfg, sat = self.cfg, self.saturation
+        self.clock["t"] += cfg.tick_step_s
+        self.market.advance(steps=1)
+        for lane in self.lanes:
+            lane.venue.advance(steps=1)
+        frames = kline_frames_for(self.market, self.symbols, cfg.intervals)
+        if timed:
+            # never sampled during warmup: the first dispatch's compile
+            # would stamp a multi-second "lag" into the probe's max
+            self.loop_lag.sample()
+        t0 = time.perf_counter()
+        for f in frames:
+            self.supervisor.offer(f)
+        with sat.stage("stream"):
+            self.published += await self.supervisor.step()
+        with sat.stage("analyzer"):
+            for lane in self.lanes:
+                self.analyzed += await lane.analyzer.run_once()
+                if cfg.analyzer_lag_s:
+                    time.sleep(cfg.analyzer_lag_s)   # BLOCKING on purpose
+        with sat.stage("executor"):
+            for lane in self.lanes:
+                self.executed += await lane.executor.run_once()
+                if cfg.executor_lag_s:
+                    time.sleep(cfg.executor_lag_s)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        # one real loop iteration so the lag probe's callback (and any
+        # call_soon work the stages queued) completes inside this tick
+        await asyncio.sleep(0)
+        if timed:
+            eng = self.monitor._engine
+            sat.close_tick(wall_ms / 1e3, bus=self.bus,
+                           engine_stats=eng.last_stats if eng is not None
+                           else None,
+                           lag_s=self.loop_lag.last_lag_s)
+            self.latencies_ms.append(wall_ms)
+        else:
+            sat.discard_tick()       # warmup busy time must not pollute
+            #                          the duty windows (compile + seeds)
+        return wall_ms
+
+    async def run(self) -> dict:
+        for _ in range(self.cfg.warmup_ticks):
+            await self.tick(timed=False)
+        # measured window starts clean: warmup publishes/analyses (and
+        # the REST seeds) belong to compile/seed, not the load point
+        self._seed_rest_calls = self.counting.kline_calls
+        self.published = self.analyzed = self.executed = 0
+        for _ in range(self.cfg.ticks):
+            await self.tick(timed=True)
+        return self.report()
+
+    def report(self) -> dict:
+        cfg, sat = self.cfg, self.saturation
+        lat = np.asarray(self.latencies_ms or [0.0])
+        return {
+            "tenants": cfg.tenants, "symbols": cfg.symbols,
+            "lanes": cfg.tenants * cfg.symbols,
+            "ticks": len(self.latencies_ms),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "max_ms": round(float(lat.max()), 3),
+            "published": self.published, "analyzed": self.analyzed,
+            "executed": self.executed,
+            "rest_kline_calls_steady":
+                int(self.counting.kline_calls - self._seed_rest_calls),
+            "stage_duty": {k: round(v, 4)
+                           for k, v in sorted(sat.windowed_duty().items())},
+            "saturated_stages": sat.saturated_stages(),
+            "bottleneck_stage": sat.bottleneck_stage(),
+            "event_loop_lag_max_s": round(self.loop_lag.max_lag_s, 6),
+            "capacity": sat.status(),
+        }
+
+
+def run_load(cfg: LoadConfig,
+             metrics: MetricsRegistry | None = None) -> dict:
+    """Measure ONE load point (blocking entry; builds its own loop)."""
+    traffic = SyntheticTenantTraffic(cfg, metrics=metrics)
+    return asyncio.run(traffic.run())
+
+
+def default_tenant_steps(max_tenants: int) -> list[int]:
+    """Doubling ramp schedule: 1, 2, 4, … up to (and including) the cap."""
+    steps, t = [], 1
+    while t < max_tenants:
+        steps.append(t)
+        t *= 2
+    steps.append(max_tenants)
+    return sorted(set(steps))
+
+
+def ramp(base: LoadConfig, tenant_steps: list[int] | None = None,
+         metrics: MetricsRegistry | None = None,
+         refine: bool = True) -> dict:
+    """Closed-loop ramp: step tenants up the schedule until the measured
+    p99 tick latency breaches the SLO; report the max sustainable
+    tenants×symbols point and the saturated stage(s) telemetry NAMES at
+    the breach (the acceptance contract: attribution comes from the
+    duty-cycle gauges, not from guessing).
+
+    ``refine`` (default on) bisects the gap between the last sustainable
+    step and the breaching step down to ±1 tenant.  The doubling
+    schedule alone quantizes the headline to powers of two — a breach
+    one step earlier would read as a 50% capacity drop, which would trip
+    the bench gate's 10% tolerance on ordinary jitter; the refined value
+    moves by at most one tenant's worth instead."""
+    steps = tenant_steps or default_tenant_steps(base.tenants)
+    slo_ms = base.slo_p99_ms
+
+    def measure(tenants: int) -> dict:
+        rep = run_load(replace(base, tenants=tenants), metrics=metrics)
+        rep["slo_p99_ms"] = slo_ms
+        rep["breached"] = rep["p99_ms"] > slo_ms
+        return rep
+
+    reports, max_sustainable, breach = [], None, None
+    for tenants in steps:
+        rep = measure(tenants)
+        reports.append(rep)
+        if rep["breached"]:
+            breach = rep
+            break
+        max_sustainable = rep
+    if breach is not None and refine:
+        lo = max_sustainable["tenants"] if max_sustainable else 0
+        hi = breach["tenants"]
+        while hi - lo > 1:
+            rep = measure((lo + hi) // 2)
+            rep["refined"] = True
+            reports.append(rep)
+            if rep["breached"]:
+                hi, breach = rep["tenants"], rep
+            else:
+                lo, max_sustainable = rep["tenants"], rep
+
+    def point(rep):
+        return {k: rep[k] for k in ("tenants", "symbols", "lanes",
+                                    "p50_ms", "p99_ms")}
+
+    return {
+        "slo_p99_ms": slo_ms,
+        "steps": reports,
+        "max_sustainable": point(max_sustainable) if max_sustainable else None,
+        "breach": point(breach) if breach else None,
+        # the attribution surface: which stage(s) the gauges say saturated
+        # at the breach point (bottleneck = argmax duty, always named)
+        "saturated_stages": (breach or reports[-1])["saturated_stages"],
+        "bottleneck_stage": (breach or reports[-1])["bottleneck_stage"],
+    }

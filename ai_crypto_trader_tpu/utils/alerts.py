@@ -67,6 +67,23 @@ def default_rules() -> list[AlertRule]:
                   lambda s: bool(s.get("stream_degraded")),
                   "websocket feed unhealthy; monitor polling REST until "
                   "it recovers"),
+        # --- load & capacity observatory (utils/saturation.py) ---
+        # saturated_stages is windowed AND min-sample gated at the source
+        # (SaturationMonitor), so one compile-heavy cold tick can never
+        # page; the PromQL twins gate on saturation_samples the same way.
+        AlertRule("StageSaturated", "warning",
+                  lambda s: bool(s.get("saturated_stages")),
+                  "a pipeline stage's duty cycle is consuming most of the "
+                  "tick latency budget"),
+        AlertRule("BusBackpressure", "warning",
+                  lambda s: bool(s.get("bus_backpressure_channels")),
+                  "a bus channel queue is pinned near capacity (slow "
+                  "subscriber backpressure; drop-oldest loss imminent)"),
+        AlertRule("EventLoopLagHigh", "warning",
+                  lambda s: (s.get("event_loop_lag_s", 0.0)
+                             > s.get("event_loop_lag_budget_s", 0.25)),
+                  "asyncio event-loop scheduling lag above budget — a "
+                  "stage is blocking the shared loop"),
         AlertRule("MaxPositionsReached", "info",
                   lambda s: s.get("open_positions", 0) >= s.get("max_positions", 5),
                   "position slots exhausted"),

@@ -19,6 +19,7 @@ The TPU-native equivalents:
 
 from __future__ import annotations
 
+import asyncio
 import time
 from dataclasses import dataclass, field
 from typing import Callable
@@ -98,6 +99,48 @@ class HeartbeatRegistry:
         return {s: max(now - self.beats.get(s, self.expected.get(s, now)),
                        0.0)
                 for s in names}
+
+
+class EventLoopLagProbe:
+    """Asyncio scheduling-delay probe: how long a ready callback waits
+    before the loop runs it.
+
+    Every stage of the tick pipeline shares ONE event loop — a blocking
+    host call anywhere (a synchronous device sync, an un-offloaded model
+    step, a disk fsync on the hot path) delays every other coroutine, and
+    no per-stage timer shows it as anyone else's problem.  `sample()`
+    schedules a zero-delay callback stamped with `perf_counter` and
+    returns the most recently COMPLETED measurement: the callback runs
+    when control next returns to the loop, so the measured delay includes
+    any blocking work between the sample and the next suspension point.
+    Exported as the `event_loop_lag_seconds` gauge (sampled once per
+    launcher tick by the saturation monitor)."""
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self._pending = False
+        self.last_lag_s = 0.0
+        self.max_lag_s = 0.0
+        self.samples = 0
+
+    def _complete(self, t0: float) -> None:
+        self.last_lag_s = max(self._clock() - t0, 0.0)
+        self.max_lag_s = max(self.max_lag_s, self.last_lag_s)
+        self.samples += 1
+        self._pending = False
+
+    def sample(self) -> float:
+        """Schedule one measurement on the running loop (no-op while one
+        is in flight, or with no loop running — e.g. sync tests); returns
+        the latest completed lag in seconds."""
+        if not self._pending:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return self.last_lag_s
+            self._pending = True
+            loop.call_soon(self._complete, self._clock())
+        return self.last_lag_s
 
 
 def device_liveness() -> dict:

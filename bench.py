@@ -14,6 +14,10 @@ The other target rows print one JSON line each ahead of it:
   tick_pipeline           fused tick-engine poll (ONE dispatch + ONE host
                           sync for S=64 symbols × 4 frames, ring-buffer
                           row deltas) vs the per-symbol feature loop
+  capacity                max sustainable tenants×symbols per host at a
+                          fixed p99 tick-latency SLO (testing/loadgen.py
+                          closed-loop ramp; breach attributed to a named
+                          saturated stage by utils/saturation.py gauges)
   flightrec               decision-provenance recorder (obs/flightrec.py):
                           records/s through ring + checksummed JSONL, and
                           % overhead on the fused tick path (recorder on
@@ -148,7 +152,9 @@ def append_history(rows: list, path: str | None = None,
               "BENCH_SIM_SCENARIOS", "BENCH_SIM_STEPS",
               "BENCH_FLIGHTREC_N", "BENCH_FLIGHTREC_SYMBOLS",
               "BENCH_RECOVERY_TRADES", "BENCH_STREAM_SYMBOLS",
-              "BENCH_STREAM_TICKS")
+              "BENCH_STREAM_TICKS", "BENCH_LOAD_TENANTS",
+              "BENCH_LOAD_SYMBOLS", "BENCH_LOAD_TICKS",
+              "BENCH_LOAD_SLO_MS")
              if os.environ.get(k)}
     with open(path, "a", encoding="utf-8") as f:
         for row in rows:
@@ -1031,6 +1037,49 @@ def bench_stream():
          rest_kline_calls_steady=int(rest_calls))
 
 
+def bench_capacity():
+    """capacity row: max sustainable tenants×symbols per host at a fixed
+    p99 tick-latency SLO (testing/loadgen.py closed-loop ramp — ROADMAP
+    item 4's first measured "millions of users" number).
+
+    The ramp doubles tenant decision lanes over an S-symbol universe
+    through the REAL serving path (stream supervisor → fused tick engine
+    → per-tenant analyzer/executor lanes on one bus) until the measured
+    p99 breaches BENCH_LOAD_SLO_MS; the headline value is the last
+    sustainable tenants×symbols product, and the saturation gauges'
+    attribution (which stage ate the budget at the breach) rides the row.
+    BENCH_LOAD_* knobs land in the history scale stamp, so a dev-scale
+    run never gates a full-scale one."""
+    from ai_crypto_trader_tpu.testing.loadgen import LoadConfig, ramp
+
+    tenants = int(os.environ.get("BENCH_LOAD_TENANTS", "8"))
+    symbols = int(os.environ.get("BENCH_LOAD_SYMBOLS", "4"))
+    ticks = int(os.environ.get("BENCH_LOAD_TICKS", "10"))
+    slo_ms = float(os.environ.get("BENCH_LOAD_SLO_MS", "250"))
+    base = LoadConfig(tenants=tenants, symbols=symbols, ticks=ticks,
+                      slo_p99_ms=slo_ms)
+    t0 = time.perf_counter()
+    out = ramp(base)
+    best = out["max_sustainable"]
+    log(f"capacity: ramp over {[s['tenants'] for s in out['steps']]} tenants "
+        f"× {symbols} symbols @ p99 SLO {slo_ms:.0f} ms took "
+        f"{time.perf_counter() - t0:.1f}s")
+    if best is None:
+        log("capacity: SLO breached at the FIRST step — no sustainable "
+            "point at this scale")
+    log(f"capacity: max sustainable "
+        f"{(best or {}).get('lanes', 0)} tenant×symbol lanes "
+        f"(p99 {(best or {}).get('p99_ms')} ms); breach "
+        f"{out['breach']} attributed to {out['saturated_stages'] or None} "
+        f"(bottleneck: {out['bottleneck_stage']})")
+    emit("capacity", float((best or {}).get("lanes", 0)), "tenant_symbols",
+         None, tenants=(best or {}).get("tenants", 0), symbols=symbols,
+         p99_ms=(best or {}).get("p99_ms"), slo_p99_ms=slo_ms,
+         breach=out["breach"],
+         saturated_stages=out["saturated_stages"],
+         bottleneck_stage=out["bottleneck_stage"])
+
+
 def bench_flightrec():
     """flightrec row: decision-provenance recorder cost (obs/flightrec.py).
 
@@ -1277,6 +1326,7 @@ def run_worker():
     secondary = [
         ("tick", bench_tick),
         ("stream", bench_stream),
+        ("capacity", bench_capacity),
         ("flightrec", bench_flightrec),
         ("ga", ga_row),
         ("rl", lambda: bench_rl(ind)),

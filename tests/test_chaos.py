@@ -232,6 +232,17 @@ def check_invariants(rig: SoakRig, final_tick: dict):
     assert executor.pending_intents == {}
     assert rig.restarts >= 2, "the soak must actually kill and restart"
 
+    # -- event-loop lag probe sampled real measurements ---------------------
+    # (utils/health.EventLoopLagProbe via the saturation monitor): a
+    # blocking host call in any stage must become a visible
+    # event_loop_lag_seconds spike, so the probe must actually be running
+    # during the soak — samples taken, gauge exported, values finite
+    assert system.loop_lag.samples > 0, "loop-lag probe never completed"
+    assert np.isfinite(system.loop_lag.max_lag_s)
+    assert system.loop_lag.max_lag_s >= 0.0
+    assert ("crypto_trader_tpu_event_loop_lag_seconds"
+            in system.metrics.exposition())
+
 
 SMOKE_RATES = {"error": 0.04, "latency": 0.02, "stale": 0.02,
                "partial": 0.01, "malformed": 0.01,

@@ -1,0 +1,539 @@
+"""Load & capacity observatory (testing/loadgen.py + utils/saturation.py).
+
+Covers:
+  * SaturationMonitor units — duty-cycle math against the tick budget,
+    window alignment for skipped stages, min-sample gating, bus
+    utilization/watermarks, scatter occupancy, host-readback share;
+  * the EventBus slow-subscriber/backlog warning rate limiting
+    (edge-trigger + periodic summary; counters stay exact);
+  * the asyncio event-loop lag probe (a blocking call becomes a
+    measured lag);
+  * BusBackpressure firing under forced saturation and staying silent at
+    nominal load (the overload alert test), plus StageSaturated /
+    EventLoopLagHigh rule coverage in BOTH engines (in-process +
+    PromQL) and series↔rule coherence for every new capacity series;
+  * the load harness smoke: a real tenants×symbols load point through
+    stream → fused engine → tenant lanes, zero REST steady-state;
+  * the ACCEPTANCE ramp: the closed-loop controller breaches the p99
+    SLO at a forced load point and the breach is attributed to a NAMED
+    saturated stage by the duty gauges — telemetry, not inference;
+  * launcher integration: saturation gauges + /state.json `capacity`
+    block from a ticking TradingSystem;
+  * the slow soak ramp (pytest -m slow).
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.utils.alerts import AlertManager
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+from ai_crypto_trader_tpu.utils.saturation import SaturationMonitor
+from ai_crypto_trader_tpu.utils.structlog import StructuredLogger
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+class TestSaturationMonitor:
+    def test_duty_cycle_is_busy_over_budget(self):
+        sat = SaturationMonitor(tick_budget_s=1.0, min_samples=2)
+        sat.observe_stage("monitor", 0.25)
+        sat.observe_stage("analyzer", 0.75)
+        duty = sat.end_tick(wall_s=1.0)
+        assert duty == {"monitor": 0.25, "analyzer": 0.75}
+
+    def test_skipped_stage_records_zero_so_windows_stay_aligned(self):
+        sat = SaturationMonitor(tick_budget_s=1.0, min_samples=1)
+        sat.observe_stage("monitor", 0.5)
+        sat.end_tick(1.0)
+        sat.end_tick(1.0)                    # monitor skipped this tick
+        assert sat.windowed_duty()["monitor"] == pytest.approx(0.25)
+
+    def test_saturated_stages_min_sample_gated(self):
+        sat = SaturationMonitor(tick_budget_s=1.0, min_samples=3,
+                                duty_threshold=0.75)
+        for _ in range(2):
+            sat.observe_stage("analyzer", 0.9)
+            sat.end_tick(1.0)
+        assert sat.saturated_stages() == {}   # window below min_samples
+        sat.observe_stage("analyzer", 0.9)
+        sat.end_tick(1.0)
+        assert "analyzer" in sat.saturated_stages()
+        assert sat.bottleneck_stage() == "analyzer"
+
+    def test_below_threshold_never_saturated(self):
+        sat = SaturationMonitor(tick_budget_s=1.0, min_samples=1,
+                                duty_threshold=0.75)
+        for _ in range(8):
+            sat.observe_stage("executor", 0.3)
+            sat.end_tick(1.0)
+        assert sat.saturated_stages() == {}
+        assert sat.bottleneck_stage() == "executor"
+
+    def test_bus_utilization_and_watermarks(self):
+        async def scenario():
+            bus = EventBus(max_queue=4)
+            bus.subscribe("ticks")           # never drained
+            for i in range(3):
+                await bus.publish("ticks", i)
+            return bus
+
+        bus = asyncio.run(scenario())
+        sat = SaturationMonitor(backpressure_utilization=0.5)
+        sat.observe_bus(bus)
+        snap = sat.last_bus["ticks"]
+        assert snap["depth"] == 3 and snap["capacity"] == 4
+        assert snap["utilization"] == pytest.approx(0.75)
+        assert snap["high_watermark"] == 3
+        assert sat.backpressured_channels() == ["ticks"]
+
+    def test_engine_occupancy_and_host_read_share(self):
+        sat = SaturationMonitor(tick_budget_s=1.0)
+        sat.observe_engine({"upload_rows": 16, "scatter_capacity": 64,
+                            "host_read_s": 0.05})
+        sat.end_tick(wall_s=0.2)
+        assert sat.scatter_occupancy() == pytest.approx(0.25)
+        assert sat.host_read_share() == pytest.approx(0.25)  # 0.05 / 0.2
+
+    def test_export_publishes_every_capacity_series(self):
+        m = MetricsRegistry()
+        sat = SaturationMonitor(metrics=m, tick_budget_s=1.0, min_samples=1)
+        sat.observe_stage("monitor", 0.4)
+        sat.observe_engine({"upload_rows": 4, "scatter_capacity": 64,
+                            "host_read_s": 0.01})
+        sat.observe_loop_lag(0.002)
+        bus = EventBus()
+        bus.subscribe("alerts")
+        sat.observe_bus(bus)
+        sat.end_tick(0.5)
+        sat.export()
+        text = m.exposition()
+        for series in ('stage_duty_cycle{stage="monitor"}',
+                       'saturation_samples{stage="monitor"}',
+                       'stage_busy_seconds_total{stage="monitor"}',
+                       'bus_queue_utilization{channel="alerts"}',
+                       'bus_queue_high_watermark{channel="alerts"}',
+                       "scatter_list_occupancy", "host_readback_share",
+                       "event_loop_lag_seconds"):
+            assert f"crypto_trader_tpu_{series}" in text, series
+
+    def test_status_is_the_capacity_block(self):
+        sat = SaturationMonitor(tick_budget_s=0.25, min_samples=1)
+        sat.observe_stage("stream", 0.2)
+        sat.end_tick(0.21)
+        status = sat.status()
+        assert status["tick_budget_s"] == 0.25
+        assert status["stage_duty"]["stream"] == pytest.approx(0.8)
+        assert "stream" in status["saturated_stages"]
+        assert status["bottleneck_stage"] == "stream"
+        json.dumps(status)                   # must be JSON-able
+
+
+class TestBusWarnRateLimit:
+    """Satellite: a saturated channel must not turn the structlog stream
+    into its own denial of service — edge-trigger + periodic summary,
+    exact counters."""
+
+    def _flood(self, tmp_path, n=200, warn_interval_s=30.0):
+        async def scenario():
+            log = StructuredLogger("bus", path=str(tmp_path / "bus.jsonl"))
+            bus = EventBus(max_queue=2, log=log,
+                           warn_interval_s=warn_interval_s)
+            bus.subscribe("ticks")           # never drained -> drops
+            for i in range(n):
+                await bus.publish("ticks", i)
+            return bus
+
+        bus = asyncio.run(scenario())
+        rows = [json.loads(line)
+                for line in open(str(tmp_path / "bus.jsonl"))]
+        return bus, rows
+
+    def test_drop_warnings_rate_limited_counters_exact(self, tmp_path):
+        bus, rows = self._flood(tmp_path, n=200)
+        # 200 publishes into a maxsize-2 queue: first two fill, the next
+        # 198 each drop-oldest — the counter is exact
+        assert bus.dropped_counts["ticks"] == 198
+        warns = [r for r in rows
+                 if r["msg"].startswith("slow subscriber")]
+        assert len(warns) == 1, "drop warnings were not rate limited"
+        assert warns[0]["dropped"] == 1
+        assert warns[0]["total_dropped"] == 1
+        # the suppressed count is recoverable at the next summary
+        last, suppressed = bus._drop_warn["ticks"]
+        assert suppressed == 197
+
+    def test_summary_line_fires_after_interval(self, tmp_path):
+        bus, rows = self._flood(tmp_path, n=50, warn_interval_s=0.0)
+        # zero interval = summary every drop: all 48 drops after the
+        # edge produce lines, each carrying the running total
+        warns = [r for r in rows if r["msg"].startswith("slow subscriber")]
+        assert len(warns) == 48
+        assert warns[-1]["total_dropped"] == 48
+
+    def test_drop_episode_end_flushes_suppressed_summary(self, tmp_path):
+        """A burst that STOPS still lands its suppressed count in the
+        log: the next healthy publish after the interval flushes an
+        episode-ended summary (the log, not just the counters, records
+        how much was lost)."""
+        async def scenario():
+            log = StructuredLogger("bus", path=str(tmp_path / "f.jsonl"))
+            bus = EventBus(max_queue=2, log=log, warn_interval_s=0.05)
+            q = bus.subscribe("ticks")
+            for i in range(10):              # 8 drops: 1 warn + 7 hidden
+                await bus.publish("ticks", i)
+            time.sleep(0.06)                 # the episode ends
+            while not q.empty():
+                q.get_nowait()               # subscriber catches up
+            await bus.publish("ticks", 99)   # healthy publish: flush
+            return bus
+
+        bus = asyncio.run(scenario())
+        rows = [json.loads(line) for line in open(str(tmp_path / "f.jsonl"))]
+        ended = [r for r in rows if "episode ended" in r["msg"]]
+        assert len(ended) == 1
+        assert ended[0]["suppressed_warnings"] == 7
+        assert ended[0]["total_dropped"] == 8
+        assert bus.dropped_counts["ticks"] == 8      # counters exact
+
+    def test_grow_channel_backlog_warning_rate_limited(self, tmp_path):
+        async def scenario():
+            log = StructuredLogger("bus", path=str(tmp_path / "g.jsonl"))
+            bus = EventBus(max_queue=4, log=log, warn_interval_s=1e9)
+            bus.subscribe("alerts")          # "grow": unbounded
+            for i in range(64):
+                await bus.publish("alerts", i)
+            return bus
+
+        bus = asyncio.run(scenario())
+        rows = [json.loads(line) for line in open(str(tmp_path / "g.jsonl"))]
+        backlog = [r for r in rows if "backlog" in r["msg"]]
+        # 64 deep on a soft limit of 4: edge at 5, then doublings only
+        # (the queue kept every message — grow channels never drop)
+        assert 1 <= len(backlog) <= 5
+        assert bus.dropped_counts.get("alerts", 0) == 0
+        assert bus.depth_watermarks["alerts"] == 64
+
+
+class TestEventLoopLagProbe:
+    def test_blocking_call_becomes_measured_lag(self):
+        from ai_crypto_trader_tpu.utils.health import EventLoopLagProbe
+
+        async def scenario():
+            probe = EventLoopLagProbe()
+            probe.sample()                   # arm
+            time.sleep(0.05)                 # a blocking host call
+            await asyncio.sleep(0)           # loop regains control
+            return probe
+
+        probe = asyncio.run(scenario())
+        assert probe.samples == 1
+        assert probe.last_lag_s >= 0.05
+        assert probe.max_lag_s >= 0.05
+
+    def test_no_loop_is_a_noop(self):
+        from ai_crypto_trader_tpu.utils.health import EventLoopLagProbe
+
+        probe = EventLoopLagProbe()
+        assert probe.sample() == 0.0         # sync context: no crash
+        assert probe.samples == 0
+
+
+class TestCapacityAlerts:
+    """Satellite: overload fires BusBackpressure, nominal stays silent —
+    and every new capacity alert exists in BOTH rule engines."""
+
+    def _state(self, bus, **extra):
+        sat = SaturationMonitor(backpressure_utilization=0.75)
+        sat.observe_bus(bus)
+        return {**sat.alert_state(), **extra}
+
+    def test_bus_backpressure_fires_under_forced_saturation(self):
+        async def scenario():
+            bus = EventBus(max_queue=4)
+            bus.subscribe("market_updates")  # stuck subscriber
+            for i in range(4):               # pinned AT capacity
+                await bus.publish("market_updates", i)
+            return bus
+
+        bus = asyncio.run(scenario())
+        mgr = AlertManager(now_fn=lambda: 0.0)
+        fired = mgr.evaluate(self._state(bus))
+        assert "BusBackpressure" in {a["name"] for a in fired}
+
+    def test_bus_backpressure_silent_at_nominal_load(self):
+        async def scenario():
+            bus = EventBus(max_queue=64)
+            q = bus.subscribe("market_updates")
+            for i in range(8):               # drained consumer: shallow
+                await bus.publish("market_updates", i)
+                q.get_nowait()
+            return bus
+
+        bus = asyncio.run(scenario())
+        mgr = AlertManager(now_fn=lambda: 0.0)
+        fired = mgr.evaluate(self._state(bus))
+        names = {a["name"] for a in fired}
+        assert "BusBackpressure" not in names
+        assert "StageSaturated" not in names
+        assert "EventLoopLagHigh" not in names
+
+    def test_stage_saturated_and_loop_lag_rules(self):
+        mgr = AlertManager(now_fn=lambda: 0.0)
+        fired = mgr.evaluate({"saturated_stages": ["analyzer"],
+                              "event_loop_lag_s": 0.5})
+        names = {a["name"] for a in fired}
+        assert {"StageSaturated", "EventLoopLagHigh"} <= names
+        # resolution clears them
+        mgr.evaluate({"saturated_stages": [], "event_loop_lag_s": 0.0})
+        assert "StageSaturated" not in mgr.active
+        assert "EventLoopLagHigh" not in mgr.active
+
+    def test_promql_twins_exist_and_reference_emitted_series(self):
+        """Coherence (the PR 1 suite, extended to the capacity series):
+        the three capacity alerts exist in monitoring/alert_rules.yml,
+        and every capacity/saturation/loop-lag series they (and the
+        recording rules) reference is one the code emits."""
+        import re
+
+        import yaml
+
+        from test_observability import TestStackConfigCoherence
+
+        emitted = TestStackConfigCoherence().emitted_series()
+        new_series = {"stage_duty_cycle", "saturation_samples",
+                      "stage_busy_seconds_total", "bus_queue_utilization",
+                      "bus_queue_high_watermark", "scatter_list_occupancy",
+                      "host_readback_share", "event_loop_lag_seconds"}
+        missing = new_series - emitted
+        assert not missing, f"capacity series not emitted: {missing}"
+
+        rules = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/alert_rules.yml")))
+        alert_names = {r["alert"] for g in rules["groups"]
+                       for r in g["rules"] if "alert" in r}
+        assert {"StageSaturated", "BusBackpressure",
+                "EventLoopLagHigh"} <= alert_names
+        # every referenced crypto_trader_tpu_* series in the capacity
+        # alerts resolves to an emitted one
+        for g in rules["groups"]:
+            for r in g["rules"]:
+                if r.get("alert") in ("StageSaturated", "BusBackpressure",
+                                      "EventLoopLagHigh"):
+                    for m in re.finditer(
+                            r"crypto_trader_tpu_([a-z0-9_]+)", r["expr"]):
+                        assert m.group(1) in emitted, m.group(1)
+        # in-process twins exist with the same names
+        from ai_crypto_trader_tpu.utils.alerts import default_rules
+
+        in_process = {r.name for r in default_rules()}
+        assert {"StageSaturated", "BusBackpressure",
+                "EventLoopLagHigh"} <= in_process
+        # recording rules for the Capacity row parse and resolve too
+        rec = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/recording_rules.yml")))
+        rec_groups = [g for g in rec["groups"]
+                      if g["name"] == "crypto_trader_tpu_capacity"]
+        assert rec_groups and rec_groups[0]["rules"]
+
+
+def _load_config(**kw):
+    from ai_crypto_trader_tpu.testing.loadgen import LoadConfig
+
+    base = dict(tenants=2, symbols=2, ticks=6, warmup_ticks=2, window=64,
+                slo_p99_ms=250.0, min_samples=2, seed=3)
+    base.update(kw)
+    return LoadConfig(**base)
+
+
+class TestLoadHarness:
+    def test_load_point_smoke_real_path_zero_rest(self):
+        """One load point through the REAL path: frames → supervisor →
+        fused engine → N tenant lanes.  Steady state serves from the
+        stream's candle books (zero REST kline calls), every tenant lane
+        analyzed every tick, and the saturation gauges exported."""
+        from ai_crypto_trader_tpu.testing.loadgen import run_load
+
+        m = MetricsRegistry()
+        rep = run_load(_load_config(), metrics=m)
+        assert rep["ticks"] == 6
+        assert rep["lanes"] == 4
+        # every tick published every symbol, every tenant analyzed it
+        assert rep["published"] == 6 * 2
+        assert rep["analyzed"] == 6 * 2 * 2
+        assert rep["rest_kline_calls_steady"] == 0
+        assert rep["p99_ms"] > 0
+        assert set(rep["stage_duty"]) >= {"stream", "analyzer", "executor"}
+        assert rep["bottleneck_stage"] in rep["stage_duty"]
+        text = m.exposition()
+        assert 'crypto_trader_tpu_stage_duty_cycle{stage="stream"}' in text
+        assert "crypto_trader_tpu_scatter_list_occupancy" in text
+        assert "crypto_trader_tpu_event_loop_lag_seconds" in text
+
+    def test_tenant_lanes_are_independent(self):
+        """Lane tagging: each tenant's executor processes only its own
+        analyzer's signals (N lanes, not N² cross-talk)."""
+        from ai_crypto_trader_tpu.testing.loadgen import (
+            LoadConfig, SyntheticTenantTraffic)
+
+        traffic = SyntheticTenantTraffic(_load_config(tenants=3))
+        assert isinstance(traffic.cfg, LoadConfig)
+
+        async def go():
+            for _ in range(3):
+                await traffic.tick(timed=False)
+
+        asyncio.run(go())
+        lanes = {lane.analyzer.lane for lane in traffic.lanes}
+        assert len(lanes) == 3
+        for lane in traffic.lanes:
+            assert lane.executor.lane == lane.analyzer.lane
+        # signals on the shared bus carry their lane tag
+        sig = traffic.bus.get("latest_signal_" + traffic.symbols[0])
+        assert sig is not None and sig.get("lane") in lanes
+
+    def test_ramp_breach_attributed_to_named_stage(self):
+        """ACCEPTANCE: the closed-loop ramp breaches the p99 SLO under a
+        forced per-lane analyzer load, and the breach point is attributed
+        to the analyzer stage BY THE DUTY GAUGES — the stage is named by
+        telemetry (saturated_stages from the windowed duty cycle), not
+        inferred from the latency number."""
+        from ai_crypto_trader_tpu.testing.loadgen import ramp
+
+        m = MetricsRegistry()
+        base = _load_config(tenants=4, ticks=6, slo_p99_ms=120.0,
+                            analyzer_lag_s=0.05, min_samples=2)
+        out = ramp(base, metrics=m)
+        assert out["breach"] is not None, \
+            f"ramp never breached: {[s['p99_ms'] for s in out['steps']]}"
+        # telemetry names the forced stage
+        assert "analyzer" in out["saturated_stages"]
+        assert out["bottleneck_stage"] == "analyzer"
+        assert out["breach"]["p99_ms"] > out["slo_p99_ms"]
+        # the max sustainable point (if any) is a strictly smaller load,
+        # refined to within one tenant of the breach (the bisection that
+        # keeps the bench gate's tolerance meaningful)
+        if out["max_sustainable"] is not None:
+            assert (out["max_sustainable"]["lanes"]
+                    < out["breach"]["lanes"])
+            assert (out["breach"]["tenants"]
+                    - out["max_sustainable"]["tenants"]) == 1
+        # the attribution came from the exported gauge, same value
+        key = 'crypto_trader_tpu_stage_duty_cycle{stage="analyzer"}'
+        assert m.gauges[key] > 0.75
+        # the injected BLOCKING lag is visible to the loop-lag probe too
+        breached_steps = [s for s in out["steps"] if s["breached"]]
+        assert breached_steps
+        assert all(s["event_loop_lag_max_s"] >= 0.05
+                   for s in breached_steps)
+
+    def test_launcher_exports_saturation_and_capacity_block(self):
+        """TradingSystem wiring: a tick exports the stage duty gauges and
+        the dashboard /state.json carries the `capacity` block."""
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        series = from_dict({k: v for k, v in
+                            generate_ohlcv(n=400, seed=5).items()
+                            if k != "regime"}, symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series})
+        clock = {"t": 1000.0}
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: clock["t"])
+
+        async def cheap_poll(*a, **kw):
+            return 1
+
+        system.monitor.poll = cheap_poll     # no engine compile needed
+
+        async def go():
+            for _ in range(3):
+                clock["t"] += 60.0
+                await system.tick()
+
+        asyncio.run(go())
+        assert system.saturation is not None
+        duty = system.saturation.windowed_duty()
+        assert {"monitor", "analyzer", "executor"} <= set(duty)
+        text = system.metrics.exposition()
+        assert 'crypto_trader_tpu_stage_duty_cycle{stage="monitor"}' in text
+        assert "crypto_trader_tpu_event_loop_lag_seconds" in text
+        assert system.loop_lag.samples > 0
+        # the /state.json capacity block
+        from ai_crypto_trader_tpu.shell.dashboard_server import (
+            DashboardServer)
+
+        server = DashboardServer(system, port=0).start()
+        try:
+            state = server.state()
+            assert "capacity" in state
+            assert "stage_duty" in state["capacity"]
+            json.dumps(state["capacity"])
+        finally:
+            server.stop()
+
+    def test_saturated_stage_reaches_launcher_alerts(self):
+        """A saturating stage raises StageSaturated through the
+        launcher's own rule engine (the in-process alert path)."""
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        series = from_dict({k: v for k, v in
+                            generate_ohlcv(n=400, seed=5).items()
+                            if k != "regime"}, symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series})
+        clock = {"t": 1000.0}
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: clock["t"],
+                               tick_budget_s=0.01)   # tiny budget
+        system.saturation.min_samples = 2
+
+        async def slow_poll(*a, **kw):
+            time.sleep(0.02)                 # 2× the whole tick budget
+            return 1
+
+        system.monitor.poll = slow_poll
+
+        async def go():
+            for _ in range(3):
+                clock["t"] += 60.0
+                await system.tick()
+
+        asyncio.run(go())
+        assert "monitor" in system.saturation.saturated_stages()
+        assert "StageSaturated" in system.alerts.active
+
+
+@pytest.mark.slow
+class TestLoadSoak:
+    def test_soak_ramp_full(self):
+        """The slow soak ramp: more tenants, more symbols, more ticks —
+        the ramp either finds a breach (attributed to a named stage) or
+        sustains the whole schedule; either way the telemetry is
+        complete at every step and the steady state stays zero-REST."""
+        from ai_crypto_trader_tpu.testing.loadgen import ramp
+
+        base = _load_config(tenants=8, symbols=4, ticks=20,
+                            warmup_ticks=3, min_samples=4,
+                            slo_p99_ms=5_000.0)
+        out = ramp(base)
+        assert len(out["steps"]) >= 1
+        for step in out["steps"]:
+            assert step["ticks"] == 20
+            assert step["rest_kline_calls_steady"] == 0
+            assert step["published"] == 20 * 4
+            assert step["analyzed"] == 20 * 4 * step["tenants"]
+            assert step["bottleneck_stage"] in step["stage_duty"]
+            assert np.isfinite(step["p99_ms"])
+        if out["breach"] is not None:
+            assert out["saturated_stages"], \
+                "breach without a telemetry-named saturated stage"
+        else:
+            assert out["max_sustainable"]["lanes"] == 8 * 4
