@@ -16,7 +16,6 @@ from ai_crypto_trader_tpu.backtest.engine import (  # noqa: F401
     prepare_inputs,
     run_backtest,
     sweep,
-    sweep_sharded,
 )
 from ai_crypto_trader_tpu.backtest.metrics import compute_metrics  # noqa: F401
 from ai_crypto_trader_tpu.backtest.portfolio import (  # noqa: F401

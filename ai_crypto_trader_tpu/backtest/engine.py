@@ -43,7 +43,6 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ai_crypto_trader_tpu.backtest import signals as sig
 from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
@@ -211,6 +210,31 @@ def _run_backtest_jit(
     """
     T = inputs.close.shape[-1]
     steps = jnp.arange(T, dtype=jnp.int32)
+    step = replay_step(
+        params, warmup=warmup,
+        ai_confidence_threshold=ai_confidence_threshold,
+        min_signal_strength=min_signal_strength,
+        reference_quirks=reference_quirks, use_param_sl_tp=use_param_sl_tp,
+        return_curve=return_curve, sell_exits=sell_exits)
+
+    init = _init_state(initial_balance)
+    xs = (steps,) + tuple(inputs)
+    final, curve = lax.scan(step, init, xs, unroll=unroll)
+
+    stats = finalize_stats(final, inputs.close[-1], initial_balance)
+    return (stats, curve) if return_curve else stats
+
+
+def replay_step(params: StrategyParams | None, *, warmup: int,
+                ai_confidence_threshold, min_signal_strength,
+                reference_quirks: bool, use_param_sl_tp: bool,
+                return_curve: bool, sell_exits: bool):
+    """THE per-candle replay transition, extracted so every scan in the
+    repo — the plain replay, the vmapped sweep, and the GA's fused
+    signal+replay program (backtest/evolvable.py) — runs the SAME
+    position-bookkeeping code.  Returns ``step(state, x)`` where ``x`` is
+    (t, close, signal, strength, volatility, volume, confidence,
+    decision, sl_override, tp_override) — scalars or same-shaped arrays."""
 
     def step(s: CarryState, x):
         (t, close, signal, strength, vol, volume, conf, decision,
@@ -289,15 +313,16 @@ def _run_backtest_jit(
         )
         return s, (equity if return_curve else None)
 
-    init = _init_state(initial_balance)
-    xs = (steps,) + tuple(inputs)
-    final, curve = lax.scan(step, init, xs, unroll=unroll)
+    return step
 
-    # --- close any remaining position at the last price ("End of Test",
-    # strategy_tester.py:302-307) ---
-    final = _book_close(final, inputs.close[-1], final.in_pos)
 
-    stats = BacktestStats(
+def finalize_stats(final: CarryState, last_close,
+                   initial_balance) -> BacktestStats:
+    """Close any remaining position at the last price ("End of Test",
+    strategy_tester.py:302-307) and assemble the raw stats — shared by
+    every scan that drives `replay_step`."""
+    final = _book_close(final, last_close, final.in_pos)
+    return BacktestStats(
         initial_balance=jnp.asarray(initial_balance, jnp.float32),
         final_balance=final.balance,
         total_trades=final.trades,
@@ -314,7 +339,6 @@ def _run_backtest_jit(
         max_win_streak=final.max_win_streak,
         max_loss_streak=final.max_loss_streak,
     )
-    return (stats, curve) if return_curve else stats
 
 
 def run_backtest(inputs: BacktestInputs,
@@ -361,61 +385,61 @@ def _sweep_jit(inputs: BacktestInputs, params: StrategyParams,
     return jax.vmap(fn)(params)
 
 
-def sweep(inputs: BacktestInputs, params: StrategyParams, *args, **kw):
-    """Host entry for `_sweep_jit` (same signature), with a
-    `backtest.sweep` span + compile/execute attribution when traced and a
-    one-shot ``backtest_sweep`` devprof cost card (FLOPs/bytes only: the
-    sweep program is the largest in the repo, so the card skips the AOT
-    backend re-compile that memory_analysis would cost — see
-    utils/devprof.py)."""
+# The non-population arguments of _sweep_jit in positional order, so the
+# partitioned path can fold them into its cached closure (they are rare
+# and hashable — statics or scalar budgets).
+_SWEEP_ARG_NAMES = ("initial_balance", "ai_confidence_threshold",
+                    "min_signal_strength", "warmup", "reference_quirks",
+                    "return_curve", "unroll")
+
+
+@functools.lru_cache(maxsize=16)
+def _sweep_partitioned(partitioner, kw_items: tuple):
+    """One cached sharded sweep program per (partitioner, settings): the
+    population axis splits over the mesh data axis, each device runs its
+    strategy shard over the replicated candle arrays, and results are
+    all-gathered over ICI (the collective that replaces the reference's
+    "publish fitness to Redis", SURVEY §2.7).  Ragged populations pad +
+    slice inside the partitioner (repeating the last individual)."""
+    kw = dict(kw_items)
+    return partitioner.population_eval(
+        lambda p_shard, inputs: _sweep_jit(inputs, p_shard, **kw))
+
+
+def sweep(inputs: BacktestInputs, params: StrategyParams, *args,
+          partitioner=None, **kw):
+    """Host entry for the population sweep (same signature as `_sweep_jit`
+    plus ``partitioner``), with a `backtest.sweep` span + compile/execute
+    attribution when traced and a one-shot ``backtest_sweep`` devprof cost
+    card (FLOPs/bytes only: the sweep program is the largest in the repo,
+    so the card skips the AOT backend re-compile that memory_analysis
+    would cost — see utils/devprof.py).
+
+    ``partitioner`` (parallel/partitioner.py) shards the population over
+    the mesh data axis — `parallel.get_partitioner()` to use every
+    visible device; None / single-device runs the plain jit program.
+    Results are identical either way (the mesh-invariance contract,
+    tests/test_partitioner.py)."""
+    sharded = (partitioner is not None
+               and getattr(partitioner, "device_count", 1) > 1)
+    if sharded:
+        kw = {**dict(zip(_SWEEP_ARG_NAMES, args)), **kw}
+        fn = _sweep_partitioned(partitioner, tuple(sorted(kw.items())))
+        call = lambda: fn(params, inputs)  # noqa: E731
+        card, card_fn, card_args = ("population_sweep", fn, (params, inputs))
+    else:
+        call = lambda: _sweep_jit(inputs, params, *args, **kw)  # noqa: E731
+        card, card_fn, card_args = ("backtest_sweep", _sweep_jit,
+                                    (inputs, params) + args)
     if (devprof.active() is not None
             and not isinstance(inputs.close, jax.core.Tracer)
-            and not devprof.has_card("backtest_sweep")):
-        devprof.cost_card("backtest_sweep", _sweep_jit, inputs, params,
-                          *args, _memory_analysis=False, **kw)
+            and not devprof.has_card(card)):
+        devprof.cost_card(card, card_fn, *card_args,
+                          _memory_analysis=False,
+                          **({} if sharded else kw))
     return _traced_entry(
         "backtest.sweep", inputs.close,
         lambda: {"candles": int(inputs.close.shape[-1]),
-                 "population": int(jax.tree.leaves(params)[0].shape[0])},
-        lambda: _sweep_jit(inputs, params, *args, **kw))
-
-
-def sweep_sharded(mesh, inputs: BacktestInputs, params: StrategyParams, **kw):
-    """Shard the population over the mesh's data axis.
-
-    The population axis is split across devices; every device runs its shard
-    of strategies over the (replicated) candle array, and results are
-    all-gathered — the ICI collective that replaces the reference's
-    "publish fitness to Redis" (SURVEY §2.7).
-
-    Populations that don't divide the data axis are transparently padded
-    (repeating the last individual) and the results sliced back."""
-    data_axis = mesh.axis_names[0]
-    n_dev = mesh.shape[data_axis]
-    pop = jax.tree.leaves(params)[0].shape[0]
-    pad = (-pop) % n_dev
-    if pad:
-        params = jax.tree.map(
-            lambda x: jnp.concatenate([x, jnp.repeat(x[-1:], pad, axis=0)]),
-            params)
-    pspec = P(data_axis)
-
-    def local_sweep(p_shard):
-        # private jit entry: inside shard_map tracing the closed-over
-        # inputs stay concrete, so the traced host wrapper must not run
-        return _sweep_jit(inputs, p_shard, **kw)
-
-    shard_fn = jax.shard_map(
-        local_sweep,
-        mesh=mesh,
-        in_specs=(pspec,),
-        out_specs=pspec,
-        check_vma=False,
-    )
-    params = jax.device_put(params, NamedSharding(mesh, pspec))
-    out = shard_fn(params)
-    if pad:
-        out = jax.tree.map(
-            lambda x: x[:pop] if getattr(x, "ndim", 0) >= 1
-            and x.shape[0] == pop + pad else x, out)
-    return out
+                 "population": int(jax.tree.leaves(params)[0].shape[0]),
+                 "devices": getattr(partitioner, "device_count", 1)},
+        call)

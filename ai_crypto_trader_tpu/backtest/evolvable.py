@@ -14,6 +14,22 @@ parameter vector drives a real backtest:
 
 so GA fitness = real vectorized backtest Sharpe, evaluated for the whole
 population in one vmap and sharded over the mesh.
+
+Period-table fast path (ISSUE 11): every period dimension the GA evolves
+is a SMALL INTEGER RANGE (PARAM_RANGES marks them integer; the GA rounds
+them), so per-genome indicator values are draws from a finite menu.
+`build_indicator_tables` computes every integer period's indicator row
+ONCE per market window ([n_periods, T] tables, built by vmapping the very
+same dynamic kernels over the period grid — the same math as the
+per-genome computation; XLA's per-context FMA choices can wobble the last
+f32 bit of a row, which the parity tests bound), and the population eval
+gathers rows by genome period instead of re-running ~12 length-T kernels
+per genome per generation.  At bench scale (pop 256 × 43 200 candles) the
+indicator pipeline was ~95 % of fitness-eval wall time; the tables turn
+that into seven gathers, and `evolvable_fused_backtest` folds the vote
+rule into the replay scan so nothing [pop, T]-sized is materialized
+between gather and replay.  `tables=None` keeps the direct per-genome
+path — the parity oracle the tests pin the gather path against.
 """
 
 from __future__ import annotations
@@ -34,6 +50,16 @@ from ai_crypto_trader_tpu.backtest import signals as sig
 WMAX_BB = int(PARAM_RANGES["bollinger_period"][1])      # 30
 WMAX_VOL = int(PARAM_RANGES["volume_ma_period"][1])     # 30
 
+# Integer period grids (inclusive) — the finite menus the GA draws from.
+# One EMA grid serves ema_short, ema_long, macd_fast AND macd_slow (the
+# MACD line is just a difference of two EMA rows).
+_EMA_LO = int(min(PARAM_RANGES["ema_short"][0], PARAM_RANGES["macd_fast"][0]))
+_EMA_HI = int(max(PARAM_RANGES["ema_long"][1], PARAM_RANGES["macd_slow"][1]))
+_RSI_LO, _RSI_HI = (int(v) for v in PARAM_RANGES["rsi_period"][:2])
+_ATR_LO, _ATR_HI = (int(v) for v in PARAM_RANGES["atr_period"][:2])
+_BB_LO, _BB_HI = (int(v) for v in PARAM_RANGES["bollinger_period"][:2])
+_VOL_LO, _VOL_HI = (int(v) for v in PARAM_RANGES["volume_ma_period"][:2])
+
 
 class SocialInputs(NamedTuple):
     """Optional per-candle social metrics (sentiment 0-100, volume,
@@ -44,24 +70,132 @@ class SocialInputs(NamedTuple):
     engagement: jnp.ndarray
 
 
-def evolvable_signal(ohlcv: dict, p: StrategyParams,
-                     social: SocialInputs | None = None):
-    """Per-candle (signal ∈ {-1,0,1}, strength ∈ [0,100], volatility) for
-    one parameter vector. vmap over a stacked StrategyParams for the
-    population axis."""
-    close, high, low, volume = (ohlcv[k] for k in ("close", "high", "low", "volume"))
+class IndicatorTables(NamedTuple):
+    """Per-integer-period indicator rows over one market window.
 
-    rsi = ind_ops.nanfill(dyn.rsi_dyn(close, p.rsi_period))
-    macd_line, _, _ = dyn.macd_dyn(close, p.macd_fast, p.macd_slow, p.macd_signal)
-    macd_line = ind_ops.nanfill(macd_line)
-    _, _, _, _, bb_pos = dyn.bollinger_dyn(close, p.bollinger_period,
-                                           p.bollinger_std, WMAX_BB)
-    bb_pos = ind_ops.nanfill(bb_pos)
-    ema_s = ind_ops.nanfill(dyn.ema_dyn(close, p.ema_short))
-    ema_l = ind_ops.nanfill(dyn.ema_dyn(close, p.ema_long))
-    atr = ind_ops.nanfill(dyn.atr_dyn(high, low, close, p.atr_period))
-    vol_ma = ind_ops.nanfill(dyn.rolling_mean_dyn(volume, p.volume_ma_period, WMAX_VOL))
+    Every leaf is [n_periods, T] except ``atr_median`` ([n_periods] —
+    the per-period median of ATR/close, so the adaptive-exit reference
+    level costs a gather instead of a per-genome sort).  The `_fill`
+    tables store nanfill-ed rows (nanfill commutes with the row gather,
+    so filling once per PERIOD replaces two associative scans per GENOME
+    per generation); `ema_raw` keeps the warmup NaNs because the MACD
+    line needs the raw difference (see `_filled_indicators`)."""
 
+    ema_raw: jnp.ndarray     # spans _EMA_LO.._EMA_HI, warmup NaN
+    ema_fill: jnp.ndarray    # nanfill(ema_raw) — the trend EMAs
+    rsi_fill: jnp.ndarray    # periods _RSI_LO.._RSI_HI
+    atr_fill: jnp.ndarray    # periods _ATR_LO.._ATR_HI
+    atr_median: jnp.ndarray  # median(nanfill(atr)/close) per atr period
+    bb_mid: jnp.ndarray      # bollinger middle band per period (raw)
+    bb_sd: jnp.ndarray       # bollinger rolling std per period (raw)
+    vol_ma_fill: jnp.ndarray  # volume MA per period
+
+
+def _grid(lo: int, hi: int) -> jnp.ndarray:
+    return jnp.arange(lo, hi + 1, dtype=jnp.float32)
+
+
+@jax.jit
+def build_indicator_tables(ohlcv: dict) -> IndicatorTables:
+    """All integer-period indicator rows for one window, one compiled
+    program.  Rows are produced by vmapping the SAME traced-window kernels
+    (and the same nanfill) the direct path runs per genome — identical
+    math; XLA's fusion context may differ in the last f32 bit
+    (tests/test_evolve.py bounds it and pins the replay stats)."""
+    close, high, low, volume = (ohlcv[k]
+                                for k in ("close", "high", "low", "volume"))
+    ema_raw = jax.vmap(lambda w: dyn.ema_dyn(close, w))(
+        _grid(_EMA_LO, _EMA_HI))
+    atr_fill = jax.vmap(
+        lambda w: ind_ops.nanfill(dyn.atr_dyn(high, low, close, w)))(
+        _grid(_ATR_LO, _ATR_HI))
+    return IndicatorTables(
+        ema_raw=ema_raw,
+        ema_fill=jax.vmap(ind_ops.nanfill)(ema_raw),
+        rsi_fill=jax.vmap(
+            lambda w: ind_ops.nanfill(dyn.rsi_dyn(close, w)))(
+            _grid(_RSI_LO, _RSI_HI)),
+        atr_fill=atr_fill,
+        # median of what the signal path calls `volatility` for this period
+        atr_median=jax.vmap(lambda row: jnp.median(row / close))(atr_fill),
+        bb_mid=jax.vmap(lambda w: dyn.rolling_mean_dyn(close, w, WMAX_BB))(
+            _grid(_BB_LO, _BB_HI)),
+        bb_sd=jax.vmap(lambda w: dyn.rolling_std_dyn(close, w, WMAX_BB))(
+            _grid(_BB_LO, _BB_HI)),
+        vol_ma_fill=jax.vmap(
+            lambda w: ind_ops.nanfill(dyn.rolling_mean_dyn(volume, w,
+                                                           WMAX_VOL)))(
+            _grid(_VOL_LO, _VOL_HI)),
+    )
+
+
+def _row(table: jnp.ndarray, period, lo: int, hi: int) -> jnp.ndarray:
+    """Gather one period's row; clip guards a just-out-of-range float
+    (clamp_params keeps genomes in range, but a hand-built param must not
+    index out of bounds)."""
+    idx = jnp.clip(jnp.round(period).astype(jnp.int32) - lo, 0, hi - lo)
+    return table[idx]
+
+
+def _filled_indicators(ohlcv: dict, p: StrategyParams,
+                       tables: IndicatorTables | None):
+    """(rsi, macd_line, bb_pos, ema_s, ema_l, atr, vol_ma), all
+    nanfill-ed — gathered from the period tables when provided, else
+    computed per genome (the parity oracle)."""
+    close, high, low, volume = (ohlcv[k]
+                                for k in ("close", "high", "low", "volume"))
+    nf = ind_ops.nanfill
+    if tables is None:
+        macd_raw, _, _ = dyn.macd_dyn(close, p.macd_fast, p.macd_slow,
+                                      p.macd_signal)
+        _, _, _, _, bb_raw = dyn.bollinger_dyn(close, p.bollinger_period,
+                                               p.bollinger_std, WMAX_BB)
+        return (nf(dyn.rsi_dyn(close, p.rsi_period)), nf(macd_raw),
+                nf(bb_raw),
+                nf(dyn.ema_dyn(close, p.ema_short)),
+                nf(dyn.ema_dyn(close, p.ema_long)),
+                nf(dyn.atr_dyn(high, low, close, p.atr_period)),
+                nf(dyn.rolling_mean_dyn(volume, p.volume_ma_period,
+                                        WMAX_VOL)))
+
+    # MACD line = fast EMA row − slow EMA row on the RAW table.  Its NaN
+    # set is the leading warmup run t < slow-1 (slow ≥ fast by range, no
+    # interior NaNs), so nanfill (ffill→bfill→0) reduces EXACTLY to
+    # "backfill with the first valid value, diff[slow-1]" — one gather +
+    # select instead of two associative scans per genome.  (The signal
+    # line is dead code in the vote rule either way.)
+    diff = (_row(tables.ema_raw, p.macd_fast, _EMA_LO, _EMA_HI)
+            - _row(tables.ema_raw, p.macd_slow, _EMA_LO, _EMA_HI))
+    T = close.shape[-1]
+    first_valid = jnp.clip(jnp.round(p.macd_slow).astype(jnp.int32) - 1,
+                           0, T - 1)
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    macd_line = jnp.nan_to_num(
+        jnp.where(t_idx < first_valid, jnp.take(diff, first_valid, axis=-1),
+                  diff))
+    # Bollinger %B from the (mid, sd) rows — bollinger_dyn's exact
+    # formula, then the genome's own nanfill (sd==0 windows put interior
+    # NaNs at data-dependent spots, so this one can't precompute).
+    mid = _row(tables.bb_mid, p.bollinger_period, _BB_LO, _BB_HI)
+    sd = _row(tables.bb_sd, p.bollinger_period, _BB_LO, _BB_HI)
+    hi_band, lo_band = mid + p.bollinger_std * sd, mid - p.bollinger_std * sd
+    rng = hi_band - lo_band
+    bb_pos = nf((close - lo_band) / jnp.where(rng == 0.0, jnp.nan, rng))
+    return (_row(tables.rsi_fill, p.rsi_period, _RSI_LO, _RSI_HI),
+            macd_line, bb_pos,
+            _row(tables.ema_fill, p.ema_short, _EMA_LO, _EMA_HI),
+            _row(tables.ema_fill, p.ema_long, _EMA_LO, _EMA_HI),
+            _row(tables.atr_fill, p.atr_period, _ATR_LO, _ATR_HI),
+            _row(tables.vol_ma_fill, p.volume_ma_period, _VOL_LO, _VOL_HI))
+
+
+def _vote_signal(p: StrategyParams, close, volume, rsi, macd_line, bb_pos,
+                 ema_s, ema_l, atr, vol_ma,
+                 social: SocialInputs | None = None):
+    """The vote rule as pure elementwise ops — shape-polymorphic, so the
+    SAME code scores a whole [T] window (evolvable_signal) and a single
+    candle inside the fused replay scan (evolvable_fused_backtest).
+    Returns (signal, strength, volatility)."""
     volatility = atr / close
     uptrend = ema_s > ema_l
     downtrend = ema_s < ema_l
@@ -110,9 +244,24 @@ def evolvable_signal(ohlcv: dict, p: StrategyParams,
     return signal, strength, volatility
 
 
+def evolvable_signal(ohlcv: dict, p: StrategyParams,
+                     social: SocialInputs | None = None,
+                     tables: IndicatorTables | None = None):
+    """Per-candle (signal ∈ {-1,0,1}, strength ∈ [0,100], volatility) for
+    one parameter vector. vmap over a stacked StrategyParams for the
+    population axis; pass ``tables`` to gather indicator rows instead of
+    recomputing them per genome."""
+    close, volume = ohlcv["close"], ohlcv["volume"]
+    rsi, macd_line, bb_pos, ema_s, ema_l, atr, vol_ma = \
+        _filled_indicators(ohlcv, p, tables)
+    return _vote_signal(p, close, volume, rsi, macd_line, bb_pos,
+                        ema_s, ema_l, atr, vol_ma, social)
+
+
 def evolvable_inputs(ohlcv: dict, p: StrategyParams,
-                     social: SocialInputs | None = None) -> BacktestInputs:
-    signal, strength, volatility = evolvable_signal(ohlcv, p, social)
+                     social: SocialInputs | None = None,
+                     tables: IndicatorTables | None = None) -> BacktestInputs:
+    signal, strength, volatility = evolvable_signal(ohlcv, p, social, tables)
     close = ohlcv["close"]
     avg_volume = jnp.mean(ohlcv["volume"]) * jnp.mean(close)
     T = close.shape[-1]
@@ -123,8 +272,13 @@ def evolvable_inputs(ohlcv: dict, p: StrategyParams,
     # reward:risk ratio), bounded to the same 0.5-2.0 factor range.
     # atr_multiplier=2 at median volatility is the neutral anchor; this makes
     # both ATR genome dims live in fitness (volatility =
-    # atr_dyn(p.atr_period)/close).
-    vol_ref = jnp.maximum(jnp.median(volatility), 1e-8)
+    # atr_dyn(p.atr_period)/close).  With tables, the median comes from the
+    # per-period table instead of a per-genome sort.
+    if tables is None:
+        vol_ref = jnp.maximum(jnp.median(volatility), 1e-8)
+    else:
+        vol_ref = jnp.maximum(
+            _row(tables.atr_median, p.atr_period, _ATR_LO, _ATR_HI), 1e-8)
     factor = jnp.clip(p.atr_multiplier * volatility / (2.0 * vol_ref),
                       0.5, 2.0)
     sl_t = p.stop_loss * factor
@@ -143,27 +297,87 @@ def evolvable_backtest(ohlcv: dict, p: StrategyParams,
                        initial_balance: float = 10_000.0,
                        min_signal_strength: float = 50.0,
                        warmup: int = 10,
-                       social: SocialInputs | None = None):
+                       social: SocialInputs | None = None,
+                       tables: IndicatorTables | None = None):
     """Full pipeline for one parameter vector: dynamic indicators → signal →
     scan backtest with the params' SL/TP. The GA's fitness kernel.
 
     ``social`` (dense per-candle arrays from
     `social.provider.SocialDataProvider.social_inputs`) adds the social
-    vote axis and makes the three social threshold genome dims live."""
-    inputs = evolvable_inputs(ohlcv, p, social)
+    vote axis and makes the three social threshold genome dims live.
+    ``tables`` (build_indicator_tables) swaps the per-genome indicator
+    recomputation for period-row gathers — same values, a fraction of the
+    work when vmapped over a population."""
+    inputs = evolvable_inputs(ohlcv, p, social, tables)
     return run_backtest(inputs, p, initial_balance=initial_balance,
                         min_signal_strength=min_signal_strength,
                         use_param_sl_tp=True, warmup=warmup)
 
 
 @functools.partial(jax.jit, static_argnames=("min_signal_strength", "warmup"))
+def evolvable_fused_backtest(ohlcv: dict, p: StrategyParams,
+                             tables: IndicatorTables,
+                             initial_balance: float = 10_000.0,
+                             min_signal_strength: float = 50.0,
+                             warmup: int = 10):
+    """The GA's fitness kernel with the signal rule FUSED INTO the replay
+    scan.
+
+    The tabled-but-unfused path still materializes ~30 [pop, T]
+    intermediates (votes, strength, exit ladders) between the gathers and
+    the scan — at bench scale that memory traffic, not the replay, is the
+    eval.  Here the scan consumes the seven gathered indicator rows
+    directly and computes votes → signal/strength → adaptive exits
+    per candle in registers via the SAME `_vote_signal` elementwise block
+    and the SAME `engine.replay_step` transition — the replay stats land
+    bit-equal to `evolvable_backtest(..., tables=...)` (pinned in
+    tests/test_evolve.py) at a fraction of the wall time.  Requires
+    tables; no social axis (the unfused path serves both)."""
+    from ai_crypto_trader_tpu.backtest.engine import (
+        _init_state,
+        finalize_stats,
+        replay_step,
+    )
+    from jax import lax
+
+    close, volume = ohlcv["close"], ohlcv["volume"]
+    rsi, macd_line, bb_pos, ema_s, ema_l, atr, vol_ma = \
+        _filled_indicators(ohlcv, p, tables)
+    avg_volume = jnp.mean(ohlcv["volume"]) * jnp.mean(close)
+    vol_ref = jnp.maximum(
+        _row(tables.atr_median, p.atr_period, _ATR_LO, _ATR_HI), 1e-8)
+    conf = jnp.float32(1.0)
+    inner = replay_step(p, warmup=warmup, ai_confidence_threshold=0.7,
+                        min_signal_strength=min_signal_strength,
+                        reference_quirks=False, use_param_sl_tp=True,
+                        return_curve=False, sell_exits=False)
+
+    def step(s, x):
+        t, c, v, rsi_t, macd_t, bb_t, es_t, el_t, atr_t, vma_t = x
+        sig_t, str_t, vol_t = _vote_signal(p, c, v, rsi_t, macd_t, bb_t,
+                                           es_t, el_t, atr_t, vma_t)
+        factor = jnp.clip(p.atr_multiplier * vol_t / (2.0 * vol_ref),
+                          0.5, 2.0)
+        return inner(s, (t, c, sig_t, str_t, vol_t, avg_volume, conf,
+                         sig_t, p.stop_loss * factor, p.take_profit * factor))
+
+    T = close.shape[-1]
+    steps = jnp.arange(T, dtype=jnp.int32)
+    final, _ = lax.scan(step, _init_state(initial_balance),
+                        (steps, close, volume, rsi, macd_line, bb_pos,
+                         ema_s, ema_l, atr, vol_ma), unroll=8)
+    return finalize_stats(final, close[-1], initial_balance)
+
+
+@functools.partial(jax.jit, static_argnames=("min_signal_strength", "warmup"))
 def population_backtest(ohlcv: dict, population: StrategyParams,
                         initial_balance: float = 10_000.0,
                         min_signal_strength: float = 50.0, warmup: int = 10,
-                        social: SocialInputs | None = None):
+                        social: SocialInputs | None = None,
+                        tables: IndicatorTables | None = None):
     """vmap the full dynamic pipeline over a stacked population (one
     compiled program — see engine.sweep note on eager dispatch)."""
     return jax.vmap(lambda p: evolvable_backtest(
         ohlcv, p, initial_balance=initial_balance,
         min_signal_strength=min_signal_strength, warmup=warmup,
-        social=social))(population)
+        social=social, tables=tables))(population)

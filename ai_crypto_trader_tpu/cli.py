@@ -200,14 +200,21 @@ def cmd_evolve(args):
     from ai_crypto_trader_tpu.backtest import default_params
     from ai_crypto_trader_tpu.config import GAParams
     from ai_crypto_trader_tpu.evolve import backtest_fitness, run_ga
+    from ai_crypto_trader_tpu.parallel import get_partitioner
 
     d = _load_or_generate(args.symbol, args.days * 1440, args.seed)
     arrays = {k: jnp.asarray(np.asarray(v)) for k, v in d.items()}
     cfg = GAParams(population_size=args.population, generations=args.generations)
+    # the whole GA runs as ONE compiled scan; the partitioner shards the
+    # population eval over every visible device (single-device fallback
+    # on a 1-chip host)
+    partitioner = get_partitioner()
     best, hist = run_ga(jax.random.PRNGKey(args.seed),
                         backtest_fitness(arrays), cfg,
-                        seed_params=default_params())
+                        seed_params=default_params(),
+                        partitioner=partitioner)
     print(json.dumps({"history": hist,
+                      "devices": partitioner.device_count,
                       "best_params": {k: float(v) for k, v in
                                       best._asdict().items()}}, indent=2))
 
@@ -218,6 +225,7 @@ def cmd_generate(args):
     report the held-out comparison."""
     import asyncio
 
+    from ai_crypto_trader_tpu.parallel import get_partitioner
     from ai_crypto_trader_tpu.strategy.generator import StrategyGenerator
     from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
 
@@ -225,7 +233,7 @@ def cmd_generate(args):
     reg = ModelRegistry(path=args.registry)
     gen = StrategyGenerator(registry=reg, cv_folds=args.folds,
                             pool_size=args.pool, max_rounds=args.rounds,
-                            seed=args.seed)
+                            seed=args.seed, partitioner=get_partitioner())
     out = asyncio.run(gen.generate(d))
 
     def finite(x):

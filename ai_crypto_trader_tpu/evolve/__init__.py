@@ -4,5 +4,4 @@ from ai_crypto_trader_tpu.evolve.ga import (  # noqa: F401
     evolve_step,
     population_diversity,
     run_ga,
-    run_ga_sharded,
 )

@@ -1,33 +1,42 @@
-"""Genetic strategy evolution with *real* backtest fitness, mesh-sharded.
+"""Genetic strategy evolution with *real* backtest fitness, compiled
+end-to-end: the whole G-generation GA is ONE jitted `lax.scan`.
 
 Capability parity with `services/genetic_algorithm.py` (seeded init :83-117,
 elitism + tournament-3 selection :135-161, uniform crossover :163-189,
 int/float mutation :191-223, per-generation history + diversity :293-348) —
-but the two structural flaws of the reference are fixed by design:
+but the structural flaws of the reference are fixed by design:
 
   * its fitness evaluation is a **sequential Python loop** over individuals
     (`genetic_algorithm.py:119-133`) — here the whole population evaluates
-    as one vmapped program, sharded over the mesh data axis with fitness
-    values all-gathered over ICI (replacing "publish fitness to Redis",
+    as one vmapped program, optionally sharded over the mesh data axis via
+    the `Partitioner` seam (parallel/partitioner.py) with fitness values
+    all-gathered over ICI (replacing "publish fitness to Redis",
     SURVEY §2.7);
   * its production fitness is a **heuristic score**, not a backtest
     (`strategy_evolution_service.py:542-641`) — here fitness is the Sharpe
     (blended with drawdown/win-rate exactly where the reference's
     _needs_improvement thresholds look, strategy_evolution_service.py:
-    1571-1582) of a full dynamic-period backtest (backtest/evolvable.py).
-
-Every genetic operator is a pure jitted function of (key, genomes, fitness);
-a generation is one device program.
+    1571-1582) of a full dynamic-period backtest (backtest/evolvable.py);
+  * its generation loop is host-driven — and so was ours until ISSUE 11:
+    the old `run_ga` dispatched the evaluator once per generation and
+    synced THREE scalars back per generation for the history record
+    (3G+1 host round-trips).  `run_ga` now lowers eval → evolve →
+    best-tracking into one `lax.scan` over generations with the
+    (genomes, key) carry DONATED, history accumulated as device arrays,
+    and exactly ONE `host_read` at the end — one dispatch, one sync, for
+    any G.  The retired Python-loop driver survives as `run_ga_legacy`,
+    the bit-exactness oracle the tests pin the scan against.
 """
 
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import lax
 
 from ai_crypto_trader_tpu.backtest.evolvable import evolvable_backtest
 from ai_crypto_trader_tpu.backtest.metrics import compute_metrics
@@ -40,6 +49,15 @@ from ai_crypto_trader_tpu.backtest.strategy import (
     unstack_params,
 )
 from ai_crypto_trader_tpu.config import GAParams
+from ai_crypto_trader_tpu.parallel.partitioner import (
+    Partitioner,
+    SingleDevicePartitioner,
+)
+from ai_crypto_trader_tpu.utils import devprof
+
+# Shared by every run_ga call that doesn't name a partitioner, so the
+# compiled-program cache below keys all of them onto one entry.
+_SINGLE = SingleDevicePartitioner()
 
 
 class GAState(NamedTuple):
@@ -47,6 +65,18 @@ class GAState(NamedTuple):
     fitness: jnp.ndarray      # [pop]
     best_genome: jnp.ndarray  # [n_params]
     best_fitness: jnp.ndarray
+
+
+def host_read(tree):
+    """THE per-run device→host sync: GA outputs → numpy.
+
+    Module-level seam (the ops/tick_engine.host_read pattern) so tests can
+    wrap it with a counting double and assert ONE sync per run_ga.  Timed
+    into the ``host_read`` SLO window when the observatory is on."""
+    t0 = time.perf_counter()
+    out = jax.device_get(tree)
+    devprof.observe_latency("host_read", time.perf_counter() - t0)
+    return out
 
 
 def population_diversity(genomes: jnp.ndarray) -> jnp.ndarray:
@@ -59,13 +89,29 @@ def population_diversity(genomes: jnp.ndarray) -> jnp.ndarray:
 
 def backtest_fitness(ohlcv: dict, *, min_sharpe_weight: float = 1.0,
                      drawdown_limit: float = 15.0,
-                     win_rate_target: float = 52.0) -> Callable:
+                     win_rate_target: float = 52.0,
+                     tables: bool = True) -> Callable:
     """Fitness = backtest Sharpe, penalized by the monitoring thresholds the
     reference's _needs_improvement checks (strategy_evolution_service.py:
-    1571-1582): excess drawdown and win-rate shortfall subtract."""
+    1571-1582): excess drawdown and win-rate shortfall subtract.
+
+    ``tables=True`` (default) precomputes the integer-period indicator
+    tables for this window ONCE (backtest/evolvable.py) so every genome's
+    eval gathers its indicator rows instead of recomputing ~12 length-T
+    kernels, and runs the signal rule fused into the replay scan
+    (`evolvable_fused_backtest`) — the same values bit-for-bit, at a
+    fraction of the per-generation wall time."""
+    from ai_crypto_trader_tpu.backtest.evolvable import (
+        build_indicator_tables,
+        evolvable_fused_backtest,
+    )
+
+    arrays = {k: jnp.asarray(v) for k, v in ohlcv.items() if k != "regime"}
+    tbl = build_indicator_tables(arrays) if tables else None
 
     def fitness(p: StrategyParams) -> jnp.ndarray:
-        stats = evolvable_backtest(ohlcv, p)
+        stats = (evolvable_fused_backtest(arrays, p, tbl) if tbl is not None
+                 else evolvable_backtest(arrays, p))
         m = compute_metrics(stats)
         dd_pen = jnp.maximum(m["max_drawdown_pct"] - drawdown_limit, 0.0) * 0.05
         wr_pen = jnp.maximum(win_rate_target - m["win_rate"], 0.0) * 0.01
@@ -85,11 +131,11 @@ def _tournament(key, fitness, k: int, n_picks: int):
     return cand[jnp.arange(n_picks), jnp.argmax(cand_fit, axis=1)]
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def evolve_step(key, state: GAState, cfg: GAParams) -> GAState:
-    """One generation of selection → crossover → mutation → clamp.
-    Fitness of the new genomes is filled in by the (separately jitted /
-    sharded) evaluation pass — see run_ga."""
+def _evolve_core(key, state: GAState, cfg: GAParams) -> GAState:
+    """One generation of selection → crossover → mutation → clamp (pure;
+    traced both by the standalone `evolve_step` jit and INSIDE the scanned
+    GA program).  Fitness of the new genomes is filled in by the
+    evaluation pass — see run_ga."""
     genomes, fitness = state.genomes, state.fitness
     pop, n_params = genomes.shape
     k_sel, k_cross, k_mut, k_scale = jax.random.split(key, 4)
@@ -122,6 +168,9 @@ def evolve_step(key, state: GAState, cfg: GAParams) -> GAState:
     return state._replace(genomes=new_genomes)
 
 
+evolve_step = jax.jit(_evolve_core, static_argnames=("cfg",))
+
+
 def _update_best(state: GAState) -> GAState:
     i = jnp.argmax(state.fitness)
     better = state.fitness[i] > state.best_fitness
@@ -131,20 +180,64 @@ def _update_best(state: GAState) -> GAState:
     )
 
 
-def run_ga(key, fitness_fn: Callable, cfg: GAParams,
-           seed_params: StrategyParams | None = None,
-           eval_fn: Callable | None = None):
-    """GA driver (`genetic_algorithm.py:254-291`): returns (best
-    StrategyParams, history list of per-generation records).
+def _eval_impl(fitness_fn: Callable, partitioner: Partitioner):
+    """Population fitness as one (optionally sharded) program: vmap the
+    scalar fitness over genome rows, population axis split over the mesh
+    data axis by the partitioner, fitness all-gathered."""
+    return partitioner.population_eval(
+        lambda g: jax.vmap(lambda row: fitness_fn(unstack_params(row)))(g))
 
-    `eval_fn(genomes) -> fitness` defaults to a vmap of fitness_fn; pass the
-    sharded evaluator from run_ga_sharded for pod execution."""
+
+@functools.lru_cache(maxsize=2)
+def _ga_program(fitness_fn: Callable, cfg: GAParams,
+                partitioner: Partitioner):
+    """Build (and cache) THE compiled GA: initial eval + G scanned
+    generations, genome buffer donated, history stacked on device.
+
+    Cache key is (fitness closure, cfg, partitioner) identity — repeated
+    runs with ONE fitness closure (the bench's median-of-3, a caller
+    holding its backtest_fitness) reuse one program with zero re-trace,
+    which the contract test pins.  A caller that rebuilds the fitness per
+    run (the evolver cadence evolves a FRESH market window each time)
+    re-traces by construction — that is the price of new data, and
+    maxsize=2 keeps dead closures from pinning more than ~two windows'
+    ohlcv + indicator tables on device."""
+    eval_impl = _eval_impl(fitness_fn, partitioner)
+
+    # Donate the genome buffer: the final population rides back out with
+    # the same [pop, n_params] shape, so XLA aliases the input buffer onto
+    # it (a donation with no shape-matched output would silently degrade
+    # to a copy — exactly what the devprof verifier exists to catch).
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def program(genomes, key):
+        fitness = eval_impl(genomes)
+        state = GAState(genomes, fitness, genomes[jnp.argmax(fitness)],
+                        jnp.max(fitness))
+        state = _update_best(state)
+
+        def gen_step(carry, _):
+            state, key = carry
+            key, k_gen = jax.random.split(key)
+            state = _evolve_core(k_gen, state, cfg)
+            state = state._replace(fitness=eval_impl(state.genomes))
+            state = _update_best(state)
+            record = (state.best_fitness,
+                      jnp.mean(state.fitness),
+                      population_diversity(state.genomes))
+            return (state, key), record
+
+        (state, _), history = lax.scan(gen_step, (state, key), None,
+                                       length=cfg.generations)
+        return state, history
+
+    return program
+
+
+def _init_genomes(key, cfg: GAParams,
+                  seed_params: StrategyParams | None):
+    """Shared by the scanned and legacy drivers so both consume the key
+    stream identically (the bit-exactness contract)."""
     from ai_crypto_trader_tpu.backtest.strategy import sample_params
-
-    if eval_fn is None:
-        eval_fn = jax.jit(
-            lambda g: jax.vmap(lambda row: fitness_fn(unstack_params(row)))(g)
-        )
 
     k_init, key = jax.random.split(key)
     genomes = stack_params(sample_params(k_init, cfg.population_size))
@@ -152,6 +245,64 @@ def run_ga(key, fitness_fn: Callable, cfg: GAParams,
         # Seeded init: individual 0 is the incumbent strategy
         # (genetic_algorithm.py:92-99).
         genomes = genomes.at[0].set(stack_params(seed_params))
+    return genomes, key
+
+
+def run_ga(key, fitness_fn: Callable, cfg: GAParams,
+           seed_params: StrategyParams | None = None,
+           partitioner: Partitioner | None = None):
+    """GA driver (`genetic_algorithm.py:254-291`): returns (best
+    StrategyParams, history list of per-generation records).
+
+    The whole run is ONE compiled program (see `_ga_program`) and ONE
+    `host_read`; ``partitioner`` shards the population eval over a device
+    mesh (default: single-device — pass
+    ``parallel.get_partitioner()`` to use every visible chip).  Matches
+    `run_ga_legacy` bit-for-bit on the same key."""
+    partitioner = partitioner if partitioner is not None else _SINGLE
+    genomes, key = _init_genomes(key, cfg, seed_params)
+    genomes = partitioner.shard_population(genomes) \
+        if cfg.population_size % partitioner.device_count == 0 else genomes
+
+    program = _ga_program(fitness_fn, cfg, partitioner)
+    prof = devprof.active()
+    if prof is not None and not devprof.has_card("ga_scan"):
+        # FLOPs/bytes only: the scanned GA is among the largest programs
+        # in the repo — skip the AOT re-compile memory_analysis costs
+        # (the backtest_sweep precedent, utils/devprof.py).
+        devprof.cost_card("ga_scan", program, genomes, key,
+                          _memory_analysis=False)
+    donated = genomes
+    out = program(genomes, key)
+    if prof is not None:
+        devprof.verify_donation("ga_scan", donated)
+
+    state, (h_best, h_mean, h_div) = host_read(out)
+    best_genome = state.best_genome
+    history = [{
+        "generation": gen,
+        "best_fitness": float(h_best[gen]),
+        "mean_fitness": float(h_mean[gen]),
+        "diversity": float(h_div[gen]),
+    } for gen in range(cfg.generations)]
+    return unstack_params(best_genome), history
+
+
+def run_ga_legacy(key, fitness_fn: Callable, cfg: GAParams,
+                  seed_params: StrategyParams | None = None,
+                  eval_fn: Callable | None = None):
+    """The retired host-driven generation loop: one evaluator dispatch per
+    generation plus three scalar syncs for the history record (3G+1 host
+    round-trips).  Kept ONLY as the parity oracle `run_ga`'s scan is
+    pinned against (tests/test_partitioner.py, tests/test_evolve.py) and
+    as the bench's legacy-driver comparison — product code calls
+    `run_ga`."""
+    if eval_fn is None:
+        eval_fn = jax.jit(
+            lambda g: jax.vmap(lambda row: fitness_fn(unstack_params(row)))(g)
+        )
+
+    genomes, key = _init_genomes(key, cfg, seed_params)
 
     fitness = eval_fn(genomes)
     state = GAState(genomes, fitness, genomes[jnp.argmax(fitness)], jnp.max(fitness))
@@ -170,36 +321,3 @@ def run_ga(key, fitness_fn: Callable, cfg: GAParams,
             "diversity": float(population_diversity(state.genomes)),
         })
     return unstack_params(state.best_genome), history
-
-
-def run_ga_sharded(key, mesh, ohlcv: dict, cfg: GAParams,
-                   seed_params: StrategyParams | None = None,
-                   fitness_fn: Callable | None = None):
-    """GA with population evaluation sharded over the mesh data axis.
-
-    Each device backtests its population shard; fitness is all-gathered over
-    ICI by the out_spec (the collective that replaces the reference's
-    sequential evaluate→publish loop). Population size must divide the data
-    axis; GAParams.population_size is padded up if needed."""
-    fitness_fn = fitness_fn or backtest_fitness(ohlcv)
-    data_axis = mesh.axis_names[0]
-    n_dev = mesh.shape[data_axis]
-    pop = ((cfg.population_size + n_dev - 1) // n_dev) * n_dev
-    if pop != cfg.population_size:
-        import dataclasses
-        cfg = dataclasses.replace(cfg, population_size=pop)
-
-    def local_eval(g_shard):
-        return jax.vmap(lambda row: fitness_fn(unstack_params(row)))(g_shard)
-
-    sharded = jax.jit(jax.shard_map(
-        local_eval, mesh=mesh,
-        in_specs=(P(data_axis, None),), out_specs=P(data_axis),
-        check_vma=False,
-    ))
-
-    def eval_fn(genomes):
-        genomes = jax.device_put(genomes, NamedSharding(mesh, P(data_axis, None)))
-        return sharded(genomes)
-
-    return run_ga(key, fitness_fn, cfg, seed_params, eval_fn=eval_fn)

@@ -154,6 +154,7 @@ def optimize_hyperparameters(
     n_startup: int = 5,
     target_col: int = 0,
     precision: str | None = None,
+    partitioner=None,
 ) -> dict:
     """Returns {"best_params": ..., "best_val_loss": ..., "trials": [...]}.
 
@@ -165,9 +166,32 @@ def optimize_hyperparameters(
     Every trial runs through train_model's compiled-epoch path — one
     donated `lax.scan` program per epoch instead of re-entering the Python
     batch loop per trial — and ``precision`` ("f32"/"bf16") is forwarded
-    to both rungs."""
+    to both rungs.
+
+    ``partitioner`` (parallel/partitioner.py) farms trials over the mesh
+    devices round-robin: trials can't fuse into one SPMD program (each
+    architecture/width compiles to a different shape), but JAX dispatch is
+    async, so pinning consecutive trials' programs to different devices
+    via ``jax.default_device`` overlaps their device time — the host
+    issues trial i+1's epochs while device i is still crunching trial i.
+    None / single-device runs every trial on the default device."""
     rng = np.random.default_rng(seed)
     results = []
+    devices = list(partitioner.trial_devices()) if partitioner is not None \
+        else []
+
+    def run_trial(i: int, t: dict, trial_key, epochs: int, patience: int):
+        def go():
+            return train_model(
+                trial_key, features, t["model_type"], seq_len=seq_len,
+                units=t["units"], dropout=t["dropout"],
+                learning_rate=t["learning_rate"], batch_size=t["batch_size"],
+                epochs=epochs, early_stopping_patience=patience,
+                target_col=target_col, precision=precision)
+        if devices:
+            with jax.default_device(devices[i % len(devices)]):
+                return go()
+        return go()
 
     # Rung 0: short budget for everyone; TPE proposes from accumulated
     # rung-0 results once the warm-up is done.
@@ -176,11 +200,8 @@ def optimize_hyperparameters(
             t = suggest_tpe(results, rng)
         else:
             t = _sample_trial(rng)
-        r = train_model(jax.random.fold_in(key, i), features, t["model_type"],
-                        seq_len=seq_len, units=t["units"], dropout=t["dropout"],
-                        learning_rate=t["learning_rate"], batch_size=t["batch_size"],
-                        epochs=rung_epochs[0], early_stopping_patience=rung_epochs[0],
-                        target_col=target_col, precision=precision)
+        r = run_trial(i, t, jax.random.fold_in(key, i), rung_epochs[0],
+                      patience=rung_epochs[0])
         results.append({"trial": t, "val_loss": r.best_val_loss, "rung": 0})
 
     # Survivors graduate to the full budget; the winner is chosen among
@@ -191,11 +212,8 @@ def optimize_hyperparameters(
     finalists = []
     for rank, i in enumerate(order[:n_sur]):
         t = results[i]["trial"]
-        r = train_model(jax.random.fold_in(key, 10_000 + rank), features,
-                        t["model_type"], seq_len=seq_len, units=t["units"],
-                        dropout=t["dropout"], learning_rate=t["learning_rate"],
-                        batch_size=t["batch_size"], epochs=rung_epochs[-1],
-                        target_col=target_col, precision=precision)
+        r = run_trial(rank, t, jax.random.fold_in(key, 10_000 + rank),
+                      rung_epochs[-1], patience=10)
         rec = {"trial": t, "val_loss": r.best_val_loss, "rung": 1}
         results[i] = rec
         finalists.append(rec)

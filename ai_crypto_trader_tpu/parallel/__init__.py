@@ -1,3 +1,13 @@
+"""Public parallelism API: mesh construction + the Partitioner seam.
+
+The population evaluators (GA, backtest sweep, structure pool, HPO
+trials) all route through `get_partitioner()` — see
+parallel/partitioner.py.  The sequence-parallel scan kernels
+(parallel/time_shard.py) and ring attention (parallel/ring_attention.py)
+are NOT re-exported here: they are exercised by the multichip dryrun and
+the long-context model only — import them from their modules.
+"""
+
 from ai_crypto_trader_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     default_mesh,
@@ -7,12 +17,10 @@ from ai_crypto_trader_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_leading_axis,
 )
-from ai_crypto_trader_tpu.parallel.ring_attention import (  # noqa: F401
-    reference_attention,
-    ring_self_attention,
-)
-from ai_crypto_trader_tpu.parallel.time_shard import (  # noqa: F401
-    sharded_ema,
-    sharded_first_order_recursion,
-    sharded_rolling_mean,
+from ai_crypto_trader_tpu.parallel.partitioner import (  # noqa: F401
+    MeshPartitioner,
+    Partitioner,
+    SingleDevicePartitioner,
+    get_partitioner,
+    match_partition_rules,
 )

@@ -60,6 +60,20 @@ def default_mesh() -> Mesh:
     return make_mesh()
 
 
+def compat_shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    """shard_map across jax versions: `jax.shard_map` (check_vma kwarg)
+    when present, else `jax.experimental.shard_map.shard_map` (check_rep).
+    Replication checking is disabled either way — closed-over replicated
+    arrays (candle windows, fold features) trip the checker."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Shard the leading axis over the data axis, replicate the rest."""
     spec = P(mesh.axis_names[0], *([None] * (ndim - 1)))

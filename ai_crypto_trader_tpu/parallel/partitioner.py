@@ -1,0 +1,237 @@
+"""The Partitioner seam: ONE object that decides how population-parallel
+programs land on the hardware.
+
+Every population evaluator in the repo — the GA fitness pass
+(evolve/ga.py), the backtest sweep (backtest/engine.sweep), the
+strategy-structure pool (strategy/generator.py), and HPO trials
+(models/hpo.py) — maps a leading "population" axis over independent
+members.  Before this seam each caller hand-rolled its own `shard_map`
+plumbing (the dryrun-only `sweep_sharded` / `run_ga_sharded` helpers,
+now absorbed here); after it, callers write the LOCAL computation and the
+partitioner supplies mesh placement, padding to the device count, the
+fitness all-gather (out_specs collective over ICI), and the
+single-device fallback — the SNIPPETS [1]–[3] pattern
+(`match_partition_rules`, `shard_map`, `SingleDevicePartitioner`).
+
+Contracts:
+
+  * ``population_eval(fn)`` — ``fn(pop_tree, *replicated)`` maps members
+    independently (every leaf of ``pop_tree`` shares the leading
+    population axis; every output leaf carries it back).  The returned
+    callable is BOTH a standalone jitted program and traceable inside a
+    larger jit (the scanned GA embeds it inside `lax.scan`).  Populations
+    that don't divide the device count are padded by repeating the last
+    member and the outputs sliced back — padding + masking is the
+    standing answer to ragged shapes on TPU (SURVEY §7.4).
+  * ``shard_population(tree)`` — device_put with the population sharding
+    (leading axis split over the mesh data axis), so a donated carry
+    starts life on the right devices.
+  * ``trial_devices()`` — round-robin device list for HOST-level trial
+    farming (HPO: each trial is its own compiled program; dispatch is
+    async, so placing consecutive trials on different devices overlaps
+    their device time without threads).
+
+Results are mesh-size-invariant by construction: the sharded program
+computes exactly the per-member values the single-device vmap computes
+(the collective only all-gathers), so a 1-device mesh must be bit-equal
+to the `SingleDevicePartitioner` — pinned by tests/test_partitioner.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ai_crypto_trader_tpu.parallel.mesh import (
+    compat_shard_map as _shard_map,
+    default_mesh,
+)
+
+
+def _path_name(path) -> str:
+    """'/'-joined tree path (SNIPPETS [1] named_tree_map, without flax)."""
+    parts = []
+    for k in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                parts.append(str(getattr(k, attr)))
+                break
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, tree):
+    """Pytree of PartitionSpec chosen by the first regex matching each
+    leaf's '/'-joined path (SNIPPETS [1] `match_partition_rules`).
+
+    ``rules`` is a sequence of (pattern, PartitionSpec); scalar leaves
+    (or one-element leaves) are never partitioned.  A leaf no rule covers
+    raises — a silent replicate-by-default would hide a model-parallel
+    sharding bug until the first OOM."""
+    def spec_for(path, leaf):
+        shape = np.shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        name = _path_name(path)
+        for rule, ps in rules:
+            if re.search(rule, name) is not None:
+                return ps
+        raise ValueError(f"no partition rule matches leaf {name!r}")
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+class Partitioner:
+    """Abstract partitioning policy (SNIPPETS [3] `Partitioner`)."""
+
+    mesh: Mesh | None = None
+    axis: str | None = None
+
+    @property
+    def device_count(self) -> int:
+        raise NotImplementedError
+
+    def population_sharding(self, ndim: int = 1):
+        """Sharding for a [pop, ...] array (None = single-device default)."""
+        raise NotImplementedError
+
+    def shard_population(self, tree):
+        raise NotImplementedError
+
+    def population_eval(self, fn):
+        raise NotImplementedError
+
+    def trial_devices(self) -> list:
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {"kind": type(self).__name__, "devices": self.device_count}
+
+
+class SingleDevicePartitioner(Partitioner):
+    """The fallback: every program is a plain jit on the default device
+    (SNIPPETS [3] `SingleDevicePartitioner`).  Semantically identical to
+    the mesh path — the contract the tests pin.
+
+    All instances compare equal: identity-keyed program caches (the GA's
+    `_ga_program`, the sweep's `_sweep_partitioned`) must not compile the
+    same program twice because one call site used `get_partitioner()` and
+    another the module default."""
+
+    def __eq__(self, other) -> bool:
+        return type(other) is SingleDevicePartitioner
+
+    def __hash__(self) -> int:
+        return hash(SingleDevicePartitioner)
+
+    @property
+    def device_count(self) -> int:
+        return 1
+
+    def population_sharding(self, ndim: int = 1):
+        return None
+
+    def shard_population(self, tree):
+        return tree
+
+    def population_eval(self, fn):
+        return jax.jit(fn)
+
+    def trial_devices(self) -> list:
+        return []
+
+
+class MeshPartitioner(Partitioner):
+    """Population axis sharded over one mesh axis; outputs all-gathered.
+
+    ``axis`` defaults to the mesh's first ("data") axis.  A 1-device mesh
+    is legal and must match SingleDevicePartitioner exactly — the shape
+    every code path is written in from the start (parallel/mesh.py)."""
+
+    def __init__(self, mesh: Mesh | None = None, axis: str | None = None):
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.axis = axis if axis is not None else self.mesh.axis_names[0]
+
+    @property
+    def device_count(self) -> int:
+        return int(self.mesh.shape[self.axis])
+
+    def population_sharding(self, ndim: int = 1):
+        return NamedSharding(self.mesh,
+                             P(self.axis, *([None] * (ndim - 1))))
+
+    def shard_population(self, tree):
+        """device_put every leaf split on its leading axis (leading sizes
+        must divide the axis — population_eval pads internally instead
+        when handed an un-shardable population)."""
+        def put(x):
+            return jax.device_put(x, self.population_sharding(np.ndim(x)))
+        return jax.tree.map(put, tree)
+
+    def population_eval(self, fn):
+        """``fn(pop_tree, *replicated) -> out_tree`` as a sharded program.
+
+        The population axis splits over ``self.axis``; ``replicated``
+        arguments are visible whole on every device; ``out_specs``
+        all-gathers every output's population axis (the ICI collective
+        that replaces the reference's "publish fitness to Redis",
+        SURVEY §2.7).  Ragged populations pad by repeating the last
+        member and slice back — the pad rows are masked out of every
+        result the caller sees."""
+        mesh, axis, n_dev = self.mesh, self.axis, self.device_count
+
+        def padded(pop_tree, *repl):
+            pop = int(jax.tree.leaves(pop_tree)[0].shape[0])
+            pad = (-pop) % n_dev
+
+            if pad:
+                pop_tree = jax.tree.map(
+                    lambda x: jnp.concatenate(
+                        [x, jnp.repeat(x[-1:], pad, axis=0)]), pop_tree)
+            sharded = _shard_map(
+                fn, mesh,
+                in_specs=(P(axis),) + (P(),) * len(repl),
+                out_specs=P(axis),
+            )
+            out = sharded(pop_tree, *repl)
+            if pad:
+                out = jax.tree.map(
+                    lambda x: x[:pop]
+                    if getattr(x, "ndim", 0) >= 1 and x.shape[0] == pop + pad
+                    else x, out)
+            return out
+
+        # jit at the seam: standalone callers get ONE compiled program per
+        # shape; inside an enclosing jit (the scanned GA) this inlines.
+        return jax.jit(padded)
+
+    def trial_devices(self) -> list:
+        return list(np.ravel(self.mesh.devices))
+
+
+@functools.lru_cache(maxsize=8)
+def _default_partitioner(n_devices: int) -> Partitioner:
+    if n_devices <= 1:
+        return SingleDevicePartitioner()
+    return MeshPartitioner(default_mesh())
+
+
+def get_partitioner(mesh: Mesh | None = None) -> Partitioner:
+    """The default seam: MeshPartitioner over the default (all-devices)
+    mesh when more than one device is visible, else the single-device
+    fallback.  Pass an explicit mesh to pin topology (tests, dryruns)."""
+    if mesh is not None:
+        if mesh.shape[mesh.axis_names[0]] <= 1:
+            return SingleDevicePartitioner()
+        return MeshPartitioner(mesh)
+    try:
+        n = jax.device_count()
+    except RuntimeError:       # backend not initializable (gate, docs jobs)
+        n = 1
+    return _default_partitioner(n)

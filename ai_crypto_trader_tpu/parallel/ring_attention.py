@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ai_crypto_trader_tpu.parallel.mesh import compat_shard_map
 
 NEG_BIG = -1e30   # finite stand-in for -inf: never produces NaN under exp/sub
 
@@ -123,8 +124,8 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = "data",
         out = o / jnp.maximum(l, 1e-30).T[..., None]
         return out.astype(q_blk.dtype)
 
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = compat_shard_map(local, mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
     sharding = NamedSharding(mesh, spec)
     return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
               jax.device_put(v, sharding))

@@ -31,6 +31,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ai_crypto_trader_tpu.ops.indicators import first_order_recursion
+from ai_crypto_trader_tpu.parallel.mesh import compat_shard_map
 
 
 def _carry_for_my_block(A, B, axis: str):
@@ -63,8 +64,8 @@ def sharded_first_order_recursion(a, b, mesh, axis: str = "data"):
         carry = _carry_for_my_block(prefix[-1], local_y[-1], axis)
         return local_y + carry * prefix
 
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = compat_shard_map(local, mesh, in_specs=(spec, spec),
+                       out_specs=spec)
     sharding = NamedSharding(mesh, spec)
     return fn(jax.device_put(a, sharding), jax.device_put(b, sharding))
 
@@ -115,6 +116,5 @@ def sharded_rolling_mean(x, window: int, mesh, axis: str = "data"):
         means = jnp.convolve(ext, kernel, mode="valid")
         return means
 
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec,), out_specs=spec,
-                       check_vma=False)
+    fn = compat_shard_map(local, mesh, in_specs=(spec,), out_specs=spec)
     return fn(jax.device_put(x, NamedSharding(mesh, spec)))

@@ -260,17 +260,24 @@ def build_full_stack(system, *, registry=None, llm=None,
             bus, symbols, now_fn=now_fn,
             **kw("nn", scorecard=getattr(system, "scorecard", None),
                  registry=registry)))
+    # Population-eval sharding for the evolver's GA and the generator's
+    # candidate pools (parallel/partitioner.py): every visible device on
+    # multi-chip hosts, single-device fallback on one chip.
+    from ai_crypto_trader_tpu.parallel import get_partitioner
+
+    partitioner = cadences.get("partitioner") or get_partitioner()
     if evolver:
         from ai_crypto_trader_tpu.config import EvolutionParams
 
         ev_cfg = cadences.get("evolution_cfg") or EvolutionParams()
         services.append(EvolverService(
             bus, StrategyEvolver(bus, cfg=ev_cfg, registry=registry,
-                                 now_fn=now_fn),
+                                 now_fn=now_fn, partitioner=partitioner),
             symbol=symbols[0], now_fn=now_fn, **kw("evolver")))
     if generator:
         services.append(GeneratorService(bus, symbols[0], registry=registry,
                                          llm=llm, now_fn=now_fn,
+                                         partitioner=partitioner,
                                          **kw("generator")))
     if grid_symbol:
         from ai_crypto_trader_tpu.strategy.grid_live import GridTraderService

@@ -42,6 +42,7 @@ from ai_crypto_trader_tpu.backtest.strategy import (
 )
 from ai_crypto_trader_tpu.config import EvolutionParams, GAParams
 from ai_crypto_trader_tpu.evolve import backtest_fitness, run_ga
+from ai_crypto_trader_tpu.parallel import get_partitioner
 from ai_crypto_trader_tpu.shell.bus import EventBus
 from ai_crypto_trader_tpu.shell.llm import LLMTrader
 from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
@@ -86,6 +87,11 @@ class StrategyEvolver:
     registry: ModelRegistry | None = None
     now_fn: any = time.time
     seed: int = 0
+    # Population-eval sharding seam (parallel/partitioner.py). None =
+    # resolve get_partitioner() lazily on first GA run, so the evolver
+    # stage uses every visible device without the launcher having to know
+    # about meshes.
+    partitioner: object | None = None
 
     def needs_improvement(self, metrics: dict) -> bool:
         """`_needs_improvement` (:1571-1582)."""
@@ -108,11 +114,17 @@ class StrategyEvolver:
 
     # --- optimization paths -------------------------------------------------
     def optimize_with_ga(self, ohlcv: dict, current: StrategyParams) -> tuple[StrategyParams, dict]:
-        """`optimize_with_genetic_algorithm` (:525-694) with real fitness."""
+        """`optimize_with_genetic_algorithm` (:525-694) with real fitness:
+        the whole GA is one compiled scan, population eval sharded over the
+        partitioner's mesh."""
+        if self.partitioner is None:
+            self.partitioner = get_partitioner()
         best, history = run_ga(jax.random.PRNGKey(self.seed),
                                backtest_fitness(ohlcv), self.cfg.ga,
-                               seed_params=current)
-        return best, {"method": "ga", "history": history}
+                               seed_params=current,
+                               partitioner=self.partitioner)
+        return best, {"method": "ga", "history": history,
+                      "devices": self.partitioner.device_count}
 
     def optimize_with_rl(self, ohlcv: dict, current: StrategyParams,
                          iterations: int = 20) -> tuple[StrategyParams, dict]:

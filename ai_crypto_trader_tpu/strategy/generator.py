@@ -29,6 +29,7 @@ sequential loop.
 
 from __future__ import annotations
 
+import functools
 import json
 from dataclasses import dataclass, field, replace
 
@@ -213,22 +214,45 @@ def _eval_batch(stack, close, volatility, avg_volume,
     return jax.vmap(one)(weights, buy_thr, sell_thr, sl, tp)
 
 
+@functools.lru_cache(maxsize=4)
+def _partitioned_eval(partitioner):
+    """One cached sharded structure evaluator per partitioner: the
+    candidate axis splits over the mesh data axis (pad + slice inside the
+    partitioner), the fold features ride replicated, and scores
+    all-gather — the same program `_eval_batch` compiles, sharded."""
+    return partitioner.population_eval(
+        lambda batch, fold: _eval_batch(
+            fold["stack"], fold["close"], fold["volatility"],
+            fold["avg_volume"], *batch))
+
+
 def evaluate_structures(folds: list[dict],
-                        structures: list[StrategyStructure]) -> np.ndarray:
+                        structures: list[StrategyStructure],
+                        partitioner=None) -> np.ndarray:
     """Mean across-fold Sharpe per structure (CV evaluation —
     `ai_strategy_evaluator.py:1360` batch evaluation, as one device batch
     per fold instead of one call per candidate). Structures that never
     trade score -inf: an empty backtest's Sharpe 0.0 must not outrank a
-    trading seed."""
+    trading seed.
+
+    ``partitioner`` (parallel/partitioner.py) shards the candidate batch
+    over the mesh data axis; None / single-device compiles the plain
+    vmapped program.  Scores are identical either way (mesh invariance,
+    tests/test_partitioner.py)."""
     W = jnp.asarray(np.stack([s.weight_vector() for s in structures]))
     buy = jnp.asarray([s.buy_threshold for s in structures], jnp.float32)
     sell = jnp.asarray([s.sell_threshold for s in structures], jnp.float32)
     sl = jnp.asarray([s.stop_loss for s in structures], jnp.float32)
     tp = jnp.asarray([s.take_profit for s in structures], jnp.float32)
+    sharded = (partitioner is not None
+               and getattr(partitioner, "device_count", 1) > 1)
     sharpes, trades = [], []
     for f in folds:
-        s, t = _eval_batch(f["stack"], f["close"], f["volatility"],
-                           f["avg_volume"], W, buy, sell, sl, tp)
+        if sharded:
+            s, t = _partitioned_eval(partitioner)((W, buy, sell, sl, tp), f)
+        else:
+            s, t = _eval_batch(f["stack"], f["close"], f["volatility"],
+                               f["avg_volume"], W, buy, sell, sl, tp)
         sharpes.append(np.asarray(s))
         trades.append(np.asarray(t))
     mean_sharpe = np.mean(sharpes, axis=0)
@@ -337,6 +361,9 @@ class StrategyGenerator:
     patience: int = 2
     min_improvement: float = 0.02
     seed: int = 0
+    # Candidate-batch sharding seam (parallel/partitioner.py); None =
+    # plain single-device vmap.
+    partitioner: object | None = None
     history: list = field(default_factory=list)
 
     async def generate(self, ohlcv: dict,
@@ -355,7 +382,8 @@ class StrategyGenerator:
         holdout_fold = [fold_features(holdout)]
 
         best = seed_structure or default_seed()
-        best_score = float(evaluate_structures(folds, [best])[0])
+        best_score = float(evaluate_structures(
+            folds, [best], partitioner=self.partitioner)[0])
         self.history = [{"round": 0, "structure": best.to_payload(),
                          "cv_sharpe": best_score, "source": "seed",
                          "adopted": True}]
@@ -390,7 +418,8 @@ class StrategyGenerator:
                 candidates += await proposer.propose(best, cv_record, rnd)
             while len(candidates) < self.pool_size:
                 candidates.append(mutate(rng, best, rnd))
-            scores = evaluate_structures(folds, candidates)
+            scores = evaluate_structures(folds, candidates,
+                                         partitioner=self.partitioner)
             top = int(np.argmax(scores))
             top_score = float(scores[top])
             adopted = top_score > best_score + self.min_improvement
@@ -411,7 +440,8 @@ class StrategyGenerator:
                 stall += 1
 
         seed_s = seed_structure or default_seed()
-        held = evaluate_structures(holdout_fold, [seed_s, best])
+        held = evaluate_structures(holdout_fold, [seed_s, best],
+                                   partitioner=self.partitioner)
         return {
             "structure": best,
             "cv_sharpe": best_score,
@@ -477,6 +507,7 @@ class GeneratorService:
     pool_size: int = 8
     max_rounds: int = 2
     seed: int = 0
+    partitioner: object | None = None   # parallel/partitioner.py seam
     now_fn: any = None
     name: str = "generator"
     current: StrategyStructure = field(default_factory=default_seed)
@@ -536,6 +567,7 @@ class GeneratorService:
         gen = StrategyGenerator(
             registry=self.registry, llm=self.llm, cv_folds=self.cv_folds,
             pool_size=self.pool_size, max_rounds=self.max_rounds,
+            partitioner=self.partitioner,
             # fresh search randomness each scheduled run — a fixed seed
             # would re-propose the identical rejected pool forever
             seed=self.seed + len(self.runs))

@@ -22,9 +22,18 @@ The other target rows print one JSON line each ahead of it:
                           records/s through ring + checksummed JSONL, and
                           % overhead on the fused tick path (recorder on
                           vs off — the ≤5% default-on budget)
+  population_sweep_candles_per_sec
+                          the headline sweep routed through the
+                          Partitioner seam (parallel/partitioner.py),
+                          device-count stamped
   ga_backtests_per_sec    GA generations with real backtest fitness
                           (`services/genetic_algorithm.py:119-133`'s
-                          sequential loop, as one device program/gen)
+                          sequential loop): the WHOLE run is one jitted
+                          lax.scan with period-table fitness; amortized
+                          steady-state throughput + per-generation ms,
+                          median-of-3 interleaved vs the retired Python
+                          loop driver, device-count stamped
+                          (BENCH_GA_T/POP/GENS scale knobs)
   rl_env_steps_per_sec    DQN train_iteration: 256 vmapped envs × 32 steps
                           + 4 replay-batch learns (`reinforcement_learning
                           .py:335-419`; the reference has no env at all)
@@ -154,7 +163,8 @@ def append_history(rows: list, path: str | None = None,
               "BENCH_RECOVERY_TRADES", "BENCH_STREAM_SYMBOLS",
               "BENCH_STREAM_TICKS", "BENCH_LOAD_TENANTS",
               "BENCH_LOAD_SYMBOLS", "BENCH_LOAD_TICKS",
-              "BENCH_LOAD_SLO_MS")
+              "BENCH_LOAD_SLO_MS",
+              "BENCH_GA_T", "BENCH_GA_POP", "BENCH_GA_GENS")
              if os.environ.get(k)}
     with open(path, "a", encoding="utf-8") as f:
         for row in rows:
@@ -211,10 +221,15 @@ def _gate_key(r: dict) -> tuple:
     """Rows are comparable only at the same device kind AND the same
     scale knobs (append_history stamps `scale` precisely because a
     BENCH_T=43200 run and a default-T run measure different things —
-    letting one gate the other would perma-fail CI on no regression)."""
+    letting one gate the other would perma-fail CI on no regression).
+    Device-COUNT-stamped rows (the sharded GA / population-sweep rows)
+    additionally key on the count: a 1-chip dev-host trajectory and an
+    8-chip pod trajectory are different curves of the same metric.  Rows
+    without the stamp read as 1 chip, so pre-stamp history keeps gating
+    single-device runs."""
     scale = r.get("scale") or {}
     return (r["metric"], r.get("device_kind", "unknown"),
-            tuple(sorted(scale.items())))
+            tuple(sorted(scale.items())), int(r.get("devices") or 1))
 
 
 def gate_history(rows: list, tolerance: float = GATE_TOLERANCE):
@@ -241,12 +256,14 @@ def gate_history(rows: list, tolerance: float = GATE_TOLERANCE):
                 best_prior[key] = r
     ok, report = True, []
     for key in sorted(latest):
-        metric, device_kind, scale = key
+        metric, device_kind, scale, devices = key
         row, best = latest[key], best_prior.get(key)
         rec = {"metric": metric, "device_kind": device_kind,
                "value": row["value"], "unit": row.get("unit")}
         if scale:
             rec["scale"] = dict(scale)
+        if devices != 1:
+            rec["devices"] = devices
         if best is None:
             rec.update(status="new")
         else:
@@ -1164,31 +1181,77 @@ def bench_flightrec():
 
 
 def bench_ga(arrays):
-    """BASELINE row: GA population sweep with REAL backtest fitness (the
-    reference's sequential evaluate loop, genetic_algorithm.py:119-133)."""
+    """BASELINE row: GA generations with REAL backtest fitness (the
+    reference's sequential evaluate loop, genetic_algorithm.py:119-133).
+
+    ISSUE 11 measurement contract: the headline value is the COMPILED-SCAN
+    amortized throughput — `run_ga` is one jitted lax.scan over
+    generations with the period-table fitness, so steady-state runs pay
+    zero re-trace and exactly one host sync.  The retired Python-loop
+    driver (`run_ga_legacy`, same fitness tables) runs INTERLEAVED with it
+    (median-of-3 each) so the scan-vs-loop speedup is measured on the same
+    thermal/cache state, and the per-generation cost rides the row."""
     import jax
 
     from ai_crypto_trader_tpu.config import GAParams
     from ai_crypto_trader_tpu.evolve import backtest_fitness, run_ga
+    from ai_crypto_trader_tpu.evolve.ga import run_ga_legacy
+    from ai_crypto_trader_tpu.parallel import get_partitioner
 
-    T_GA = 43_200                                  # 30 days of 1m candles
+    T_GA = int(os.environ.get("BENCH_GA_T", "43200"))  # 30 d of 1m candles
+    POP = int(os.environ.get("BENCH_GA_POP", "256"))
+    GENS = int(os.environ.get("BENCH_GA_GENS", "3"))
     ohlcv = {k: v[:T_GA] for k, v in arrays.items()}
-    cfg = GAParams(population_size=256, generations=3)
-    fitness = backtest_fitness(ohlcv)
+    cfg = GAParams(population_size=POP, generations=GENS)
+    fitness = backtest_fitness(ohlcv)        # ONE fitness (incl. tables)
+    partitioner = get_partitioner()
+    # ONE evaluator instance for every legacy run: run_ga_legacy's default
+    # builds a fresh jit wrapper per call, which re-traces+re-compiles the
+    # biggest program in the repo each iteration — that would make the
+    # legacy timings compile-dominated instead of measuring the driver.
+    from ai_crypto_trader_tpu.backtest.strategy import unstack_params
+
+    legacy_eval = jax.jit(
+        lambda g: jax.vmap(lambda row: fitness(unstack_params(row)))(g))
+
     t0 = time.perf_counter()
-    best, hist = run_ga(jax.random.PRNGKey(0), fitness, cfg)
+    run_ga(jax.random.PRNGKey(0), fitness, cfg, partitioner=partitioner)
     warm = time.perf_counter() - t0
     t0 = time.perf_counter()
-    best, hist = run_ga(jax.random.PRNGKey(1), fitness, cfg)
-    dt = time.perf_counter() - t0
-    n_backtests = cfg.population_size * (cfg.generations + 1)
-    log(f"GA: {cfg.generations} generations × pop {cfg.population_size} over "
-        f"{T_GA} candles: {dt:.2f}s steady ({warm:.1f}s with compile) → "
-        f"{n_backtests / dt:,.0f} full backtests/s")
+    run_ga_legacy(jax.random.PRNGKey(0), fitness, cfg, eval_fn=legacy_eval)
+    legacy_warm = time.perf_counter() - t0
+
+    scan_s, legacy_s = [], []
+    for i in range(3):                       # median-of-3, interleaved
+        t0 = time.perf_counter()
+        run_ga(jax.random.PRNGKey(1 + i), fitness, cfg,
+               partitioner=partitioner)
+        scan_s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_ga_legacy(jax.random.PRNGKey(1 + i), fitness, cfg,
+                      eval_fn=legacy_eval)
+        legacy_s.append(time.perf_counter() - t0)
+
+    dt = float(np.median(scan_s))
+    legacy_dt = float(np.median(legacy_s))
+    n_backtests = POP * (GENS + 1)           # initial eval + one per gen
+    per_gen_ms = dt * 1e3 / (GENS + 1)
+    log(f"GA: {GENS} generations × pop {POP} over {T_GA} candles "
+        f"(devices={partitioner.device_count}): scan {dt:.2f}s steady "
+        f"({warm:.1f}s with compile, {per_gen_ms:.0f} ms/generation) vs "
+        f"legacy loop {legacy_dt:.2f}s ({legacy_warm:.1f}s warm) → "
+        f"{n_backtests / dt:,.0f} full backtests/s, "
+        f"{legacy_dt / dt:.1f}x the loop driver")
     # reference: sequential fitness loop ≈ one scalar replay per individual;
     # measured reference loop throughput (BENCH headline) gives its rate:
     # ref_backtests/s = ref_candles_per_sec / T_GA — computed by caller
-    return n_backtests / dt, T_GA
+    return n_backtests / dt, T_GA, {
+        "devices": partitioner.device_count,
+        "population": POP, "generations": GENS,
+        "per_generation_ms": round(per_gen_ms, 3),
+        "legacy_driver_backtests_per_sec": round(n_backtests / legacy_dt, 3),
+        "speedup_vs_legacy_driver": round(legacy_dt / dt, 2),
+    }
 
 
 def run_worker():
@@ -1271,12 +1334,37 @@ def run_worker():
 
     def emit_headline():
         emit(HEADLINE_METRIC, candles_per_sec, "candles/s/chip",
-             round(candles_per_sec / ref_cps, 1), engine=engine)
+             round(candles_per_sec / ref_cps, 1), engine=engine,
+             devices=jax.device_count())
 
     # EARLY headline: a worker killed later (driver budget, flaky relay)
     # still leaves a parseable row in the captured output; the orchestrator
     # reorders it last.  It is re-emitted at the end with the final engine.
     emit_headline()
+
+    # population-sweep row through the Partitioner seam (ISSUE 11): the
+    # same sweep routed via get_partitioner() — single-device fallback on
+    # a 1-chip host, population sharded over the mesh data axis with
+    # results all-gathered on multi-chip.  Device-count-stamped so the
+    # trajectory stays legible when the same config runs on a pod slice.
+    try:
+        from ai_crypto_trader_tpu.parallel import get_partitioner
+
+        part = get_partitioner()
+        stats_p = sweep(inp, params, unroll=best_unroll, partitioner=part)
+        fetch(stats_p.final_balance)               # compile + first run
+        t0 = time.perf_counter()
+        stats_p = sweep(inp, params, unroll=best_unroll, partitioner=part)
+        fetch(stats_p.final_balance)
+        dt_p = time.perf_counter() - t0
+        log(f"population sweep via partitioner (devices="
+            f"{part.device_count}): {dt_p:.3f}s → "
+            f"{T*B/dt_p:,.0f} candles/s")
+        emit("population_sweep_candles_per_sec", T * B / dt_p, "candles/s",
+             None, engine="partitioner", devices=part.device_count,
+             population=B)
+    except Exception as e:               # noqa: BLE001 — bench must not die
+        log(f"population_sweep row unavailable ({type(e).__name__}: {e})")
 
     # Pallas replay kernel: VMEM-resident candle loop with no per-step XLA
     # dispatch (ops/pallas_backtest.py). TPU-only candidate; the scan path
@@ -1319,9 +1407,10 @@ def run_worker():
     # failure degrades to a log line, never kills the headline; each is
     # skipped when the worker budget is nearly spent) ----------------------
     def ga_row():
-        ga_rate, t_ga = bench_ga(arrays)
+        ga_rate, t_ga, extras = bench_ga(arrays)
         emit("ga_backtests_per_sec", ga_rate, "backtests/s",
-             round(ga_rate / (ref_cps / t_ga), 1))
+             round(ga_rate / (ref_cps / t_ga), 1), engine="scan_ga",
+             **extras)
 
     secondary = [
         ("tick", bench_tick),

@@ -17,8 +17,8 @@ from ai_crypto_trader_tpu.backtest import (
     run_backtest,
     sample_params,
     sweep,
-    sweep_sharded,
 )
+from ai_crypto_trader_tpu.parallel import MeshPartitioner
 
 # Slow tier (VERDICT r4 next#3): golden-parity / end-to-end /
 # training / sharded-compile suite — deselected by the default
@@ -209,7 +209,7 @@ class TestSweep:
         inp = _inputs(ohlcv, n=512)
         params = sample_params(jax.random.PRNGKey(1), 16)  # 2 per device
         plain = sweep(inp, params)
-        sharded = sweep_sharded(mesh8, inp, params)
+        sharded = sweep(inp, params, partitioner=MeshPartitioner(mesh8))
         np.testing.assert_allclose(np.asarray(plain.final_balance),
                                    np.asarray(sharded.final_balance), rtol=1e-5)
         np.testing.assert_array_equal(np.asarray(plain.total_trades),
@@ -219,7 +219,7 @@ class TestSweep:
         inp = _inputs(ohlcv, n=512)
         params = sample_params(jax.random.PRNGKey(2), 11)  # not divisible by 8
         plain = sweep(inp, params)
-        sharded = sweep_sharded(mesh8, inp, params)
+        sharded = sweep(inp, params, partitioner=MeshPartitioner(mesh8))
         assert sharded.final_balance.shape == (11,)
         np.testing.assert_allclose(np.asarray(plain.final_balance),
                                    np.asarray(sharded.final_balance), rtol=1e-5)

@@ -140,6 +140,24 @@ class TestGateLogic:
         assert not ok and report[0]["status"] == "REGRESSION"
         assert report[0]["scale"] == {"BENCH_T": "43200"}
 
+    def test_cross_device_count_rows_never_gate(self):
+        """An 8-chip GA trajectory must not become the bar for a 1-chip
+        dev-host run (device-COUNT stamp, ISSUE 11) — and rows without the
+        stamp keep gating devices=1 rows (pre-stamp history continuity)."""
+        rows = [
+            {"run_id": "r0", "metric": "ga_backtests_per_sec", "value": 1e4,
+             "unit": "backtests/s", "device_kind": "cpu", "devices": 8},
+            {"run_id": "r1", "metric": "ga_backtests_per_sec", "value": 100.0,
+             "unit": "backtests/s", "device_kind": "cpu", "devices": 1},
+        ]
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert ok and report[0]["status"] == "new"
+        # stampless prior row == devices 1: DOES gate the stamped 1-chip run
+        rows[0].pop("devices")
+        rows[0]["value"] = 1e4
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert not ok and report[0]["status"] == "REGRESSION"
+
     def test_best_prior_not_just_last(self):
         """The gate compares against the BEST prior row, so two
         successive small regressions cannot ratchet the bar down."""

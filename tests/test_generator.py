@@ -92,6 +92,24 @@ class TestEvaluation:
         finite = scores[np.isfinite(scores)]
         assert len(set(np.round(finite, 6))) > 1 or len(finite) <= 1
 
+    def test_partitioned_eval_matches_plain(self, folds, mesh8):
+        """The candidate pool sharded over the mesh data axis must score
+        bit-equal to the plain vmapped program — including a pool size
+        (3) the 8-device mesh pads + masks."""
+        from ai_crypto_trader_tpu.parallel import MeshPartitioner
+
+        structures = [
+            default_seed(),
+            StrategyStructure(rules=(("divergence_detector", 1.0),),
+                              buy_threshold=0.5),
+            StrategyStructure(rules=(("triple_moving_average", -1.0),),
+                              buy_threshold=0.1, sell_threshold=0.1),
+        ]
+        plain = evaluate_structures(folds, structures)
+        sharded = evaluate_structures(folds, structures,
+                                      partitioner=MeshPartitioner(mesh8))
+        np.testing.assert_array_equal(plain, sharded)
+
     def test_never_trading_structure_scores_neg_inf(self, folds):
         # direct construction skips from_payload clamping; a blend in
         # [-1, 1] can never reach a 2.0 threshold, so zero trades happen

@@ -128,6 +128,19 @@ class TestHPO:
         assert np.isfinite(out["best_val_loss"])
         assert out["best_params"]["model_type"] in MODEL_REGISTRY
 
+    def test_trials_farm_over_partitioner_devices(self, mesh8):
+        """HPO with a MeshPartitioner round-robins trial programs over the
+        mesh devices via jax.default_device — results stay valid and every
+        trial's arrays land on a real device."""
+        from ai_crypto_trader_tpu.parallel import MeshPartitioner
+
+        f = _features(150)
+        out = optimize_hyperparameters(
+            KEY, f, n_trials=2, rung_epochs=(1, 1), seq_len=16,
+            sampler="random", partitioner=MeshPartitioner(mesh8))
+        assert len(out["trials"]) == 2
+        assert np.isfinite(out["best_val_loss"])
+
     def test_tpe_sampler_concentrates_on_good_region(self):
         """Pure-sampler test (no training): on a synthetic objective whose
         optimum is (lr≈1e-3, dropout≈0.2, units=64), TPE proposals must land

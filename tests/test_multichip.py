@@ -1,0 +1,25 @@
+"""The multichip dryrun as a pytest (ISSUE 11 CI satellite).
+
+`__graft_entry__.dryrun_multichip` exercises the four sharded programs on
+an n-device mesh — dp×tp transformer training step, the Partitioner-
+routed GA population sweep, the sequence-parallel scan, and ring-attention
+training — and was previously only runnable by the driver (the
+MULTICHIP_r0*.json artifacts).  Promoted to the slow tier so the sharded
+paths rot loudly; skips cleanly when fewer than 8 devices are visible
+(conftest.py forces 8 virtual CPU devices, so the skip only fires outside
+the test harness)."""
+
+import jax
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_dryrun_multichip_8devices(capsys):
+    if jax.device_count() < 8:
+        pytest.skip("needs >= 8 devices (virtual CPU mesh)")
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
+    out = capsys.readouterr().out
+    assert "dryrun_multichip(8) OK" in out

@@ -1,0 +1,224 @@
+"""The Partitioner seam + the scanned-GA contract (tier-1).
+
+Pins the ISSUE 11 guarantees:
+  * partition rules: regex → PartitionSpec, scalars never partitioned,
+    uncovered leaves raise;
+  * population_eval: single-device fallback ≡ sharded results on a
+    1-device mesh AND an 8-device mesh, pad + mask for populations that
+    don't divide the device count;
+  * the scanned GA: bit-exact against the legacy Python-loop driver on
+    the same PRNGKey, ONE host_read per run, ZERO recompiles on a repeat
+    run (the regression guard), and a verified genome-buffer donation.
+
+Cheap deterministic fitness keeps this tier-1; the same contracts on the
+REAL backtest fitness live in the slow tier (tests/test_evolve.py).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ai_crypto_trader_tpu.backtest.strategy import (
+    _HIGHS,
+    _LOWS,
+    stack_params,
+)
+from ai_crypto_trader_tpu.config import GAParams
+from ai_crypto_trader_tpu.evolve import run_ga
+from ai_crypto_trader_tpu.evolve import ga as ga_mod
+from ai_crypto_trader_tpu.evolve.ga import run_ga_legacy
+from ai_crypto_trader_tpu.parallel import (
+    MeshPartitioner,
+    SingleDevicePartitioner,
+    get_partitioner,
+    make_mesh,
+    match_partition_rules,
+)
+from ai_crypto_trader_tpu.utils import devprof
+from ai_crypto_trader_tpu.utils.tracing import JitCompileMonitor
+
+
+def _cheap_fitness(p):
+    """Deterministic nontrivial fitness with NO backtest: distance of the
+    genome from a fixed target point, so the GA has a real gradient to
+    climb while the whole program compiles in well under a second."""
+    g = jnp.stack(list(p))
+    target = jnp.asarray((_LOWS + 0.75 * (_HIGHS - _LOWS)), jnp.float32)
+    span = jnp.asarray(_HIGHS - _LOWS, jnp.float32)
+    return -jnp.sum(((g - target) / span) ** 2)
+
+
+CFG = GAParams(population_size=8, generations=3, elite_size=2)
+
+
+class TestPartitionRules:
+    def test_regex_rules_and_scalar_passthrough(self):
+        tree = {"dense": {"kernel": np.ones((4, 8)), "bias": np.ones((8,))},
+                "scale": np.float32(2.0)}
+        specs = match_partition_rules(
+            [(r"kernel", P(None, "model")), (r".*", P())], tree)
+        assert specs["dense"]["kernel"] == P(None, "model")
+        assert specs["dense"]["bias"] == P()
+        assert specs["scale"] == P()          # scalars never partitioned
+
+    def test_uncovered_leaf_raises(self):
+        with pytest.raises(ValueError, match="no partition rule"):
+            match_partition_rules([(r"kernel", P())],
+                                  {"other": np.ones((3, 3))})
+
+
+class TestPopulationEval:
+    """population_eval over a toy per-member function: mesh invariance and
+    pad + mask."""
+
+    @staticmethod
+    def _fn(tree):
+        return {"sq": tree["x"] ** 2, "sum": jnp.sum(tree["x"], axis=-1)}
+
+    @staticmethod
+    def _fn_repl(tree, extra):
+        return tree["x"] * extra["scale"]
+
+    def test_single_device_fallback_matches_one_device_mesh(self):
+        x = {"x": jnp.arange(24.0).reshape(6, 4)}
+        single = SingleDevicePartitioner().population_eval(self._fn)(x)
+        mesh1 = make_mesh(data_parallel=1, model_parallel=1,
+                          devices=jax.devices()[:1])
+        onedev = MeshPartitioner(mesh1).population_eval(self._fn)(x)
+        for k in single:
+            np.testing.assert_array_equal(np.asarray(single[k]),
+                                          np.asarray(onedev[k]))
+
+    def test_pad_and_mask_uneven_population(self, mesh8):
+        # 10 members over 8 devices: pad to 16 inside, slice back to 10
+        x = {"x": jnp.arange(40.0).reshape(10, 4)}
+        plain = SingleDevicePartitioner().population_eval(self._fn)(x)
+        sharded = MeshPartitioner(mesh8).population_eval(self._fn)(x)
+        assert sharded["sq"].shape == (10, 4)
+        assert sharded["sum"].shape == (10,)
+        for k in plain:
+            np.testing.assert_array_equal(np.asarray(plain[k]),
+                                          np.asarray(sharded[k]))
+
+    def test_replicated_args_ride_whole(self, mesh8):
+        x = {"x": jnp.arange(16.0).reshape(8, 2)}
+        extra = {"scale": jnp.asarray(3.0)}
+        got = MeshPartitioner(mesh8).population_eval(self._fn_repl)(x, extra)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(x["x"]) * 3.0)
+
+    def test_get_partitioner_explicit_meshes(self, mesh8):
+        mesh1 = make_mesh(data_parallel=1, model_parallel=1,
+                          devices=jax.devices()[:1])
+        assert isinstance(get_partitioner(mesh1), SingleDevicePartitioner)
+        part = get_partitioner(mesh8)
+        assert isinstance(part, MeshPartitioner)
+        assert part.device_count == 8
+        assert len(part.trial_devices()) == 8
+
+    def test_shard_population_places_leading_axis(self, mesh8):
+        part = MeshPartitioner(mesh8)
+        tree = {"g": jnp.ones((16, 3))}
+        out = part.shard_population(tree)
+        assert len(out["g"].sharding.device_set) == 8
+
+
+class TestScannedGA:
+    def test_bit_exact_vs_legacy_loop(self):
+        b_scan, h_scan = run_ga(jax.random.PRNGKey(7), _cheap_fitness, CFG)
+        b_leg, h_leg = run_ga_legacy(jax.random.PRNGKey(7), _cheap_fitness,
+                                     CFG)
+        assert len(h_scan) == CFG.generations
+        for a, b in zip(b_scan, b_leg):
+            assert float(a) == float(b)
+        for ha, hb in zip(h_scan, h_leg):
+            assert ha["generation"] == hb["generation"]
+            assert ha["best_fitness"] == hb["best_fitness"]
+            # mean/diversity may differ by an f32 ULP: the scan fuses the
+            # reductions into one program, the legacy loop runs them as
+            # standalone eager reductions
+            np.testing.assert_allclose(ha["mean_fitness"],
+                                       hb["mean_fitness"],
+                                       rtol=2e-6, atol=1e-7)
+            np.testing.assert_allclose(ha["diversity"], hb["diversity"],
+                                       rtol=2e-6, atol=1e-7)
+
+    def test_seed_params_ride_individual_zero(self):
+        from ai_crypto_trader_tpu.backtest import default_params
+
+        b1, _ = run_ga(jax.random.PRNGKey(3), _cheap_fitness, CFG,
+                       seed_params=default_params())
+        b2, _ = run_ga_legacy(jax.random.PRNGKey(3), _cheap_fitness, CFG,
+                              seed_params=default_params())
+        for a, b in zip(b1, b2):
+            assert float(a) == float(b)
+
+    def test_one_dispatch_one_sync_zero_recompile(self, monkeypatch):
+        """THE regression guard: a repeat run with the same (fitness, cfg,
+        partitioner) must re-trace nothing and sync the host exactly once,
+        and the donated genome buffer must actually be consumed."""
+        def fitness(p):                     # fresh closure → fresh program
+            return _cheap_fitness(p)
+
+        dp = devprof.DevProf()
+        syncs = {"n": 0}
+        real_read = ga_mod.host_read
+
+        def counting_read(tree):
+            syncs["n"] += 1
+            return real_read(tree)
+
+        monkeypatch.setattr(ga_mod, "host_read", counting_read)
+        with devprof.use(dp):
+            run_ga(jax.random.PRNGKey(0), fitness, CFG)   # compile run
+            assert syncs["n"] == 1
+            card = dp.cards["ga_scan"]
+            assert card.error is None
+            assert card.flops > 0
+            assert card.donation_ok is True               # no silent copy
+
+            jit_mon = JitCompileMonitor.install()
+            before = jit_mon.sample()
+            _, hist = run_ga(jax.random.PRNGKey(1), fitness, CFG)
+            since = jit_mon.since(before)
+            assert since["compiles"] == 0, since          # zero recompiles
+            assert syncs["n"] == 2                        # ONE more sync
+        assert len(hist) == CFG.generations
+        assert all(np.isfinite(h["best_fitness"]) for h in hist)
+
+    def test_mesh_partitioned_ga_matches_single(self, mesh8):
+        fit = _cheap_fitness
+        b_single, h_single = run_ga(jax.random.PRNGKey(11), fit, CFG)
+        b_mesh, h_mesh = run_ga(jax.random.PRNGKey(11), fit, CFG,
+                                partitioner=MeshPartitioner(mesh8))
+        for a, b in zip(b_single, b_mesh):
+            assert float(a) == float(b)
+        for ha, hb in zip(h_single, h_mesh):
+            assert ha["best_fitness"] == hb["best_fitness"]
+
+    def test_elitism_monotone_best(self):
+        _, hist = run_ga(jax.random.PRNGKey(5), _cheap_fitness,
+                         GAParams(population_size=8, generations=5,
+                                  elite_size=2))
+        bf = [h["best_fitness"] for h in hist]
+        assert all(b2 >= b1 - 1e-6 for b1, b2 in zip(bf, bf[1:]))
+
+    def test_uneven_population_on_mesh(self, mesh8):
+        """pop 10 over 8 devices: the eval pads + masks inside the scan."""
+        cfg = GAParams(population_size=10, generations=2, elite_size=2)
+        b_mesh, h_mesh = run_ga(jax.random.PRNGKey(13), _cheap_fitness, cfg,
+                                partitioner=MeshPartitioner(mesh8))
+        b_single, _ = run_ga(jax.random.PRNGKey(13), _cheap_fitness, cfg)
+        assert len(h_mesh) == 2
+        for a, b in zip(b_mesh, b_single):
+            assert float(a) == float(b)
+
+
+class TestGenomeRoundTrip:
+    def test_stack_matches_genome_width(self):
+        from ai_crypto_trader_tpu.backtest import default_params
+
+        g = stack_params(default_params())
+        assert g.shape == (_LOWS.shape[0],)

@@ -13,7 +13,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from ai_crypto_trader_tpu.parallel import (
+from ai_crypto_trader_tpu.parallel.ring_attention import (
     reference_attention,
     ring_self_attention,
 )
