@@ -46,7 +46,7 @@ from jax import lax
 
 from ai_crypto_trader_tpu.backtest import signals as sig
 from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
-from ai_crypto_trader_tpu.utils import devprof, tracing
+from ai_crypto_trader_tpu.utils import devprof, meshprof, tracing
 
 
 def _traced_entry(name: str, close, attrs_fn, call):
@@ -403,7 +403,8 @@ def _sweep_partitioned(partitioner, kw_items: tuple):
     slice inside the partitioner (repeating the last individual)."""
     kw = dict(kw_items)
     return partitioner.population_eval(
-        lambda p_shard, inputs: _sweep_jit(inputs, p_shard, **kw))
+        lambda p_shard, inputs: _sweep_jit(inputs, p_shard, **kw),
+        name="population_sweep")
 
 
 def sweep(inputs: BacktestInputs, params: StrategyParams, *args,
@@ -437,9 +438,33 @@ def sweep(inputs: BacktestInputs, params: StrategyParams, *args,
         devprof.cost_card(card, card_fn, *card_args,
                           _memory_analysis=False,
                           **({} if sharded else kw))
+    if (meshprof.active() is not None
+            and not isinstance(inputs.close, jax.core.Tracer)):
+        # meshprof watch: compile attribution for the sweep dispatch.  A
+        # never-seen (population, window, settings, devices) combination
+        # compiles by design — mark its window cold so only an UNEXPECTED
+        # re-trace at a seen shape counts as a steady-state recompile.
+        shape_key = (card, int(jax.tree.leaves(params)[0].shape[0]),
+                     int(inputs.close.shape[-1]), args,
+                     tuple(sorted(kw.items())),
+                     getattr(partitioner, "device_count", 1))
+        cold = shape_key not in _SWEEP_SHAPES_SEEN
+        _SWEEP_SHAPES_SEEN.add(shape_key)
+        inner = call
+        call = lambda: _watched(card, cold, inner)  # noqa: E731
     return _traced_entry(
         "backtest.sweep", inputs.close,
         lambda: {"candles": int(inputs.close.shape[-1]),
                  "population": int(jax.tree.leaves(params)[0].shape[0]),
                  "devices": getattr(partitioner, "device_count", 1)},
         call)
+
+
+# (card, pop, T, args, kw, devices) combinations already dispatched once —
+# the sweep's cold-run ledger for the recompile sentinel
+_SWEEP_SHAPES_SEEN: set = set()
+
+
+def _watched(card: str, cold: bool, call):
+    with meshprof.watch(card, cold=cold):
+        return call()

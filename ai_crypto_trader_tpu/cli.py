@@ -305,6 +305,7 @@ def cmd_trade(args):
                            trace_jsonl=args.trace_jsonl,
                            journal_path=args.journal,
                            enable_devprof=args.devprof,
+                           enable_meshprof=args.meshprof,
                            flightrec_path=args.flightrec)
     if args.full_stack:
         from ai_crypto_trader_tpu.shell.stack import build_full_stack
@@ -497,6 +498,98 @@ def cmd_scan(args):
     print(json.dumps({"discovered": len(series), "ranked": ranked}))
 
 
+def _fetch_state(url: str) -> dict:
+    """One live-state fetch for the operator commands (`mesh`, `status`):
+    a running dashboard server's /state.json."""
+    import urllib.request
+
+    with urllib.request.urlopen(f"{url}/state.json", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def cmd_mesh(args):
+    """Mesh layout inspector (the mesh runtime observatory's REPL-free
+    surface, ISSUE 12): the active Partitioner layout (kind, mesh shape,
+    axis, device kinds), a per-device card for every visible chip (id,
+    kind, platform, allocator stats where the backend exposes them), and
+    the pad/mask arithmetic for a given population — the same numbers the
+    `mesh_pad_fraction` / `mesh_device_members` gauges publish.  With
+    `--url`, reads a LIVE system's `/state.json` mesh block (layout cards,
+    sentinel counters) instead of building a local partitioner."""
+    if args.url:
+        state = _fetch_state(args.url)
+        print(json.dumps(state.get("mesh", {"error": "no mesh block"}),
+                         indent=2, default=str))
+        return
+    import jax
+
+    from ai_crypto_trader_tpu.parallel import get_partitioner
+
+    part = get_partitioner()
+    desc = part.describe()
+    print(json.dumps({"partitioner": desc}, indent=2, default=str))
+    print(f"\n{'id':>4} {'kind':<16} {'platform':<10} {'memory':<16} role")
+    trial_devs = {str(d) for d in part.trial_devices()}
+    for d in jax.devices():
+        stats = ""
+        try:
+            ms = d.memory_stats()
+            if ms:
+                stats = f"{ms.get('bytes_in_use', 0):,}B in use"
+        except Exception:              # noqa: BLE001 — CPU backends
+            pass                       # expose no allocator stats
+        role = "trial farm" if str(d) in trial_devs else "default"
+        print(f"{d.id:>4} {str(getattr(d, 'device_kind', d.platform)):<16} "
+              f"{d.platform:<10} {stats:<16} {role}")
+    n = part.device_count
+    pad = (-args.pop) % n
+    padded = args.pop + pad
+    print(f"\npopulation {args.pop} on {n} device(s): "
+          f"pad {pad} → {padded} lanes "
+          f"({padded // n}/device), pad_fraction "
+          f"{pad / padded if padded else 0.0:.4f}"
+          + (" — MeshPaddingWasteHigh would fire"
+             if padded and pad / padded > 0.25 else ""))
+
+
+def cmd_status(args):
+    """Operator status without a REPL (ISSUE 12 satellite): queries a live
+    dashboard server's `/state.json` and prints a compact summary — the
+    active mesh/partitioner layout, portfolio, alerts, capacity bottleneck
+    and (when the observatories are on) devprof/meshprof headlines.
+    Without `--url` it reports the LOCAL process view: the partitioner
+    layout `get_partitioner()` would serve this host."""
+    if not args.url:
+        from ai_crypto_trader_tpu.parallel import get_partitioner
+
+        print(json.dumps({"live": False,
+                          "partitioner": get_partitioner().describe()},
+                         indent=2, default=str))
+        print("(no --url given: showing the local partitioner layout; "
+              "point --url at a running `trade --serve` for live state)")
+        return
+    state = _fetch_state(args.url)
+    status = state.get("status", {})
+    out = {
+        "live": True,
+        "portfolio_value_usd": status.get("portfolio_value_usd"),
+        "open_trades": len(status.get("active_trades", {})),
+        "closed_trades": status.get("closed_trades"),
+        "total_pnl": status.get("total_pnl"),
+        "alerts": status.get("alerts", []),
+    }
+    if "mesh" in state:
+        out["mesh"] = state["mesh"]
+    cap = state.get("capacity")
+    if cap:
+        out["bottleneck_stage"] = cap.get("bottleneck_stage")
+    dev = state.get("devprof")
+    if dev:
+        out["slo_burn_rates"] = dev.get("burn_rates")
+        out["donation_failures"] = dev.get("donation_failures")
+    print(json.dumps(out, indent=2, default=str))
+
+
 def cmd_registry(args):
     """Model-registry operations (`run_ai_model_services.py` surface)."""
     from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
@@ -610,6 +703,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="persist the decision-provenance flight recorder "
                          "(obs/flightrec.py) as checksummed JSONL to PATH "
                          "— queryable offline via `why --file PATH`")
+    sp.add_argument("--meshprof", action="store_true",
+                    help="mesh runtime observatory (utils/meshprof.py): "
+                         "recompile/transfer sentinels on the hot "
+                         "dispatches, sharded-program layout cards, "
+                         "per-device memory-imbalance gauges")
     sp.set_defaults(fn=cmd_trade)
     sp = sub.add_parser("why", help="decision provenance for a symbol "
                                     "(flight-recorder query)")
@@ -655,6 +753,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--top", type=int, default=10)
     sp.add_argument("--seed", type=int, default=0)
     sp.set_defaults(fn=cmd_scan)
+    sp = sub.add_parser("mesh", help="partitioner layout + per-device "
+                                     "cards (mesh runtime observatory)")
+    sp.add_argument("--pop", type=int, default=256,
+                    help="population size for the pad/mask arithmetic")
+    sp.add_argument("--url", default=None,
+                    help="read a live system's /state.json mesh block "
+                         "instead (e.g. http://127.0.0.1:8050)")
+    sp.set_defaults(fn=cmd_mesh)
+    sp = sub.add_parser("status", help="operator summary from a live "
+                                       "dashboard server (/state.json)")
+    sp.add_argument("--url", default=None,
+                    help="dashboard server base URL "
+                         "(e.g. http://127.0.0.1:8050)")
+    sp.set_defaults(fn=cmd_status)
     sp = sub.add_parser("registry", help="inspect the model registry")
     sp.add_argument("--path", default="models/registry.json")
     sp.add_argument("--kind", default="strategy_params")
@@ -668,7 +780,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _JAX_COMMANDS = {"backtest", "train", "evolve", "mc", "trade", "dashboard",
-                 "scan", "profile", "load"}
+                 "scan", "profile", "load", "mesh"}
 
 
 def main(argv=None):

@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from ai_crypto_trader_tpu.models.train import train_model
+from ai_crypto_trader_tpu.utils import meshprof
 
 SEARCH_SPACE = {
     # neural_network_service.py:604-640 (Optuna suggest_* calls)
@@ -189,7 +190,12 @@ def optimize_hyperparameters(
                 epochs=epochs, early_stopping_patience=patience,
                 target_col=target_col, precision=precision)
         if devices:
-            with jax.default_device(devices[i % len(devices)]):
+            dev = devices[i % len(devices)]
+            # per-device trial accounting (utils/meshprof.py): the
+            # round-robin farm's assignment skew becomes a counted
+            # mesh_trial_assignments_total{device=} series
+            meshprof.record_trial(dev)
+            with jax.default_device(dev):
                 return go()
         return go()
 

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ai_crypto_trader_tpu.utils import devprof
+from ai_crypto_trader_tpu.utils import devprof, meshprof
 
 _PRECISIONS = {
     # None = backend default (f32 on CPU; the MXU's default mode on TPU).
@@ -79,7 +79,8 @@ def host_read(x) -> np.ndarray:
     readback blocks on the whole epoch program, so its latency IS the
     device-side epoch time as seen from the host."""
     t0 = time.perf_counter()
-    out = np.asarray(x)
+    with meshprof.allow_transfers():   # THE sanctioned device→host sync
+        out = np.asarray(x)
     devprof.observe_latency("host_read", time.perf_counter() - t0)
     return out
 
@@ -158,6 +159,10 @@ class EpochTrainer:
 
         self._epoch = jax.jit(_epoch, static_argnames=("batch_size",),
                               donate_argnums=(0, 1))
+        # (shapes, batch_size) combinations this trainer has dispatched —
+        # the recompile sentinel's cold ledger (a fresh trainer/shape
+        # compiles by design; a re-trace at a seen shape pages)
+        self._watched_shapes: set = set()
 
     def epoch(self, params, opt_state, X, y, k_perm, k_drop,
               X_val=None, y_val=None, *, batch_size: int):
@@ -179,8 +184,16 @@ class EpochTrainer:
                                   batch_size=batch_size)
             # t0 AFTER carding: the card's duplicate AOT lowering/compile
             # must not pollute the train_step SLO window
+            cold = True
+            if meshprof.active() is not None:   # default-OFF discipline
+                shape_key = (X.shape, y.shape,
+                             (X_val.shape if X_val is not None else None),
+                             batch_size)
+                cold = shape_key not in self._watched_shapes
+                self._watched_shapes.add(shape_key)
             t0 = time.perf_counter()
-            out = self._epoch(*args, batch_size=batch_size)
+            with meshprof.watch(self.card, cold=cold):
+                out = self._epoch(*args, batch_size=batch_size)
         if dp is not None:
             nb = max(X.shape[0] // min(batch_size, X.shape[0]), 1)
             dp.observe_latency("train_step",

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ai_crypto_trader_tpu import ops
-from ai_crypto_trader_tpu.utils import devprof
+from ai_crypto_trader_tpu.utils import devprof, meshprof
 from ai_crypto_trader_tpu.backtest import compute_signal_features, reference_signal
 from ai_crypto_trader_tpu.obs.drift import DRIFT_FEATURES, N_BINS, PSI_EPS
 from ai_crypto_trader_tpu.ops.combinations import (
@@ -62,7 +62,8 @@ def host_read(tree):
     is timed into the ``host_read`` SLO window (utils/devprof.py) — sync
     time is where a device-queue stall first becomes visible."""
     t0 = time.perf_counter()
-    out = jax.device_get(tree)
+    with meshprof.allow_transfers():   # THE sanctioned device→host sync
+        out = jax.device_get(tree)
     devprof.observe_latency("host_read", time.perf_counter() - t0)
     return out
 
@@ -377,16 +378,38 @@ class TickEngine:
                               self._base, rows, s_ix, f_ix, pos, valid,
                               self._drift_ref)
         donated_ring = self._ring if carding else None
-        self._ring, out = _tick_program(self._ring, self._base, rows, s_ix,
-                                        f_ix, pos, valid, self._drift_ref)
-        if donated_ring is not None:
-            devprof.verify_donation("tick_engine", donated_ring)
-        self.dispatch_count += 1
-        self._need_seed = False
-        self.last_valid = valid
-        t_hr = time.perf_counter()
-        host = host_read(out)
-        host_read_s = time.perf_counter() - t_hr
+        # meshprof watch window (utils/meshprof.py; disabled = one module
+        # check): attributes any compile during this dispatch to
+        # "tick_engine" — a compile after warmup is a counted steady-state
+        # recompile + SteadyStateRecompile alert — and arms the
+        # device→host transfer guard from dispatch through the sanctioned
+        # host_read, so a stray host pull on the fused path becomes a
+        # counted gauge instead of invisible latency.  A fresh engine's
+        # FIRST dispatch is cold: the monitor rebuilds the engine when the
+        # universe/window changes (each is a compiled-shape input by
+        # design), and the sentinel's window count is global across
+        # instances — within one engine the array shapes are fixed, so any
+        # later compile is genuinely unexpected.
+        try:
+            with meshprof.watch("tick_engine", cold=self.dispatch_count == 0):
+                self._ring, out = _tick_program(self._ring, self._base,
+                                                rows, s_ix, f_ix, pos,
+                                                valid, self._drift_ref)
+                if donated_ring is not None:
+                    devprof.verify_donation("tick_engine", donated_ring)
+                self.dispatch_count += 1
+                self._need_seed = False
+                self.last_valid = valid
+                t_hr = time.perf_counter()
+                host = host_read(out)
+                host_read_s = time.perf_counter() - t_hr
+        except Exception:
+            # a mid-step abort (counted guard violation, XLA runtime
+            # error) leaves the donated device ring in an unknown state;
+            # the host mirror is authoritative, so the next step re-seeds
+            # — a transfer, never a compile
+            self._need_seed = True
+            raise
         # drift outputs ride the same readback; pop them into last_drift so
         # the published feature payload (and the fused↔per-symbol parity
         # contract) is unchanged.  PSI is only meaningful where a reference

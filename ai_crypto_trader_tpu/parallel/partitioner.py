@@ -51,6 +51,7 @@ from ai_crypto_trader_tpu.parallel.mesh import (
     compat_shard_map as _shard_map,
     default_mesh,
 )
+from ai_crypto_trader_tpu.utils import meshprof
 
 
 def _path_name(path) -> str:
@@ -104,14 +105,31 @@ class Partitioner:
     def shard_population(self, tree):
         raise NotImplementedError
 
-    def population_eval(self, fn):
+    def population_eval(self, fn, name: str | None = None):
+        """``name`` registers the program with the mesh observatory
+        (utils/meshprof.py): its pad/mask layout and all-gather byte
+        volume are recorded at trace time under that program name."""
         raise NotImplementedError
 
     def trial_devices(self) -> list:
         raise NotImplementedError
 
+    def _device_list(self) -> list:
+        return [jax.devices()[0]]
+
     def describe(self) -> dict:
-        return {"kind": type(self).__name__, "devices": self.device_count}
+        """Operator-facing layout summary (/state.json `mesh` block,
+        `cli mesh` / `cli status`): partitioner kind, device count and
+        the concrete device kinds behind it."""
+        out = {"kind": type(self).__name__, "devices": self.device_count}
+        try:
+            devs = self._device_list()
+            out["device_kinds"] = sorted(
+                {str(getattr(d, "device_kind", d.platform)) for d in devs})
+            out["platform"] = devs[0].platform if devs else None
+        except Exception:            # noqa: BLE001 — backend uninitialized
+            pass                     # (gate/docs jobs): layout-only answer
+        return out
 
 
 class SingleDevicePartitioner(Partitioner):
@@ -140,8 +158,21 @@ class SingleDevicePartitioner(Partitioner):
     def shard_population(self, tree):
         return tree
 
-    def population_eval(self, fn):
-        return jax.jit(fn)
+    def population_eval(self, fn, name: str | None = None):
+        if name is None:
+            return jax.jit(fn)
+
+        def named(pop_tree, *repl):
+            # trace-time layout card (once per compiled shape): pad 0,
+            # one device — the 1-chip end of the same trajectory the
+            # mesh rows stamp, so bench/state views never have holes
+            out = fn(pop_tree, *repl)
+            meshprof.record_population_layout(
+                name, population=int(jax.tree.leaves(pop_tree)[0].shape[0]),
+                pad=0, devices=1, out_tree=out)
+            return out
+
+        return jax.jit(named)
 
     def trial_devices(self) -> list:
         return []
@@ -174,7 +205,7 @@ class MeshPartitioner(Partitioner):
             return jax.device_put(x, self.population_sharding(np.ndim(x)))
         return jax.tree.map(put, tree)
 
-    def population_eval(self, fn):
+    def population_eval(self, fn, name: str | None = None):
         """``fn(pop_tree, *replicated) -> out_tree`` as a sharded program.
 
         The population axis splits over ``self.axis``; ``replicated``
@@ -183,8 +214,11 @@ class MeshPartitioner(Partitioner):
         that replaces the reference's "publish fitness to Redis",
         SURVEY §2.7).  Ragged populations pad by repeating the last
         member and slice back — the pad rows are masked out of every
-        result the caller sees."""
+        result the caller sees.  ``name`` publishes the layout (pad
+        fraction, per-device members, all-gather bytes) to the mesh
+        observatory at trace time — once per compiled shape."""
         mesh, axis, n_dev = self.mesh, self.axis, self.device_count
+        dev_names = tuple(str(d) for d in np.ravel(mesh.devices))
 
         def padded(pop_tree, *repl):
             pop = int(jax.tree.leaves(pop_tree)[0].shape[0])
@@ -200,6 +234,12 @@ class MeshPartitioner(Partitioner):
                 out_specs=P(axis),
             )
             out = sharded(pop_tree, *repl)
+            if name is not None:
+                # trace-time (once per compiled shape): out leaves are
+                # tracers — only shapes/dtypes are read
+                meshprof.record_population_layout(
+                    name, population=pop, pad=pad, devices=n_dev,
+                    out_tree=out, device_names=dev_names)
             if pad:
                 out = jax.tree.map(
                     lambda x: x[:pop]
@@ -213,6 +253,17 @@ class MeshPartitioner(Partitioner):
 
     def trial_devices(self) -> list:
         return list(np.ravel(self.mesh.devices))
+
+    def _device_list(self) -> list:
+        return list(np.ravel(self.mesh.devices))
+
+    def describe(self) -> dict:
+        out = super().describe()
+        out["axis"] = self.axis
+        out["mesh_shape"] = {str(a): int(self.mesh.shape[a])
+                             for a in self.mesh.axis_names}
+        out["device_names"] = [str(d) for d in np.ravel(self.mesh.devices)]
+        return out
 
 
 @functools.lru_cache(maxsize=8)

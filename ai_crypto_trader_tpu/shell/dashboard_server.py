@@ -260,6 +260,23 @@ class DashboardServer:
         flightrec = getattr(system, "flightrec", None)
         if flightrec is not None:
             out["flightrec"] = flightrec.status()
+        # mesh runtime (utils/meshprof.py + parallel/partitioner.py): the
+        # active partitioner layout is surfaced even when the observatory
+        # is off — operators must be able to see mesh shape / device
+        # kinds without a REPL (ISSUE 12 satellite) — and the sentinel /
+        # layout-card state rides along when meshprof is enabled.
+        mesh_block = {}
+        try:
+            from ai_crypto_trader_tpu.parallel import get_partitioner
+
+            mesh_block["partitioner"] = get_partitioner().describe()
+        except Exception:                      # noqa: BLE001 — backend
+            pass                               # unavailable: sentinel-only
+        meshprof = getattr(system, "meshprof", None)
+        if meshprof is not None:
+            mesh_block.update(meshprof.status())
+        if mesh_block:
+            out["mesh"] = mesh_block
         saturation = getattr(system, "saturation", None)
         if saturation is not None:
             # load & capacity observatory (utils/saturation.py): stage
@@ -286,7 +303,11 @@ class DashboardServer:
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        if self._thread is not None:
+            # shutdown() handshakes with serve_forever's loop — calling it
+            # on a server that was never start()ed blocks forever on the
+            # __is_shut_down event nothing will ever set
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)

@@ -28,6 +28,7 @@ from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
 from ai_crypto_trader_tpu.shell.executor import TradeExecutor
 from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
 from ai_crypto_trader_tpu.utils import devprof as devprof_mod
+from ai_crypto_trader_tpu.utils import meshprof as meshprof_mod
 from ai_crypto_trader_tpu.utils import tracing
 from ai_crypto_trader_tpu.utils.alerts import AlertManager
 from ai_crypto_trader_tpu.utils.health import EventLoopLagProbe, HeartbeatRegistry
@@ -63,6 +64,16 @@ class TradingSystem:
     # p50/p99/burn-rate latency SLO gauges for tick / train_step /
     # host_read.
     enable_devprof: bool = False
+    # Mesh runtime observatory (utils/meshprof.py). Default OFF like
+    # tracing/devprof (disabled hot path = one module-global check).
+    # When on: recompile sentinel windows around every carded hot
+    # dispatch (a steady-state re-trace of the tick engine / GA / sweeps
+    # becomes a counted mesh_steady_recompiles_total + alert), transfer
+    # guards on the fused tick and GA paths (an unsanctioned device→host
+    # pull is counted, not silently paid), sharded-program layout cards
+    # (pad fraction, per-device members, all-gather bytes) and the
+    # per-device memory-imbalance fold sampled each tick.
+    enable_meshprof: bool = False
     # Crash-safe trading state (utils/journal.py): when set, the executor
     # write-ahead-journals every order intent/ack/closure here, and
     # `recover()` replays + reconciles it after a restart.
@@ -137,6 +148,10 @@ class TradingSystem:
         if self.enable_devprof:
             self.devprof = devprof_mod.configure(
                 devprof_mod.DevProf(metrics=self.metrics))
+        self.meshprof = None
+        if self.enable_meshprof:
+            self.meshprof = meshprof_mod.configure(
+                meshprof_mod.MeshProf(metrics=self.metrics))
         # bus telemetry: fanout latency + queue depth metrics, and slow-
         # subscriber warnings through the structured log (trace-correlated)
         self.bus = EventBus(now_fn=self.now_fn, metrics=self.metrics,
@@ -590,12 +605,18 @@ class TradingSystem:
         for service, age in self.heartbeats.staleness().items():
             self.metrics.set_gauge("heartbeat_staleness_seconds", age,
                                    service=service)
+        mem_sample = None
         if self.devprof is not None:
             # SLO p50/p99 + burn-rate gauges, and the per-device
             # live-buffer watermark sample — on BOTH tick paths, so a
             # latency burn or HBM leak is visible during outages too
             self.devprof.export()
-            self.devprof.sample_memory()
+            mem_sample = self.devprof.sample_memory()
+        if self.meshprof is not None:
+            # mesh observatory export: per-device memory-imbalance fold
+            # (reusing devprof's sample when it ran this tick — one
+            # jax.live_arrays() walk, not two) + byte-split refresh
+            self.meshprof.export(memory=mem_sample)
         self.metrics.set_gauge("last_market_update_timestamp",
                                self._last_market_update)
         self.metrics.set_gauge("max_positions",
@@ -668,6 +689,10 @@ class TradingSystem:
         if self.devprof is not None:
             state["slo_burn_rates"] = self.devprof.burn_rates()
             state["donation_failures"] = list(self.devprof.donation_failures)
+        if self.meshprof is not None:
+            # mesh observatory inputs: steady-state recompiles on hot
+            # programs, guarded host transfers, pad waste, memory skew
+            state.update(self.meshprof.alert_state())
         if self.stream is not None:
             # degrade-to-poll visibility: the in-process rule engine's
             # StreamDegradedToPoll input (PromQL twin: stream_mode == 0)
@@ -781,6 +806,9 @@ class TradingSystem:
                 and devprof_mod.active() is self.devprof):
             devprof_mod.disable()          # a later system's devprof is
             #                                left alone (tracer pattern)
+        if (self.meshprof is not None
+                and meshprof_mod.active() is self.meshprof):
+            meshprof_mod.disable()
         if self.journal is not None:
             self.journal.close()           # flush the buffered tail
         if self.flightrec is not None:

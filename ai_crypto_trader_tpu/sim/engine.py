@@ -42,7 +42,11 @@ import numpy as np
 
 from ai_crypto_trader_tpu.sim import exchange as sx
 from ai_crypto_trader_tpu.sim import paths, scenarios
-from ai_crypto_trader_tpu.utils import devprof
+from ai_crypto_trader_tpu.utils import devprof, meshprof
+
+# (scenarios, steps, log_capacity) shapes already dispatched once — the
+# sim sweep's cold-run ledger for the recompile sentinel
+_SWEEP_SHAPES_SEEN: set = set()
 
 # slot layout the strategy uses (and the parity oracle mirrors): the stop
 # is placed first so FakeExchange's insertion-ordered matching walks the
@@ -56,7 +60,8 @@ def host_read(tree):
     """THE per-sweep device→host sync (module seam so tests can count it;
     the tick-engine pattern).  Timed into the `host_read` SLO window."""
     t0 = time.perf_counter()
-    out = jax.device_get(tree)
+    with meshprof.allow_transfers():   # THE sanctioned device→host sync
+        out = jax.device_get(tree)
     devprof.observe_latency("host_read", time.perf_counter() - t0)
     return out
 
@@ -336,18 +341,31 @@ def sweep(key, scenario="mixed", num_scenarios: int = 4096,
                           fp, pp, quote0, log_capacity=log_capacity,
                           _memory_analysis=False)
     donated = list(sched_dev.values()) if carding else None
+    # meshprof watch: compile attribution + transfer guard across dispatch
+    # and the one sanctioned host_read.  A never-seen (B, steps, capacity)
+    # shape compiles by design (scale knobs) — cold; pathology is array
+    # CONTENT (sim/scenarios.py), so preset changes at a seen shape that
+    # re-trace are exactly the regression the sentinel pages on.
+    cold = True
+    if meshprof.active() is not None:       # default-OFF discipline
+        shape_key = (int(sched.num_scenarios), int(sched.steps),
+                     int(log_capacity))
+        cold = shape_key not in _SWEEP_SHAPES_SEEN
+        _SWEEP_SHAPES_SEEN.add(shape_key)
     t0 = time.perf_counter()
-    out = _sweep_jit(key, sched_dev, strat, fp, pp, quote0,
-                     log_capacity=log_capacity)
-    if donated is not None:
-        devprof.verify_donation("sim_sweep", donated)
-    # ONE [B]-sized host readback: candles / equity curves / fill logs stay
-    # device-resident under "device" (fetch on demand; at 10k × 1k they are
-    # the donated-buffer reuse, not something to drag over the host link)
-    fetch = {"summary": out["summary"]}
-    if return_fills:
-        fetch["fills"] = out["fills"]
-    host = host_read(fetch)
+    with meshprof.watch("sim_sweep", cold=cold):
+        out = _sweep_jit(key, sched_dev, strat, fp, pp, quote0,
+                         log_capacity=log_capacity)
+        if donated is not None:
+            devprof.verify_donation("sim_sweep", donated)
+        # ONE [B]-sized host readback: candles / equity curves / fill logs
+        # stay device-resident under "device" (fetch on demand; at 10k×1k
+        # they are the donated-buffer reuse, not something to drag over
+        # the host link)
+        fetch = {"summary": out["summary"]}
+        if return_fills:
+            fetch["fills"] = out["fills"]
+        host = host_read(fetch)
     wall = time.perf_counter() - t0
     devprof.observe_latency("sim_sweep", wall)
     host["device"] = {"candles": out["candles"],

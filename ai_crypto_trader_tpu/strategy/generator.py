@@ -223,7 +223,8 @@ def _partitioned_eval(partitioner):
     return partitioner.population_eval(
         lambda batch, fold: _eval_batch(
             fold["stack"], fold["close"], fold["volatility"],
-            fold["avg_volume"], *batch))
+            fold["avg_volume"], *batch),
+        name="structure_pool")
 
 
 def evaluate_structures(folds: list[dict],

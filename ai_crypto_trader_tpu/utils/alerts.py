@@ -105,6 +105,31 @@ def default_rules() -> list[AlertRule]:
                   lambda s: bool(s.get("donation_failures")),
                   "a donated input buffer survived its dispatch "
                   "(XLA fell back to a silent copy — doubles HBM)"),
+        # --- mesh runtime observatory (utils/meshprof.py) ---
+        # the recompile sentinel attributes jax.monitoring compile events
+        # to named hot programs via watch windows; a compile AFTER a
+        # program's warmup window (and not marked cold by the caller) is a
+        # steady-state re-trace — the zero-recompile contract tests as a
+        # live invariant.  The PromQL twins ride the mesh_* counters.
+        AlertRule("SteadyStateRecompile", "warning",
+                  lambda s: bool(s.get("steady_recompile_programs")),
+                  "a carded hot program re-traced after warmup (shape "
+                  "churn on the fused tick / GA / sweep paths)"),
+        AlertRule("UnintendedHostTransfer", "warning",
+                  lambda s: bool(s.get("guarded_transfer_programs")),
+                  "a guarded dispatch pulled device data to the host "
+                  "outside the sanctioned host_read seam"),
+        AlertRule("MeshPaddingWasteHigh", "info",
+                  lambda s: (s.get("mesh_pad_fraction_max", 0.0)
+                             > s.get("mesh_pad_waste_threshold", 0.25)),
+                  "a sharded program pads away more than a quarter of its "
+                  "mesh lanes (ragged population vs device count)"),
+        AlertRule("DeviceMemoryImbalance", "warning",
+                  lambda s: (s.get("mesh_devices", 1) > 1
+                             and s.get("mesh_memory_imbalance", 0.0)
+                             > s.get("mesh_imbalance_threshold", 2.0)),
+                  "one device holds more than its fair share of live "
+                  "buffers (max/mean bytes skew across the mesh)"),
         # --- trading-quality observatory (obs/) ---
         # PSI > 0.25 is the classic "significant shift" reading; the
         # feature histograms come out of the fused tick dispatch itself

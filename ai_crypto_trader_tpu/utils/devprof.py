@@ -137,6 +137,10 @@ class MemoryWatermark:
     def __init__(self):
         self.peak_bytes: dict[str, int] = {}
         self.peak_count: dict[str, int] = {}
+        # newest sample, kept so downstream consumers (the meshprof
+        # imbalance fold) can read per-device state without re-walking
+        # jax.live_arrays() a second time in the same tick
+        self.last: dict = {}
 
     def sample(self, metrics=None) -> dict:
         import jax
@@ -165,6 +169,7 @@ class MemoryWatermark:
                 metrics.set_gauge("live_buffer_bytes", nbytes, device=dev)
                 metrics.set_gauge("live_buffer_bytes_peak",
                                   self.peak_bytes[dev], device=dev)
+        self.last = out
         return out
 
 

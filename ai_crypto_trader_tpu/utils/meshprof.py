@@ -1,0 +1,530 @@
+"""Mesh runtime observatory: recompile/transfer sentinels and
+padding/collective accounting for sharded programs.
+
+PR 11 turned the GA, backtest sweep, structure pool, and HPO trials into
+sharded programs behind the `Partitioner` seam — and left the fleet axis
+a telemetry blind spot: nothing reported per-device skew, pad+mask waste,
+steady-state recompiles, or silent host transfers.  Podracer (arXiv:
+2104.06272) and FinRL-Podracer (arXiv:2111.05188) attribute their scaling
+wins to exactly this per-device utilization/locality accounting.  Four
+instruments, one module — the fifth observatory (tracing → devprof →
+flightrec → saturation → meshprof), same module-global default-OFF
+discipline:
+
+  * **RecompileSentinel** (`watch`): every carded hot dispatch runs under
+    a watch window that samples the process-wide ``jax.monitoring``
+    compile counters (utils/tracing.JitCompileMonitor) before and after.
+    Compiles attributed to a window AFTER the program's warmup window are
+    steady-state recompiles — the zero-recompile contract the tests pin
+    (tests/test_tick_engine.py, tests/test_partitioner.py) promoted to a
+    LIVE production invariant: `mesh_steady_recompiles_total{program=}`
+    plus the SteadyStateRecompile alert.  Call sites that legitimately
+    rebuild a program (the evolver evolving a fresh market window) pass
+    ``cold=True`` so an expected re-trace never pages.
+  * **TransferSentinel** (inside `watch`, plus `allow_transfers`): the
+    watch window additionally enters a
+    ``jax.transfer_guard_device_to_host("disallow")`` scope, so an
+    unintended device→host pull on the fused tick or GA path becomes a
+    counted gauge (`mesh_guarded_transfers_total{program=}`) + alert
+    instead of invisible latency.  The sanctioned per-dispatch sync (the
+    ``host_read`` seams) re-enters an "allow" scope.  CAVEAT: the PJRT
+    CPU client treats device→host as zero-copy and never trips the guard
+    — on the CPU dev host the sentinel is a tripwire that only arms on
+    real accelerators; the counting/alert plumbing is exercised in tests
+    by injecting the guard's error shape (`is_transfer_violation`).
+  * **Layout cards** (`record_population_layout`): every
+    `Partitioner.population_eval(fn, name=...)` program records its
+    pad/mask layout AT TRACE TIME (once per compiled shape): population,
+    pad rows, per-device member count, pad fraction (pop 10 on an 8-way
+    mesh = 6/16 = 37.5% wasted lanes), and the all-gather collective
+    bytes computed from the output tree (each device receives the other
+    ``n-1`` shards of every population-axis output).  Published as
+    ``mesh_*{program=}`` / ``mesh_device_members{program=,device=}``
+    gauges; the compute side of the byte split reads the matching devprof
+    cost card's ``bytes_accessed`` when one exists.
+  * **Memory imbalance** (`export`): the per-device live-buffer
+    watermarks (utils/devprof.MemoryWatermark — already split by device)
+    fold into one skew gauge, ``mesh_memory_imbalance`` = max/mean bytes
+    across devices, driving DeviceMemoryImbalance on multi-chip hosts.
+
+Like tracing/devprof, the observatory is OFF by default: `watch()` and
+every other hot-path helper check one module global and return a
+pre-allocated no-op, so the disabled path costs one attribute read.
+Enable with ``TradingSystem(..., enable_meshprof=True)``,
+``cli trade --meshprof``, or ``meshprof.use(MeshProf())`` in tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re as _re
+import threading
+from dataclasses import dataclass
+
+# The active observatory. None = disabled (the default).
+_ACTIVE: "MeshProf | None" = None
+
+# Programs whose steady-state re-trace pages (the carded hot programs):
+# matching is on the name's first dot-segment so per-arch names like
+# "train_epoch.lstm" inherit the family's hotness.
+DEFAULT_HOT_PROGRAMS = frozenset({
+    "tick_engine", "ga_scan", "backtest_sweep", "population_sweep",
+    "train_epoch", "sim_sweep", "dqn_train_iterations",
+})
+
+# pad fraction above which MeshPaddingWasteHigh fires (a quarter of the
+# mesh's lanes burning FLOPs on repeated pad members)
+DEFAULT_PAD_WASTE_THRESHOLD = 0.25
+# max/mean per-device live-bytes ratio above which DeviceMemoryImbalance
+# fires (one device holding 2x its fair share of HBM)
+DEFAULT_IMBALANCE_THRESHOLD = 2.0
+
+_TRANSFER_ERR_RE = _re.compile(r"disallow\w*\s.*transfer|transfer.*disallow",
+                               _re.IGNORECASE | _re.DOTALL)
+
+
+def is_transfer_violation(exc: BaseException) -> bool:
+    """True iff ``exc`` is a jax transfer-guard violation (the error the
+    "disallow" scope raises on an unsanctioned device→host pull)."""
+    return exc is not None and _TRANSFER_ERR_RE.search(str(exc)) is not None
+
+
+class _NoopCtx:
+    """Disabled-observatory stand-in (the tracing _NoopCtx pattern)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_CTX = _NoopCtx()
+
+
+@dataclass
+class LayoutCard:
+    """One sharded program's pad/mask layout (trace-time, one-shot per
+    compiled shape — the newest shape wins)."""
+
+    program: str
+    population: int = 0
+    pad: int = 0
+    devices: int = 1
+    collective_bytes: int = 0       # all-gather traffic per dispatch
+    device_names: tuple = ()
+
+    @property
+    def padded(self) -> int:
+        return self.population + self.pad
+
+    @property
+    def pad_fraction(self) -> float:
+        return self.pad / self.padded if self.padded else 0.0
+
+    @property
+    def members_per_device(self) -> float:
+        return self.padded / self.devices if self.devices else 0.0
+
+    def to_dict(self) -> dict:
+        return {"program": self.program, "population": self.population,
+                "pad": self.pad, "padded": self.padded,
+                "devices": self.devices,
+                "pad_fraction": round(self.pad_fraction, 6),
+                "members_per_device": self.members_per_device,
+                "collective_bytes": self.collective_bytes}
+
+
+class RecompileSentinel:
+    """Per-program compile attribution over watch windows.
+
+    The process-wide ``jax.monitoring`` compile counter is global — the
+    sentinel attributes its deltas to named programs by sampling it
+    around each watched dispatch (the same before/after pattern the
+    contract tests always used, now owned by production).  A window's
+    compiles count as STEADY-STATE recompiles when the program has
+    completed at least ``warmup_windows`` prior windows and the caller
+    did not mark the window cold (an expected rebuild: fresh market
+    window, new shape bucket by design)."""
+
+    def __init__(self, metrics=None, warmup_windows: int = 1,
+                 hot_programs=DEFAULT_HOT_PROGRAMS):
+        self.metrics = metrics
+        self.warmup_windows = warmup_windows
+        self.hot_programs = frozenset(hot_programs)
+        self.windows: dict[str, int] = {}     # completed watch windows
+        self.compiles: dict[str, int] = {}    # total attributed compiles
+        self.steady: dict[str, int] = {}      # compiles after warmup
+        self.alerted: list[str] = []          # hot programs that re-traced
+        self._lock = threading.Lock()
+
+    def _is_hot(self, name: str) -> bool:
+        return name.split(".", 1)[0] in self.hot_programs
+
+    def record_window(self, name: str, compiles: int, *,
+                      cold: bool = False, aborted: bool = False) -> None:
+        with self._lock:
+            warm = self.windows.get(name, 0) >= self.warmup_windows
+            if not aborted:
+                self.windows[name] = self.windows.get(name, 0) + 1
+            if compiles <= 0:
+                self._export(name)
+                return
+            self.compiles[name] = self.compiles.get(name, 0) + compiles
+            if warm and not cold and not aborted:
+                self.steady[name] = self.steady.get(name, 0) + compiles
+                if self._is_hot(name) and name not in self.alerted:
+                    self.alerted.append(name)
+                if self.metrics is not None:
+                    self.metrics.inc("mesh_steady_recompiles_total",
+                                     compiles, program=name)
+            if self.metrics is not None:
+                self.metrics.inc("mesh_program_compiles_total", compiles,
+                                 program=name)
+            self._export(name)
+
+    def _export(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge("mesh_program_watch_windows",
+                                   self.windows.get(name, 0), program=name)
+
+    def steady_total(self) -> int:
+        with self._lock:
+            return sum(self.steady.values())
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"windows": dict(self.windows),
+                    "compiles": dict(self.compiles),
+                    "steady_recompiles": dict(self.steady),
+                    "alerted": list(self.alerted)}
+
+
+class TransferSentinel:
+    """Counted device→host guard violations per program."""
+
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+        self.violations: dict[str, int] = {}
+        self.last_error: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def record(self, name: str, exc: BaseException) -> None:
+        with self._lock:
+            self.violations[name] = self.violations.get(name, 0) + 1
+            self.last_error[name] = f"{type(exc).__name__}: {exc}"[:300]
+        if self.metrics is not None:
+            self.metrics.inc("mesh_guarded_transfers_total", program=name)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self.violations.values())
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"violations": dict(self.violations),
+                    "last_error": dict(self.last_error)}
+
+
+class _WatchCtx:
+    """One recompile-attribution window + device→host transfer guard
+    around one hot dispatch.  Allocated per watched dispatch only while
+    the observatory is ON."""
+
+    __slots__ = ("mp", "name", "cold", "_mon", "_before", "_guard")
+
+    def __init__(self, mp: "MeshProf", name: str, cold: bool):
+        self.mp = mp
+        self.name = name
+        self.cold = cold
+        self._guard = None
+
+    def __enter__(self):
+        from ai_crypto_trader_tpu.utils.tracing import JitCompileMonitor
+
+        self._mon = JitCompileMonitor.install()
+        self._before = self._mon.sample()
+        # the guard AUTO-DISARMS per program after its first counted
+        # violation: "disallow" aborts the offending dispatch (that one
+        # failure is the counted+alerted signal), but a DETERMINISTIC
+        # stray pull must not abort every subsequent tick — that would
+        # crash-loop the stage into quarantine instead of degrading to
+        # the measured latency the alert already names
+        if self.mp.guard_transfers \
+                and self.name not in self.mp.transfers.violations:
+            import jax
+
+            self._guard = jax.transfer_guard_device_to_host("disallow")
+            self._guard.__enter__()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if self._guard is not None:
+            self._guard.__exit__(et, ev, tb)
+        if ev is not None and is_transfer_violation(ev):
+            self.mp.transfers.record(self.name, ev)
+        since = self._mon.since(self._before)
+        self.mp.recompiles.record_window(self.name, since["compiles"],
+                                         cold=self.cold,
+                                         aborted=ev is not None)
+        return False                      # never swallow — callers recover
+
+
+class MeshProf:
+    """The observatory instance: sentinels + layout cards + imbalance.
+
+    ``metrics`` (a MetricsRegistry) receives every ``mesh_*`` series;
+    ``guard_transfers=False`` disables the transfer_guard scopes (watch
+    windows then do recompile attribution only — useful where a library
+    legitimately pulls values inside the watched region)."""
+
+    def __init__(self, metrics=None, *, warmup_windows: int = 1,
+                 guard_transfers: bool = True,
+                 hot_programs=DEFAULT_HOT_PROGRAMS,
+                 pad_waste_threshold: float = DEFAULT_PAD_WASTE_THRESHOLD,
+                 imbalance_threshold: float = DEFAULT_IMBALANCE_THRESHOLD):
+        self.metrics = metrics
+        self.guard_transfers = guard_transfers
+        self.pad_waste_threshold = pad_waste_threshold
+        self.imbalance_threshold = imbalance_threshold
+        self.recompiles = RecompileSentinel(metrics=metrics,
+                                            warmup_windows=warmup_windows,
+                                            hot_programs=hot_programs)
+        self.transfers = TransferSentinel(metrics=metrics)
+        self.layouts: dict[str, LayoutCard] = {}
+        self.trial_assignments: dict[str, int] = {}   # device -> trials
+        self.last_imbalance: float = 0.0
+        self.last_device_count: int = 1
+        # lazy own watermark: used only when the launcher runs without
+        # devprof (devprof's sampler feeds us its result otherwise)
+        self._watermark = None
+        self._lock = threading.Lock()
+
+    # -- watch windows --------------------------------------------------------
+    def watch(self, name: str, cold: bool = False) -> _WatchCtx:
+        return _WatchCtx(self, name, cold)
+
+    # -- layout cards ---------------------------------------------------------
+    def record_layout(self, program: str, *, population: int, pad: int,
+                      devices: int, out_tree=None,
+                      device_names=()) -> LayoutCard:
+        """Record one sharded program's pad/mask layout.  Runs at TRACE
+        time of the partitioned program (once per compiled shape), so it
+        must stay pure-host and cheap.  ``out_tree`` may hold tracers —
+        only shapes/dtypes are read; every output leaf carrying the
+        padded population axis contributes its all-gather bytes (each of
+        the ``devices`` chips receives the other ``devices-1`` shards)."""
+        import numpy as np
+
+        padded = population + pad
+        collective = 0
+        if out_tree is not None and devices > 1:
+            import jax
+
+            for leaf in jax.tree.leaves(out_tree):
+                shape = getattr(leaf, "shape", ())
+                dtype = getattr(leaf, "dtype", None)
+                if not shape or shape[0] != padded or dtype is None:
+                    continue
+                collective += (int(np.prod(shape)) * dtype.itemsize
+                               * (devices - 1))
+        card = LayoutCard(program=program, population=int(population),
+                          pad=int(pad), devices=int(devices),
+                          collective_bytes=int(collective),
+                          device_names=tuple(str(d) for d in device_names))
+        with self._lock:
+            self.layouts[program] = card
+        m = self.metrics
+        if m is not None:
+            m.set_gauge("mesh_population", card.population, program=program)
+            m.set_gauge("mesh_pad_fraction", card.pad_fraction,
+                        program=program)
+            m.set_gauge("mesh_collective_bytes", card.collective_bytes,
+                        program=program)
+            m.set_gauge("mesh_compute_bytes", self._compute_bytes(program),
+                        program=program)
+            for dev in (card.device_names
+                        or [f"device:{i}" for i in range(card.devices)]):
+                m.set_gauge("mesh_device_members", card.members_per_device,
+                            program=program, device=dev)
+        return card
+
+    @staticmethod
+    def _compute_bytes(program: str) -> float:
+        """The compute side of the byte split: the matching devprof cost
+        card's ``bytes_accessed`` (0.0 until/unless one exists — the two
+        observatories are independently enableable)."""
+        from ai_crypto_trader_tpu.utils import devprof
+
+        dp = devprof.active()
+        if dp is None:
+            return 0.0
+        card = dp.cards.get(program)
+        return float(card.bytes_accessed) if card is not None else 0.0
+
+    # -- trial farming --------------------------------------------------------
+    def record_trial(self, device) -> None:
+        """Count one host-farmed trial's device assignment (the HPO
+        `trial_devices` round-robin)."""
+        dev = str(device)
+        with self._lock:
+            self.trial_assignments[dev] = self.trial_assignments.get(dev, 0) + 1
+        if self.metrics is not None:
+            self.metrics.inc("mesh_trial_assignments_total", device=dev)
+
+    # -- memory imbalance -----------------------------------------------------
+    def observe_memory(self, per_device: dict | None = None) -> float:
+        """Fold a per-device live-memory sample (devprof.sample_memory
+        output: {device: {"bytes": ...}}) into the skew gauge.  Samples
+        its own watermark when the caller has none (launcher without
+        devprof)."""
+        if per_device is None:
+            from ai_crypto_trader_tpu.utils import devprof
+
+            dp = devprof.active()
+            if dp is not None and dp.watermark.last:
+                # devprof already walked jax.live_arrays() this tick —
+                # fold its newest sample instead of walking again
+                per_device = dp.watermark.last
+            else:
+                if self._watermark is None:
+                    self._watermark = devprof.MemoryWatermark()
+                per_device = self._watermark.sample(metrics=self.metrics)
+        # skew over PARTICIPATING devices only (those holding any live
+        # bytes): single-device programs on a multi-chip host park every
+        # buffer on device 0 by design — that is idle capacity, not an
+        # imbalance, and it must not page DeviceMemoryImbalance.  The
+        # gauge becomes meaningful exactly when sharded programs spread
+        # state and one device starts hoarding.
+        sizes = [v.get("bytes", 0) for v in per_device.values()
+                 if v.get("bytes", 0) > 0]
+        self.last_device_count = max(len(sizes), 1)
+        mean = sum(sizes) / len(sizes) if sizes else 0.0
+        self.last_imbalance = (max(sizes) / mean
+                               if sizes and mean > 0 else 0.0)
+        if self.metrics is not None:
+            self.metrics.set_gauge("mesh_memory_imbalance",
+                                   self.last_imbalance)
+            self.metrics.set_gauge("mesh_devices", self.last_device_count)
+        return self.last_imbalance
+
+    # -- views ----------------------------------------------------------------
+    def export(self, memory: dict | None = None) -> None:
+        """Per-tick export (launcher): memory-imbalance fold + refresh of
+        the byte-split gauges (the devprof card may have landed after the
+        layout did)."""
+        self.observe_memory(memory)
+        m = self.metrics
+        if m is None:
+            return
+        with self._lock:
+            programs = list(self.layouts)
+        for program in programs:
+            m.set_gauge("mesh_compute_bytes", self._compute_bytes(program),
+                        program=program)
+
+    def pad_fraction_max(self) -> float:
+        with self._lock:
+            return max((c.pad_fraction for c in self.layouts.values()),
+                       default=0.0)
+
+    def alert_state(self) -> dict:
+        """Inputs for the in-process rule engine (utils/alerts.py):
+        SteadyStateRecompile / UnintendedHostTransfer /
+        MeshPaddingWasteHigh / DeviceMemoryImbalance."""
+        with self._lock:
+            transfer_programs = [n for n, c in
+                                 self.transfers.violations.items() if c]
+        return {
+            "steady_recompile_programs": list(self.recompiles.alerted),
+            "guarded_transfer_programs": transfer_programs,
+            "mesh_pad_fraction_max": self.pad_fraction_max(),
+            "mesh_pad_waste_threshold": self.pad_waste_threshold,
+            "mesh_memory_imbalance": self.last_imbalance,
+            "mesh_imbalance_threshold": self.imbalance_threshold,
+            "mesh_devices": self.last_device_count,
+        }
+
+    def status(self) -> dict:
+        """JSON-able snapshot (dashboard /state.json `mesh` block,
+        `cli mesh`/`cli status`)."""
+        with self._lock:
+            layouts = {n: c.to_dict() for n, c in self.layouts.items()}
+            trials = dict(self.trial_assignments)
+        return {"layouts": layouts,
+                "recompiles": self.recompiles.status(),
+                "transfers": self.transfers.status(),
+                "trial_assignments": trials,
+                "memory_imbalance": self.last_imbalance,
+                "devices": self.last_device_count}
+
+
+# -- module-level hot-path API (single-check disabled path) ------------------
+
+def configure(mp: MeshProf) -> MeshProf:
+    """Install ``mp`` as the process-wide active observatory."""
+    global _ACTIVE
+    _ACTIVE = mp
+    return mp
+
+
+def disable() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> MeshProf | None:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def use(mp: MeshProf):
+    """Scoped activation (tests, bench): restores the previous instance."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = mp
+    try:
+        yield mp
+    finally:
+        _ACTIVE = prev
+
+
+def watch(name: str, cold: bool = False):
+    """Recompile window + transfer guard around one hot dispatch; the
+    pre-allocated no-op when the observatory is off."""
+    mp = _ACTIVE
+    if mp is None:
+        return _NOOP_CTX
+    return mp.watch(name, cold=cold)
+
+
+def allow_transfers():
+    """Sanctioned device→host scope for the ``host_read`` seams: inside a
+    watch window's "disallow" guard, the one explicit per-dispatch sync
+    re-enters "allow".  No-op when the observatory (or its transfer
+    guarding) is off."""
+    mp = _ACTIVE
+    if mp is None or not mp.guard_transfers:
+        return _NOOP_CTX
+    import jax
+
+    return jax.transfer_guard_device_to_host("allow")
+
+
+def record_population_layout(name: str, *, population: int, pad: int,
+                             devices: int, out_tree=None,
+                             device_names=()) -> LayoutCard | None:
+    mp = _ACTIVE
+    if mp is None:
+        return None
+    return mp.record_layout(name, population=population, pad=pad,
+                            devices=devices, out_tree=out_tree,
+                            device_names=device_names)
+
+
+def record_trial(device) -> None:
+    mp = _ACTIVE
+    if mp is not None:
+        mp.record_trial(device)

@@ -296,12 +296,54 @@ def _flag_value(name: str, default):
     return default
 
 
+def trend_table(rows: list, report: list, last_n: int = 5) -> list[str]:
+    """Per-metric trend lines for every REGRESSION in a gate report: the
+    last ``last_n`` rows sharing the regressed row's gate key (same
+    metric, device kind, scale stamp and device count), oldest first, so
+    a CI failure is diagnosable from the log alone — was this a cliff,
+    a slow slide, or one noisy run against a lucky best?"""
+    usable = [r for r in rows
+              if r.get("unit") not in GATE_SKIP_UNITS
+              and isinstance(r.get("value"), (int, float))
+              and "metric" in r and "run_id" in r]
+    by_key: dict = {}
+    for r in usable:
+        by_key.setdefault(_gate_key(r), []).append(r)
+    lines = []
+    for rec in report:
+        if rec.get("status") != "REGRESSION":
+            continue
+        key = (rec["metric"], rec["device_kind"],
+               tuple(sorted((rec.get("scale") or {}).items())),
+               int(rec.get("devices") or 1))
+        trail = by_key.get(key, [])[-last_n:]
+        if not trail:
+            continue
+        unit = rec.get("unit") or ""
+        lines.append(f"trend {rec['metric']} [{rec['device_kind']}"
+                     + (f" x{rec['devices']}" if rec.get("devices") else "")
+                     + f"] ({unit}, allowed {rec.get('allowed')}):")
+        for i, r in enumerate(trail):
+            mark = " <- REGRESSION" if i == len(trail) - 1 else ""
+            best = " (best prior)" \
+                if r["run_id"] == rec.get("best_prior_run") else ""
+            lines.append(f"  {r['run_id']}  {r['value']:g}{best}{mark}")
+    return lines
+
+
 def run_gate() -> int:
     path = _flag_value("--history-file", HISTORY_PATH)
     tol = float(_flag_value("--gate-tolerance", GATE_TOLERANCE))
-    ok, report = gate_history(load_history(path), tolerance=tol)
+    rows = load_history(path)
+    ok, report = gate_history(rows, tolerance=tol)
     for rec in report:
         print(json.dumps(rec), flush=True)
+    if not ok:
+        # regression diagnosis without archaeology: the recent same-key
+        # trajectory per failing metric, straight into the CI log.  On
+        # STDERR — stdout is a machine-readable JSON-lines contract.
+        for line in trend_table(rows, report):
+            print(line, file=sys.stderr, flush=True)
     print(json.dumps({"gate": "pass" if ok else "FAIL",
                       "tolerance": tol, "history": path}), flush=True)
     return 0 if ok else 1
@@ -1158,8 +1200,14 @@ def bench_flightrec():
                 rid = recorder.begin(s, features=feats)
                 recorder.veto(rid, "confidence_floor")
 
+    from ai_crypto_trader_tpu.utils import meshprof as meshprof_mod
+
     tick(None)                               # compile + seed
-    reps_off, reps_on = [], []
+    mesh_obs = meshprof_mod.MeshProf()       # warm its watch windows so
+    with meshprof_mod.use(mesh_obs):         # the measured ticks are
+        ex.advance(steps=1)                  # steady-state, not warmup
+        tick(None)
+    reps_off, reps_on, reps_mesh = [], [], []
     for _ in range(3):
         ex.advance(steps=1)
         t0 = time.perf_counter()
@@ -1169,15 +1217,31 @@ def bench_flightrec():
         t0 = time.perf_counter()
         tick(fr)
         reps_on.append((time.perf_counter() - t0) * 1e3)
+        # mesh observatory cost on the same path (ISSUE 12 acceptance:
+        # watch window + transfer guard ≤ 5% of the fused tick p50)
+        ex.advance(steps=1)
+        with meshprof_mod.use(mesh_obs):
+            t0 = time.perf_counter()
+            tick(None)
+            reps_mesh.append((time.perf_counter() - t0) * 1e3)
     off_ms = float(np.median(reps_off))
     on_ms = float(np.median(reps_on))
+    mesh_ms = float(np.median(reps_mesh))
     overhead_pct = max((on_ms - off_ms) / off_ms * 100.0, 0.0)
+    mesh_overhead_pct = max((mesh_ms - off_ms) / off_ms * 100.0, 0.0)
     log(f"flightrec: fused tick {off_ms:.2f} ms off vs {on_ms:.2f} ms on "
-        f"(S={S}) → overhead {overhead_pct:.2f}% of tick p50")
+        f"(S={S}) → overhead {overhead_pct:.2f}% of tick p50; "
+        f"meshprof on {mesh_ms:.2f} ms → {mesh_overhead_pct:.2f}% "
+        f"(steady recompiles {mesh_obs.recompiles.steady_total()}, "
+        f"guarded transfers {mesh_obs.transfers.total()})")
     emit("flightrec", rps, "records/s", None, symbols=S,
          overhead_pct=round(overhead_pct, 3),
          tick_ms_recorder_off=round(off_ms, 3),
-         tick_ms_recorder_on=round(on_ms, 3))
+         tick_ms_recorder_on=round(on_ms, 3),
+         tick_ms_meshprof_on=round(mesh_ms, 3),
+         meshprof_overhead_pct=round(mesh_overhead_pct, 3),
+         meshprof_steady_recompiles=mesh_obs.recompiles.steady_total(),
+         meshprof_guarded_transfers=mesh_obs.transfers.total())
 
 
 def bench_ga(arrays):
@@ -1214,8 +1278,16 @@ def bench_ga(arrays):
     legacy_eval = jax.jit(
         lambda g: jax.vmap(lambda row: fitness(unstack_params(row)))(g))
 
+    # mesh observatory around the compile run ONLY (timed runs stay
+    # untouched): the partitioned eval records its pad/mask layout at
+    # trace time, so the row carries locality data — pad fraction,
+    # per-device members, all-gather bytes — next to the throughput
+    from ai_crypto_trader_tpu.utils import meshprof as meshprof_mod
+
+    mesh_obs = meshprof_mod.MeshProf()
     t0 = time.perf_counter()
-    run_ga(jax.random.PRNGKey(0), fitness, cfg, partitioner=partitioner)
+    with meshprof_mod.use(mesh_obs):
+        run_ga(jax.random.PRNGKey(0), fitness, cfg, partitioner=partitioner)
     warm = time.perf_counter() - t0
     t0 = time.perf_counter()
     run_ga_legacy(jax.random.PRNGKey(0), fitness, cfg, eval_fn=legacy_eval)
@@ -1245,12 +1317,24 @@ def bench_ga(arrays):
     # reference: sequential fitness loop ≈ one scalar replay per individual;
     # measured reference loop throughput (BENCH headline) gives its rate:
     # ref_backtests/s = ref_candles_per_sec / T_GA — computed by caller
+    layout = mesh_obs.layouts.get("ga_scan")
+    # analytic fallback: the trace-time card is the source of truth, but
+    # a cached-program path that skipped the trace must not hole the row
+    pad = (-POP) % max(partitioner.device_count, 1)
+    locality = ({"pad_fraction": round(layout.pad_fraction, 4),
+                 "members_per_device": layout.members_per_device,
+                 "collective_bytes": layout.collective_bytes}
+                if layout is not None else
+                {"pad_fraction": round(pad / (POP + pad), 4) if POP else 0.0,
+                 "members_per_device": (POP + pad) / partitioner.device_count,
+                 "collective_bytes": 0})
     return n_backtests / dt, T_GA, {
         "devices": partitioner.device_count,
         "population": POP, "generations": GENS,
         "per_generation_ms": round(per_gen_ms, 3),
         "legacy_driver_backtests_per_sec": round(n_backtests / legacy_dt, 3),
         "speedup_vs_legacy_driver": round(legacy_dt / dt, 2),
+        **locality,
     }
 
 
@@ -1349,20 +1433,39 @@ def run_worker():
     # trajectory stays legible when the same config runs on a pod slice.
     try:
         from ai_crypto_trader_tpu.parallel import get_partitioner
+        from ai_crypto_trader_tpu.utils import meshprof as meshprof_mod
 
         part = get_partitioner()
-        stats_p = sweep(inp, params, unroll=best_unroll, partitioner=part)
-        fetch(stats_p.final_balance)               # compile + first run
+        # mesh observatory around the compile run only: the sharded
+        # program's pad/collective layout card rides the row (ISSUE 12 —
+        # the multichip trajectory carries locality data, not just
+        # throughput); timed runs stay observatory-free
+        mesh_obs = meshprof_mod.MeshProf()
+        with meshprof_mod.use(mesh_obs):
+            stats_p = sweep(inp, params, unroll=best_unroll,
+                            partitioner=part)
+            fetch(stats_p.final_balance)           # compile + first run
         t0 = time.perf_counter()
         stats_p = sweep(inp, params, unroll=best_unroll, partitioner=part)
         fetch(stats_p.final_balance)
         dt_p = time.perf_counter() - t0
+        layout = mesh_obs.layouts.get("population_sweep")
+        pad = (-B) % max(part.device_count, 1)
+        locality = ({"pad_fraction": round(layout.pad_fraction, 4),
+                     "members_per_device": layout.members_per_device,
+                     "collective_bytes": layout.collective_bytes}
+                    if layout is not None else
+                    {"pad_fraction": round(pad / (B + pad), 4) if B else 0.0,
+                     "members_per_device": (B + pad) / part.device_count,
+                     "collective_bytes": 0})
         log(f"population sweep via partitioner (devices="
             f"{part.device_count}): {dt_p:.3f}s → "
-            f"{T*B/dt_p:,.0f} candles/s")
+            f"{T*B/dt_p:,.0f} candles/s "
+            f"(pad_fraction={locality['pad_fraction']}, "
+            f"collective_bytes={locality['collective_bytes']:,})")
         emit("population_sweep_candles_per_sec", T * B / dt_p, "candles/s",
              None, engine="partitioner", devices=part.device_count,
-             population=B)
+             population=B, **locality)
     except Exception as e:               # noqa: BLE001 — bench must not die
         log(f"population_sweep row unavailable ({type(e).__name__}: {e})")
 

@@ -97,6 +97,74 @@ class TestGateSubprocess:
         assert r.returncode == 0, r.stdout + r.stderr
 
 
+class TestGateTrendTable:
+    """ISSUE 12 satellite: a --gate failure prints a per-metric trend
+    table (last N same-key rows) so CI regressions are diagnosable from
+    the log alone."""
+
+    def test_failure_prints_trend_lines(self, tmp_path):
+        hist = tmp_path / "h.jsonl"
+        _write_history(hist, [
+            [_row("ga_backtests_per_sec", 100.0, "backtests/s")],
+            [_row("ga_backtests_per_sec", 110.0, "backtests/s")],
+            [_row("ga_backtests_per_sec", 104.0, "backtests/s")],
+            [_row("ga_backtests_per_sec", 50.0, "backtests/s")],
+        ])
+        out = _run_gate(hist)
+        assert out.returncode == 1
+        # stdout stays a pure JSON-lines contract; the trend diagnostic
+        # rides stderr into the CI log
+        for line in out.stdout.strip().splitlines():
+            json.loads(line)
+        body = out.stderr
+        assert "trend ga_backtests_per_sec" in body
+        # the trail is chronological, flags the regressed run and names
+        # the best prior it was gated against
+        assert body.index("run1") < body.index("run3  50")
+        assert "(best prior)" in body
+        assert "<- REGRESSION" in body
+
+    def test_pass_prints_no_trend(self, tmp_path):
+        hist = tmp_path / "h.jsonl"
+        _write_history(hist, [
+            [_row("ga_backtests_per_sec", 100.0, "backtests/s")],
+            [_row("ga_backtests_per_sec", 105.0, "backtests/s")],
+        ])
+        out = _run_gate(hist)
+        assert out.returncode == 0
+        assert "trend " not in out.stdout + out.stderr
+
+    def test_trend_table_logic_respects_gate_keys(self):
+        """Cross-device/scale rows never pollute a metric's trail — the
+        trend shares the gate's comparability keying exactly."""
+        bench = _bench_module()
+        rows = []
+        for i, (v, kind) in enumerate([(100.0, "cpu"), (90.0, "tpu-v5e"),
+                                       (101.0, "cpu"), (50.0, "cpu")]):
+            rows.append({"run_id": f"r{i}", "metric": "m", "value": v,
+                         "unit": "x/s", "device_kind": kind})
+        ok, report = bench.gate_history(rows)
+        assert not ok
+        lines = bench.trend_table(rows, report)
+        text = "\n".join(lines)
+        trail = [ln for ln in lines if ln.startswith("  ")]
+        assert not any(" 90" in ln for ln in trail)   # the TPU row is
+        #                                               another trajectory
+        assert "100" in text and "101" in text and "50" in text
+
+    def test_trend_limited_to_last_n(self):
+        bench = _bench_module()
+        rows = [{"run_id": f"r{i}", "metric": "m", "value": 100.0 + i,
+                 "unit": "x/s", "device_kind": "cpu"} for i in range(9)]
+        rows.append({"run_id": "r9", "metric": "m", "value": 10.0,
+                     "unit": "x/s", "device_kind": "cpu"})
+        ok, report = bench.gate_history(rows)
+        assert not ok
+        lines = bench.trend_table(rows, report, last_n=4)
+        # header + 4 trail rows
+        assert len([ln for ln in lines if ln.startswith("  ")]) == 4
+
+
 class TestGateLogic:
     def setup_method(self):
         self.bench = _bench_module()

@@ -1,0 +1,525 @@
+"""The mesh runtime observatory (utils/meshprof.py) — ISSUE 12 tier-1.
+
+Pins the contracts:
+  * RecompileSentinel: a warm repeat attributes ZERO compiles; a forced
+    shape change after warmup is COUNTED and ALERTED (the negative test
+    the zero-recompile contract always lacked); cold-marked windows
+    (expected rebuilds) never count.
+  * TransferSentinel: a watch window exiting on a transfer-guard-shaped
+    error counts the violation per program and feeds the
+    UnintendedHostTransfer alert; unrelated errors never count.
+  * Layout cards: pop 10 on the 8-way mesh records pad_fraction 0.375
+    (the analytic value — 6 pad rows / 16 lanes), 2 members/device, and
+    the exact all-gather byte volume; gauges land in the registry.
+  * Memory imbalance: per-device skew folds to max/mean and drives
+    DeviceMemoryImbalance only on multi-device hosts.
+  * Alert coherence (the PR 1 suite pattern): the four mesh alerts exist
+    in BOTH rule engines and reference only emitted series.
+  * Launcher integration + the acceptance soak: a paper system with the
+    observatory ON ticks at steady state with zero steady recompiles,
+    zero guarded transfers, and a /state.json `mesh` block carrying the
+    partitioner layout.
+"""
+
+import asyncio
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.parallel.mesh import make_mesh
+from ai_crypto_trader_tpu.parallel.partitioner import (
+    MeshPartitioner,
+    SingleDevicePartitioner,
+    get_partitioner,
+)
+from ai_crypto_trader_tpu.utils import meshprof
+from ai_crypto_trader_tpu.utils.alerts import AlertManager
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+class TestRecompileSentinel:
+    def test_warm_repeat_attributes_zero_compiles(self):
+        mp = meshprof.MeshProf(guard_transfers=False)
+        f = jax.jit(lambda x: x * 2 + 1)
+        with meshprof.use(mp):
+            with meshprof.watch("tick_engine"):
+                f(jnp.ones(7)).block_until_ready()      # warmup window
+            with meshprof.watch("tick_engine"):
+                f(jnp.ones(7)).block_until_ready()      # steady repeat
+        assert mp.recompiles.steady_total() == 0
+        assert mp.recompiles.windows["tick_engine"] == 2
+        assert mp.recompiles.alerted == []
+
+    def test_forced_shape_change_counted_and_alerted(self):
+        """THE negative test (ISSUE 12 satellite): after warmup, a shape
+        change on a hot program is a counted steady-state recompile and
+        fires SteadyStateRecompile in the in-process rule engine."""
+        mp = meshprof.MeshProf(guard_transfers=False)
+        f = jax.jit(lambda x: x * 3 - 1)
+        with meshprof.use(mp):
+            with meshprof.watch("ga_scan"):
+                f(jnp.ones(5)).block_until_ready()      # warmup
+            with meshprof.watch("ga_scan"):
+                f(jnp.ones(9)).block_until_ready()      # forced re-trace
+        assert mp.recompiles.steady_total() > 0
+        assert "ga_scan" in mp.recompiles.alerted
+        fired = AlertManager(now_fn=lambda: 0.0).evaluate(mp.alert_state())
+        assert "SteadyStateRecompile" in {a["name"] for a in fired}
+
+    def test_cold_windows_never_count(self):
+        """An expected rebuild (fresh market window, new scale knob) rides
+        cold=True — by design it compiles, by design it must not page."""
+        mp = meshprof.MeshProf(guard_transfers=False)
+        f = jax.jit(lambda x: x + 2)
+        with meshprof.use(mp):
+            with meshprof.watch("sim_sweep"):
+                f(jnp.ones(4)).block_until_ready()
+            with meshprof.watch("sim_sweep", cold=True):
+                f(jnp.ones(6)).block_until_ready()      # expected re-trace
+        assert mp.recompiles.steady_total() == 0
+        assert mp.recompiles.alerted == []
+        # ...but the total compile attribution still recorded the work
+        assert mp.recompiles.compiles.get("sim_sweep", 0) >= 0
+
+    def test_non_hot_program_counts_but_never_alerts(self):
+        mp = meshprof.MeshProf(guard_transfers=False)
+        f = jax.jit(lambda x: x * 5)
+        with meshprof.use(mp):
+            with meshprof.watch("side_program"):
+                f(jnp.ones(3)).block_until_ready()
+            with meshprof.watch("side_program"):
+                f(jnp.ones(11)).block_until_ready()
+        assert mp.recompiles.steady.get("side_program", 0) > 0
+        assert mp.recompiles.alerted == []
+
+    def test_counters_land_in_metrics(self):
+        reg = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=reg, guard_transfers=False)
+        f = jax.jit(lambda x: x - 4)
+        with meshprof.use(mp):
+            with meshprof.watch("tick_engine"):
+                f(jnp.ones(2)).block_until_ready()
+            with meshprof.watch("tick_engine"):
+                f(jnp.ones(13)).block_until_ready()
+        text = reg.exposition()
+        assert "mesh_steady_recompiles_total" in text
+        assert 'program="tick_engine"' in text
+
+
+class _FakeGuardError(RuntimeError):
+    """The shape of jaxlib's transfer-guard error: the PJRT CPU client
+    never trips the guard (device→host is zero-copy there), so the
+    counting path is exercised with the error text the real guard
+    raises on accelerators."""
+
+
+class TestTransferSentinel:
+    def test_violation_error_shape_recognized(self):
+        err = _FakeGuardError(
+            "Disallowed device-to-host transfer: aval=ShapedArray(f32[8])")
+        assert meshprof.is_transfer_violation(err)
+        assert not meshprof.is_transfer_violation(ValueError("boom"))
+
+    def test_watch_counts_violation_and_alerts(self):
+        mp = meshprof.MeshProf()
+        with meshprof.use(mp):
+            with pytest.raises(_FakeGuardError):
+                with meshprof.watch("tick_engine"):
+                    raise _FakeGuardError(
+                        "Disallowed device-to-host transfer of x")
+        assert mp.transfers.violations["tick_engine"] == 1
+        state = mp.alert_state()
+        assert state["guarded_transfer_programs"] == ["tick_engine"]
+        fired = AlertManager(now_fn=lambda: 0.0).evaluate(state)
+        assert "UnintendedHostTransfer" in {a["name"] for a in fired}
+
+    def test_unrelated_errors_never_count(self):
+        mp = meshprof.MeshProf()
+        with meshprof.use(mp):
+            with pytest.raises(ValueError):
+                with meshprof.watch("ga_scan"):
+                    raise ValueError("not a transfer")
+        assert mp.transfers.total() == 0
+        # an aborted window is not a completed warm window either
+        assert mp.recompiles.windows.get("ga_scan", 0) == 0
+
+    def test_guard_auto_disarms_after_first_violation(self):
+        """A deterministic stray pull must be counted ONCE, not abort
+        every subsequent dispatch into a crash-looped stage: after the
+        first counted violation the guard stops arming for that program
+        (the alert stays latched; other programs stay guarded)."""
+        mp = meshprof.MeshProf()
+        with meshprof.use(mp):
+            with mp.watch("tick_engine") as w0:
+                assert w0._guard is not None         # armed
+            with pytest.raises(_FakeGuardError):
+                with mp.watch("tick_engine"):
+                    raise _FakeGuardError(
+                        "Disallowed device-to-host transfer of x")
+            with mp.watch("tick_engine") as w1:
+                assert w1._guard is None             # disarmed: counted,
+                #                                      alerted, not fatal
+            with mp.watch("ga_scan") as w2:
+                assert w2._guard is not None         # others still armed
+        assert mp.transfers.violations["tick_engine"] == 1
+
+    def test_disabled_module_helpers_are_noops(self):
+        meshprof.disable()
+        assert meshprof.active() is None
+        with meshprof.watch("anything") as w:
+            assert w is None
+        with meshprof.allow_transfers() as a:
+            assert a is None
+
+    def test_sanctioned_host_read_inside_guarded_watch(self):
+        """The host_read seams re-enter an allow scope inside the watch's
+        disallow guard — the one sanctioned sync must never count (on the
+        CPU backend the guard is inert either way; this pins the scope
+        nesting doesn't raise or miscount)."""
+        mp = meshprof.MeshProf()
+        f = jax.jit(lambda x: x * 2)
+        with meshprof.use(mp):
+            with meshprof.watch("tick_engine"):
+                out = f(jnp.ones(3))
+                with meshprof.allow_transfers():
+                    np.asarray(out)
+        assert mp.transfers.total() == 0
+
+
+class TestLayoutCards:
+    def test_pop10_on_8way_mesh_matches_analytic(self, mesh8):
+        """The acceptance number: pop 10 on 8 devices pads 6 rows onto 16
+        lanes = 37.5% wasted — measured by the card, not assumed."""
+        reg = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=reg)
+        with meshprof.use(mp):
+            pe = MeshPartitioner(mesh8).population_eval(
+                lambda t: {"sq": t["x"] ** 2, "sum": t["x"].sum(-1)},
+                name="ga_scan")
+            pe({"x": jnp.arange(40.0).reshape(10, 4)})
+        card = mp.layouts["ga_scan"]
+        assert card.population == 10 and card.pad == 6
+        assert card.devices == 8
+        assert abs(card.pad_fraction - 0.375) < 1e-12
+        assert card.members_per_device == 2.0
+        # all-gather bytes: sq [16,4] f32 + sum [16] f32, each received
+        # from the 7 other devices
+        assert card.collective_bytes == (16 * 4 * 4 + 16 * 4) * 7
+        assert len(card.device_names) == 8
+        text = reg.exposition()
+        assert 'crypto_trader_tpu_mesh_pad_fraction{program="ga_scan"} '\
+               '0.375' in text
+        assert "mesh_device_members" in text
+        # pad waste above the 25% threshold fires MeshPaddingWasteHigh
+        fired = AlertManager(now_fn=lambda: 0.0).evaluate(mp.alert_state())
+        assert "MeshPaddingWasteHigh" in {a["name"] for a in fired}
+
+    def test_divisible_population_has_zero_pad(self, mesh8):
+        mp = meshprof.MeshProf()
+        with meshprof.use(mp):
+            pe = MeshPartitioner(mesh8).population_eval(
+                lambda t: t["x"] * 2, name="population_sweep")
+            pe({"x": jnp.ones((16, 3))})
+        card = mp.layouts["population_sweep"]
+        assert card.pad == 0 and card.pad_fraction == 0.0
+        fired = AlertManager(now_fn=lambda: 0.0).evaluate(mp.alert_state())
+        assert "MeshPaddingWasteHigh" not in {a["name"] for a in fired}
+
+    def test_single_device_card_records_trivial_layout(self):
+        mp = meshprof.MeshProf()
+        with meshprof.use(mp):
+            pe = SingleDevicePartitioner().population_eval(
+                lambda t: t["x"] + 1, name="structure_pool")
+            pe({"x": jnp.ones((6, 2))})
+        card = mp.layouts["structure_pool"]
+        assert (card.population, card.pad, card.devices) == (6, 0, 1)
+        assert card.collective_bytes == 0
+
+    def test_scanned_ga_records_layout_and_matches_gauge(self, mesh8):
+        """End-to-end through run_ga: the partitioned eval inside the
+        scanned program records the ragged layout at trace time and the
+        published gauge matches the analytic value."""
+        from test_partitioner import _cheap_fitness
+
+        from ai_crypto_trader_tpu.config import GAParams
+        from ai_crypto_trader_tpu.evolve import run_ga
+
+        def fitness(p):                   # fresh closure → fresh program
+            return _cheap_fitness(p)
+
+        reg = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=reg)
+        cfg = GAParams(population_size=10, generations=2, elite_size=2)
+        with meshprof.use(mp):
+            run_ga(jax.random.PRNGKey(3), fitness, cfg,
+                   partitioner=MeshPartitioner(mesh8))
+        assert abs(mp.layouts["ga_scan"].pad_fraction - 0.375) < 1e-12
+        assert mp.transfers.total() == 0
+        # the compile run is cold by construction (fresh program cache
+        # entry) — nothing may count as a steady-state recompile
+        assert mp.recompiles.steady_total() == 0
+
+    def test_trial_assignment_accounting(self):
+        reg = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=reg)
+        with meshprof.use(mp):
+            for i in range(5):
+                meshprof.record_trial(f"dev{i % 2}")
+        assert mp.trial_assignments == {"dev0": 3, "dev1": 2}
+        assert "mesh_trial_assignments_total" in reg.exposition()
+
+
+class TestMemoryImbalance:
+    def _sample(self, sizes):
+        return {f"d{i}": {"count": 1, "bytes": b}
+                for i, b in enumerate(sizes)}
+
+    def test_skew_fold_and_alert(self):
+        reg = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=reg)
+        mp.observe_memory(self._sample([100, 100, 100, 900]))
+        assert mp.last_imbalance == pytest.approx(900 / 300)
+        assert mp.last_device_count == 4
+        fired = AlertManager(now_fn=lambda: 0.0).evaluate(mp.alert_state())
+        assert "DeviceMemoryImbalance" in {a["name"] for a in fired}
+        text = reg.exposition()
+        assert "mesh_memory_imbalance" in text
+        assert "crypto_trader_tpu_mesh_devices 4" in text
+
+    def test_balanced_and_single_device_stay_silent(self):
+        mp = meshprof.MeshProf()
+        mp.observe_memory(self._sample([500, 500]))
+        names = {a["name"] for a in
+                 AlertManager(now_fn=lambda: 0.0).evaluate(mp.alert_state())}
+        assert "DeviceMemoryImbalance" not in names
+        # a single device can hold 100% of bytes — never an imbalance
+        mp.observe_memory(self._sample([12345]))
+        names = {a["name"] for a in
+                 AlertManager(now_fn=lambda: 0.0).evaluate(mp.alert_state())}
+        assert "DeviceMemoryImbalance" not in names
+
+    def test_self_sampling_without_devprof(self):
+        mp = meshprof.MeshProf()
+        out = mp.observe_memory(None)          # walks jax.live_arrays()
+        assert isinstance(out, float)
+        assert mp.last_device_count >= 1
+
+    def test_reuses_devprof_watermark_sample(self):
+        """With devprof active, the fold reads its watermark's newest
+        sample instead of walking jax.live_arrays() a second time."""
+        from ai_crypto_trader_tpu.utils import devprof
+
+        dp = devprof.DevProf()
+        dp.watermark.last = self._sample([100, 300])
+        mp = meshprof.MeshProf()
+        with devprof.use(dp):
+            mp.observe_memory(None)
+        assert mp.last_imbalance == pytest.approx(300 / 200)
+        assert mp.last_device_count == 2
+
+
+class TestPartitionerDescribe:
+    def test_single_device_describe(self):
+        d = SingleDevicePartitioner().describe()
+        assert d["kind"] == "SingleDevicePartitioner"
+        assert d["devices"] == 1
+        assert d["platform"] == "cpu"
+
+    def test_mesh_describe_carries_shape_and_kinds(self, mesh8):
+        d = MeshPartitioner(mesh8).describe()
+        assert d["devices"] == 8
+        assert d["mesh_shape"] == {"data": 8, "model": 1}
+        assert d["axis"] == "data"
+        assert len(d["device_names"]) == 8
+        assert d["device_kinds"]
+
+    def test_get_partitioner_describe_never_raises(self):
+        assert "kind" in get_partitioner().describe()
+
+
+class TestMeshAlertCoherence:
+    """Extends the PR 1 coherence suite: the four mesh alerts exist in
+    BOTH rule engines, every referenced mesh_* series is emitted, and the
+    recording group parses."""
+
+    MESH_ALERTS = {"SteadyStateRecompile", "UnintendedHostTransfer",
+                   "MeshPaddingWasteHigh", "DeviceMemoryImbalance"}
+
+    def test_series_emitted_and_rules_in_both_engines(self):
+        import re
+
+        import yaml
+
+        from test_observability import TestStackConfigCoherence
+
+        from ai_crypto_trader_tpu.utils.alerts import default_rules
+
+        emitted = TestStackConfigCoherence().emitted_series()
+        new_series = {"mesh_steady_recompiles_total",
+                      "mesh_program_compiles_total",
+                      "mesh_guarded_transfers_total", "mesh_pad_fraction",
+                      "mesh_population", "mesh_collective_bytes",
+                      "mesh_compute_bytes", "mesh_device_members",
+                      "mesh_memory_imbalance", "mesh_devices",
+                      "mesh_trial_assignments_total"}
+        missing = new_series - emitted
+        assert not missing, f"mesh series not emitted: {missing}"
+
+        rules = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/alert_rules.yml")))
+        alert_names = {r["alert"] for g in rules["groups"]
+                      for r in g["rules"] if "alert" in r}
+        assert self.MESH_ALERTS <= alert_names
+        for g in rules["groups"]:
+            for r in g["rules"]:
+                if r.get("alert") in self.MESH_ALERTS:
+                    for m in re.finditer(
+                            r"crypto_trader_tpu_([a-z0-9_]+)", r["expr"]):
+                        assert m.group(1) in emitted, m.group(1)
+        in_process = {r.name for r in default_rules()}
+        assert self.MESH_ALERTS <= in_process
+        rec = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/recording_rules.yml")))
+        mesh_groups = [g for g in rec["groups"]
+                       if g["name"] == "crypto_trader_tpu_mesh"]
+        assert mesh_groups and mesh_groups[0]["rules"]
+
+    def test_alert_resolution_lifecycle(self):
+        mgr = AlertManager(now_fn=lambda: 0.0)
+        fired = mgr.evaluate({"steady_recompile_programs": ["tick_engine"],
+                              "mesh_pad_fraction_max": 0.375})
+        names = {a["name"] for a in fired}
+        assert {"SteadyStateRecompile", "MeshPaddingWasteHigh"} <= names
+        mgr.evaluate({"steady_recompile_programs": [],
+                      "mesh_pad_fraction_max": 0.0})
+        assert "SteadyStateRecompile" not in mgr.active
+        assert "MeshPaddingWasteHigh" not in mgr.active
+
+
+def _paper_system(symbols=("BTCUSDC", "ETHUSDC"), n_hist=600, **kw):
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.exchange import make_exchange
+    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+    series = {}
+    for i, sym in enumerate(symbols):
+        d = generate_ohlcv(n=n_hist + 64, seed=11 + i)
+        series[sym] = from_dict(
+            {k: v for k, v in d.items() if k != "regime"}, symbol=sym)
+    clock = {"t": 0.0}
+    ex = make_exchange("fake", series=series, quote_balance=10_000.0)
+    ex.advance(steps=n_hist)
+    system = TradingSystem(ex, list(symbols), now_fn=lambda: clock["t"],
+                           **kw)
+    # same compiled shape bucket as tests/test_tick_engine.py /
+    # test_stream.py (T=128): the soak exercises the REAL fused path
+    # without paying a fresh whole-universe compile per test run
+    system.monitor.kline_limit = 128
+    return system, ex, clock
+
+
+class TestLauncherIntegration:
+    def test_meshprof_default_off(self):
+        system, _, _ = _paper_system(enable_meshprof=False)
+        try:
+            assert system.meshprof is None
+            assert meshprof.active() is None
+        finally:
+            system.shutdown()
+
+    def test_steady_state_soak_and_state_json_mesh_block(self):
+        """The acceptance soak (scaled to tier-1): the fused tick path
+        under the observatory reports ZERO steady-state recompiles and
+        ZERO guarded transfers across a steady run, the launcher exports
+        the mesh gauges every tick, /state.json carries a `mesh` block
+        with the partitioner layout, and shutdown deactivates."""
+        from ai_crypto_trader_tpu.shell.dashboard_server import (
+            DashboardServer,
+        )
+
+        system, ex, clock = _paper_system(enable_meshprof=True)
+        server = DashboardServer(system, port=0).start()
+        try:
+            assert system.meshprof is meshprof.active()
+
+            async def soak():
+                for _ in range(8):
+                    ex.advance(steps=1)
+                    clock["t"] += 60.0
+                    await system.tick()
+
+            asyncio.run(soak())
+            mp = system.meshprof
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+            assert mp.transfers.total() == 0
+            # the fused tick path completed warm watch windows
+            assert mp.recompiles.windows.get("tick_engine", 0) >= 2
+            # per-tick export ran: imbalance + devices gauges live
+            text = system.metrics.exposition()
+            assert "mesh_devices" in text
+            assert "mesh_memory_imbalance" in text
+            # alert state is quiet at steady state
+            names = {a["name"] for a in AlertManager(
+                now_fn=lambda: 0.0).evaluate(system._alert_state())}
+            assert not (names & TestMeshAlertCoherence.MESH_ALERTS), names
+            # /state.json mesh block: partitioner layout + sentinel state
+            state = server.state()
+            assert "mesh" in state
+            assert state["mesh"]["partitioner"]["devices"] >= 1
+            assert "recompiles" in state["mesh"]
+        finally:
+            server.stop()
+            system.shutdown()
+        assert meshprof.active() is None
+
+    def test_state_json_partitioner_block_without_observatory(self):
+        """Satellite: the active layout is visible even with meshprof OFF
+        — operators can read mesh shape/device kinds without a REPL."""
+        from ai_crypto_trader_tpu.shell.dashboard_server import (
+            DashboardServer,
+        )
+
+        system, ex, clock = _paper_system(enable_meshprof=False)
+        server = DashboardServer(system, port=0)   # state() without start:
+        try:                                       # stop() must not hang
+            state = server.state()
+            assert "mesh" in state
+            assert state["mesh"]["partitioner"]["kind"] in (
+                "SingleDevicePartitioner", "MeshPartitioner")
+            # observatory off → no sentinel block, just the layout
+            assert "recompiles" not in state["mesh"]
+        finally:
+            server.stop()
+            system.shutdown()
+
+
+class TestCliSurface:
+    def test_cmd_mesh_prints_layout_and_pad_math(self, capsys):
+        from ai_crypto_trader_tpu.cli import cmd_mesh
+
+        class A:
+            pop = 10
+            url = None
+
+        cmd_mesh(A())
+        out = capsys.readouterr().out
+        assert "partitioner" in out
+        assert "pad_fraction" in out
+
+    def test_cmd_status_local_fallback(self, capsys):
+        from ai_crypto_trader_tpu.cli import cmd_status
+
+        class A:
+            url = None
+
+        cmd_status(A())
+        out = capsys.readouterr().out
+        assert '"live": false' in out
+        assert "partitioner" in out

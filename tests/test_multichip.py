@@ -23,3 +23,61 @@ def test_dryrun_multichip_8devices(capsys):
     __graft_entry__.dryrun_multichip(8)
     out = capsys.readouterr().out
     assert "dryrun_multichip(8) OK" in out
+
+
+def test_sharded_ga_locality_lands_in_bench_history(tmp_path):
+    """ISSUE 12 CI satellite: the per-device locality data a multi-chip
+    run produces (pad fraction, per-device members, all-gather bytes from
+    the meshprof layout card) lands in the bench-history payload the gate
+    consumes — the multichip trajectory carries locality, not just
+    throughput."""
+    if jax.device_count() < 8:
+        pytest.skip("needs >= 8 devices (virtual CPU mesh)")
+    import importlib.util
+    import os
+
+    import jax.numpy as jnp
+
+    from ai_crypto_trader_tpu.backtest.strategy import _HIGHS, _LOWS
+    from ai_crypto_trader_tpu.config import GAParams
+    from ai_crypto_trader_tpu.evolve import run_ga
+    from ai_crypto_trader_tpu.parallel import MeshPartitioner, make_mesh
+    from ai_crypto_trader_tpu.utils import meshprof
+
+    def fitness(p):                       # fresh closure → fresh program
+        g = jnp.stack(list(p))
+        span = jnp.asarray(_HIGHS - _LOWS, jnp.float32)
+        return -jnp.sum((g / span) ** 2)
+
+    mesh = make_mesh(data_parallel=8, model_parallel=1)
+    mp = meshprof.MeshProf()
+    cfg = GAParams(population_size=10, generations=2, elite_size=2)
+    with meshprof.use(mp):
+        run_ga(jax.random.PRNGKey(2), fitness, cfg,
+               partitioner=MeshPartitioner(mesh))
+    layout = mp.layouts["ga_scan"]
+    assert layout.devices == 8 and len(layout.device_names) == 8
+
+    # the exact stamping path bench_ga uses, against a private history
+    spec = importlib.util.spec_from_file_location(
+        "bench_mc_test", os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    row = {"metric": "ga_backtests_per_sec", "value": 123.0,
+           "unit": "backtests/s", "device_kind": "cpu",
+           "devices": layout.devices,
+           "pad_fraction": round(layout.pad_fraction, 4),
+           "members_per_device": layout.members_per_device,
+           "collective_bytes": layout.collective_bytes}
+    hist = tmp_path / "hist.jsonl"
+    bench.append_history([row], path=str(hist))
+    rows = bench.load_history(str(hist))
+    assert len(rows) == 1
+    rec = rows[0]
+    assert rec["devices"] == 8
+    assert rec["pad_fraction"] == 0.375          # pop 10 on 8 devices
+    assert rec["members_per_device"] == 2.0
+    assert rec["collective_bytes"] > 0
+    # the gate keys the sharded trajectory apart from 1-chip rows
+    assert bench._gate_key(rec)[-1] == 8

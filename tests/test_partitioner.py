@@ -37,7 +37,6 @@ from ai_crypto_trader_tpu.parallel import (
     match_partition_rules,
 )
 from ai_crypto_trader_tpu.utils import devprof
-from ai_crypto_trader_tpu.utils.tracing import JitCompileMonitor
 
 
 def _cheap_fitness(p):
@@ -158,11 +157,17 @@ class TestScannedGA:
     def test_one_dispatch_one_sync_zero_recompile(self, monkeypatch):
         """THE regression guard: a repeat run with the same (fitness, cfg,
         partitioner) must re-trace nothing and sync the host exactly once,
-        and the donated genome buffer must actually be consumed."""
+        and the donated genome buffer must actually be consumed.  The
+        zero-recompile assertion rides the meshprof RecompileSentinel —
+        the same watch-window counter the SteadyStateRecompile alert
+        pages on in production (utils/meshprof.py)."""
+        from ai_crypto_trader_tpu.utils import meshprof
+
         def fitness(p):                     # fresh closure → fresh program
             return _cheap_fitness(p)
 
         dp = devprof.DevProf()
+        mp = meshprof.MeshProf()
         syncs = {"n": 0}
         real_read = ga_mod.host_read
 
@@ -171,20 +176,25 @@ class TestScannedGA:
             return real_read(tree)
 
         monkeypatch.setattr(ga_mod, "host_read", counting_read)
-        with devprof.use(dp):
+        with devprof.use(dp), meshprof.use(mp):
             run_ga(jax.random.PRNGKey(0), fitness, CFG)   # compile run
             assert syncs["n"] == 1
             card = dp.cards["ga_scan"]
             assert card.error is None
             assert card.flops > 0
             assert card.donation_ok is True               # no silent copy
+            # the compile run is COLD (fresh program-cache entry): its
+            # compiles attribute to warmup, never to steady state
+            assert mp.recompiles.steady_total() == 0
 
-            jit_mon = JitCompileMonitor.install()
-            before = jit_mon.sample()
             _, hist = run_ga(jax.random.PRNGKey(1), fitness, CFG)
-            since = jit_mon.since(before)
-            assert since["compiles"] == 0, since          # zero recompiles
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()                    # zero recompiles
+            assert mp.recompiles.windows["ga_scan"] == 2
+            assert mp.transfers.total() == 0              # no guarded pulls
             assert syncs["n"] == 2                        # ONE more sync
+            # the single-device layout card rode the compile run
+            assert mp.layouts["ga_scan"].devices == 1
         assert len(hist) == CFG.generations
         assert all(np.isfinite(h["best_fitness"]) for h in hist)
 

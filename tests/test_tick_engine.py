@@ -193,7 +193,10 @@ class TestPollContract:
     uploads.  Tier-1 so a regression fails fast, and time-budgeted."""
 
     def test_one_dispatch_one_sync_no_recompile(self, monkeypatch):
-        from ai_crypto_trader_tpu.utils.tracing import JitCompileMonitor
+        # the zero-recompile assertion rides the meshprof RecompileSentinel
+        # (utils/meshprof.py) — the SAME watch-window counter production
+        # pages on — instead of an ad-hoc JitCompileMonitor sample
+        from ai_crypto_trader_tpu.utils import meshprof
 
         async def go():
             symbols = ("BTCUSDC", "ETHUSDC")
@@ -211,22 +214,25 @@ class TestPollContract:
                 return real_read(tree)
 
             monkeypatch.setattr(tick_engine, "host_read", counting_read)
-            assert await mon.poll(force=True) == 2     # seed + compile
-            assert syncs["n"] == 1
-            eng = mon._engine
-            assert eng.dispatch_count == 1
-            assert eng.last_stats["full_seed"]
+            mp = meshprof.MeshProf()
+            with meshprof.use(mp):
+                assert await mon.poll(force=True) == 2  # seed + compile
+                assert syncs["n"] == 1
+                eng = mon._engine
+                assert eng.dispatch_count == 1
+                assert eng.last_stats["full_seed"]
 
-            jit_mon = JitCompileMonitor.install()
-            before = jit_mon.sample()
-            ex.advance(steps=1)
-            clock["t"] += 60.0
-            import time as _time
-            t0 = _time.perf_counter()
-            assert await mon.poll() == 2               # steady state
-            steady_s = _time.perf_counter() - t0
-            since = jit_mon.since(before)
-            assert since["compiles"] == 0, since       # zero new compiles
+                ex.advance(steps=1)
+                clock["t"] += 60.0
+                import time as _time
+                t0 = _time.perf_counter()
+                assert await mon.poll() == 2            # steady state
+                steady_s = _time.perf_counter() - t0
+            # the sentinel attributed ZERO compiles to the steady window —
+            # the production invariant (SteadyStateRecompile) verbatim
+            assert mp.recompiles.steady_total() == 0, mp.recompiles.status()
+            assert mp.recompiles.windows["tick_engine"] == 2
+            assert mp.transfers.total() == 0           # no guarded pulls
             assert syncs["n"] == 2                     # ONE more host sync
             assert eng.dispatch_count == 2             # ONE more dispatch
             stats = eng.last_stats
