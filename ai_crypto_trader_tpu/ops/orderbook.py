@@ -17,6 +17,13 @@ Capability parity with OrderBookAnalyzer
 
 Input format: bids/asks as [N, 2] arrays of (price, size), bids sorted
 descending, asks ascending (exchange convention).
+
+`price_impact`, `find_walls` and `pressure_metrics` additionally accept
+leading batch dims (`[..., N, 2]`) — the `ops.volume_profile` treatment:
+the math runs per trailing book (vmapped internally where it reduces over
+levels), which is what lets the depth-frame calibration
+(`sim/calibrate.py`) and the LOB sweep analyze a whole capture window of
+books in one program instead of a Python loop.
 """
 
 from __future__ import annotations
@@ -49,14 +56,8 @@ def imbalance(bids: jnp.ndarray, asks: jnp.ndarray) -> dict:
     }
 
 
-@functools.partial(jax.jit, static_argnames=())
-def price_impact(levels: jnp.ndarray, trade_sizes: jnp.ndarray) -> jnp.ndarray:
-    """Impact (fraction of best price) of market orders of each quote-value
-    size walking one side of the book (:181-244).
-
-    For each size: find how deep the cumulative quote value reaches and
-    average the filled price. Returns [n_sizes] relative impact (NaN-free:
-    sizes exceeding total depth get the full-book impact)."""
+def _price_impact_1d(levels: jnp.ndarray,
+                     trade_sizes: jnp.ndarray) -> jnp.ndarray:
     values = levels[:, 0] * levels[:, 1]                   # quote value per level
     cum = jnp.cumsum(values)
 
@@ -73,22 +74,46 @@ def price_impact(levels: jnp.ndarray, trade_sizes: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=())
+def price_impact(levels: jnp.ndarray, trade_sizes: jnp.ndarray) -> jnp.ndarray:
+    """Impact (fraction of best price) of market orders of each quote-value
+    size walking one side of the book (:181-244).
+
+    For each size: find how deep the cumulative quote value reaches and
+    average the filled price. Returns [n_sizes] relative impact (NaN-free:
+    sizes exceeding total depth get the full-book impact).  Accepts
+    leading batch dims: ``[..., N, 2]`` books → ``[..., n_sizes]``."""
+    levels = jnp.asarray(levels)
+    if levels.ndim == 2:
+        return _price_impact_1d(levels, trade_sizes)
+    batch = levels.shape[:-2]
+    flat = levels.reshape((-1,) + levels.shape[-2:])
+    out = jax.vmap(lambda lv: _price_impact_1d(lv, trade_sizes))(flat)
+    return out.reshape(batch + out.shape[1:])
+
+
+@functools.partial(jax.jit, static_argnames=())
 def find_walls(levels: jnp.ndarray, multiple: float = 3.0):
-    """Wall mask: levels holding ≥ multiple × mean size (:245-292)."""
-    mean_size = jnp.mean(levels[:, 1])
-    return levels[:, 1] >= multiple * mean_size
+    """Wall mask: levels holding ≥ multiple × mean size (:245-292).
+    Batched over any leading dims (the mean is per trailing book)."""
+    levels = jnp.asarray(levels)
+    mean_size = jnp.mean(levels[..., 1], axis=-1, keepdims=True)
+    return levels[..., 1] >= multiple * mean_size
 
 
 @functools.partial(jax.jit, static_argnames=("near_levels",))
 def pressure_metrics(bids: jnp.ndarray, asks: jnp.ndarray,
                      near_levels: int = 5) -> dict:
     """Near-book pressure (:373-472): top-of-book volume ratios and the
-    weighted mid displacement."""
-    nb = jnp.sum(bids[:near_levels, 1])
-    na = jnp.sum(asks[:near_levels, 1])
+    weighted mid displacement.  Batched over any leading dims (every
+    reduction is over the trailing level axis)."""
+    bids, asks = jnp.asarray(bids), jnp.asarray(asks)
+    nb = jnp.sum(bids[..., :near_levels, 1], axis=-1)
+    na = jnp.sum(asks[..., :near_levels, 1], axis=-1)
     total = nb + na
-    micro = (bids[0, 0] * na + asks[0, 0] * nb) / jnp.where(total == 0, 1.0, total)
-    mid = (bids[0, 0] + asks[0, 0]) / 2.0
+    best_bid, best_ask = bids[..., 0, 0], asks[..., 0, 0]
+    micro = (best_bid * na + best_ask * nb) / jnp.where(total == 0, 1.0,
+                                                        total)
+    mid = (best_bid + best_ask) / 2.0
     return {
         "near_pressure": (nb - na) / jnp.where(total == 0, 1.0, total),
         "microprice": micro,

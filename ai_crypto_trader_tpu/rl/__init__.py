@@ -4,6 +4,7 @@ from ai_crypto_trader_tpu.rl.env import (  # noqa: F401
     env_reset,
     env_step,
     make_env_params,
+    obs_size,
 )
 from ai_crypto_trader_tpu.rl.dqn import (  # noqa: F401
     DQNConfig,

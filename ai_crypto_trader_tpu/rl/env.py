@@ -52,14 +52,29 @@ class EnvState(NamedTuple):
     scen: jnp.ndarray        # scenario row (0 on a single-path env)
 
 
+def obs_size(p: EnvParams) -> int:
+    """This env's observation width: the market-feature table plus the
+    two dynamic position features.  `OBS_SIZE` (10) is the default-table
+    constant; envs carrying extra book-state features (the
+    `sim/engine.scenario_env_params(dynamics="lob")` path) are wider —
+    size DQN nets with this, not the constant."""
+    return int(p.obs_table.shape[-1]) + 2
+
+
 def make_env_params(ind: dict, episode_len: int = 256,
-                    fee_rate: float = 0.0) -> EnvParams:
+                    fee_rate: float = 0.0,
+                    extra_features=None) -> EnvParams:
     """Build the feature table from a compute_indicators() dict.
 
     ``ind`` arrays may carry a leading scenario axis ([S, T] — the
     `sim/engine.scenario_env_params` path): the env then samples a
     scenario per episode on reset, so vmapped training sees S different
-    adversarial markets instead of one replayed history."""
+    adversarial markets instead of one replayed history.
+
+    ``extra_features`` ([(S,) T, E]) appends E market columns to the
+    table — the LOB's book-state features (spread, top-of-book depth)
+    ride here; `_observe` concatenates whatever width the table has, so
+    the program shape follows the table and nothing else changes."""
     close = ind["close"]
     ret1 = jnp.diff(close, prepend=close[..., :1], axis=-1) / close
     prev5 = jnp.roll(close, 5, axis=-1)
@@ -75,6 +90,8 @@ def make_env_params(ind: dict, episode_len: int = 256,
         jnp.clip(ret1 * 100.0, -1.0, 1.0),
         jnp.clip(ret5 * 100.0, -1.0, 1.0),
     ], axis=-1)
+    if extra_features is not None:
+        obs = jnp.concatenate([obs, jnp.asarray(extra_features)], axis=-1)
     return EnvParams(close=close, obs_table=obs.astype(jnp.float32),
                      episode_len=episode_len,
                      fee_rate=jnp.asarray(fee_rate, jnp.float32))

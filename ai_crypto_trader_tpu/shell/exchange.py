@@ -126,15 +126,48 @@ class ExchangeInterface(ABC):
         return []
 
 
+def load_depth_records(source) -> list[dict]:
+    """Depth records from a capture: a JSONL journal path (the
+    `utils/journal` record format `shell/stream.DepthCapture` writes — crc
+    verified, torn tail tolerated), a list of already-parsed record dicts,
+    or a `DepthCapture` instance (its ring).  Only SNAPSHOT records with
+    both book sides are kept: ``@depth`` diff records are per-level
+    CHANGES (zero-size removals included), not books — serving one as a
+    book would feed the analytics garbage.  Subscribe the ``@depth20``
+    snapshot channel for replayable/calibratable captures
+    (`binance_kline_url(depth_symbols=…)` does both)."""
+    if source is None:
+        return []
+    if hasattr(source, "records"):                  # a DepthCapture
+        records = source.records()
+    elif isinstance(source, str):
+        from ai_crypto_trader_tpu.utils.journal import replay
+
+        records = [r["data"] for r in replay(source)[0]
+                   if r.get("kind") == "depth"]
+    else:
+        records = list(source)
+    return [r for r in records
+            if r.get("bids") and r.get("asks")
+            and r.get("kind", "snapshot") == "snapshot"]
+
+
 class FakeExchange(ExchangeInterface):
     """Deterministic candle-replay exchange with a virtual clock.
 
     `advance()` moves to the next candle; open limit/stop orders are
     evaluated against each new candle's high/low, like a real matching
-    engine at candle granularity."""
+    engine at candle granularity.
+
+    ``depth_capture`` (a capture journal path, record list, or
+    DepthCapture) switches `get_order_book` from crc32-synthesized books
+    to REPLAYED captured depth — executor/analyzer tests run against
+    real book shapes (level spacing, size distributions, holes) instead
+    of the synthetic geometric ladder."""
 
     def __init__(self, series: dict[str, OHLCV], quote_balance: float = 10_000.0,
-                 fee_rate: float = 0.001, max_fill_base: float | None = None):
+                 fee_rate: float = 0.001, max_fill_base: float | None = None,
+                 depth_capture=None):
         self.series = series
         self.cursor = {s: 0 for s in series}
         self.balances: dict[str, float] = {"USDC": quote_balance}
@@ -143,6 +176,13 @@ class FakeExchange(ExchangeInterface):
         # at most this much per candle, the remainder stays OPEN — the
         # partial-fill reality grid/DCA reconciliation must survive.
         self.max_fill_base = max_fill_base
+        self.depth_records = load_depth_records(depth_capture)
+        # bucketed once: get_order_book runs per symbol per tick and must
+        # not rescan the whole capture on every call
+        self._depth_by_symbol: dict[str, list] = {}
+        for r in self.depth_records:
+            self._depth_by_symbol.setdefault(r.get("symbol", ""),
+                                             []).append(r)
         self.open_orders: dict[int, dict] = {}
         self.fills: list[dict] = []
         self._fills_by_oid: dict[int, list] = {}
@@ -171,8 +211,26 @@ class FakeExchange(ExchangeInterface):
     def get_order_book(self, symbol: str, limit: int = 20) -> dict:
         """Synthetic book around the candle close: geometric level spacing,
         sizes decaying with depth — enough structure for the order-book
-        analytics (imbalance/walls/impact) to chew on."""
+        analytics (imbalance/walls/impact) to chew on.
+
+        With a ``depth_capture`` attached, captured depth is REPLAYED
+        instead: the record is picked deterministically by the virtual
+        clock (cursor-indexed), so every consumer sees real book shapes
+        and repeated calls at the same cursor stay bit-identical.  Only
+        THIS symbol's records (or symbol-less ones — hand-built record
+        lists) replay; a symbol absent from the capture falls back to
+        the synthetic book rather than silently serving another
+        symbol's price scale as ``captured``."""
         c = self._candle(symbol)
+        mine = (self._depth_by_symbol.get(symbol)
+                or self._depth_by_symbol.get(""))
+        if mine:
+            rec = mine[self.cursor[symbol] % len(mine)]
+            return {"symbol": symbol,
+                    "bids": [list(lv) for lv in rec["bids"][:limit]],
+                    "asks": [list(lv) for lv in rec["asks"][:limit]],
+                    "timestamp": c["timestamp"],
+                    "captured": True, "capture_event_ms": rec.get("E", 0)}
         mid = c["close"]
         spread = max(mid * 1e-4, 1e-8)
         levels = np.arange(1, limit + 1)

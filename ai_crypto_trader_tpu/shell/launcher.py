@@ -698,6 +698,13 @@ class TradingSystem:
             # StreamDegradedToPoll input (PromQL twin: stream_mode == 0)
             state["stream_degraded"] = self._stream_degraded
             state["stream_staleness_s"] = self.stream.staleness(self.now_fn())
+            # depth-capture persistence health (DepthCaptureSaturated
+            # input; PromQL twin: depth_frames_dropped_total counting
+            # the unpersisted frames)
+            capture = getattr(self.stream.stream, "depth", None)
+            if capture is not None:
+                state["depth_journal_exhausted"] = capture.journal_exhausted
+                state["depth_ring_fill"] = capture.watermark
         if self.saturation is not None:
             # capacity observatory inputs: saturating stages (windowed,
             # min-sample gated), backpressured bus channels, loop lag
@@ -813,6 +820,10 @@ class TradingSystem:
             self.journal.close()           # flush the buffered tail
         if self.flightrec is not None:
             self.flightrec.close()         # flush the decision JSONL tail
+        if self.stream is not None:
+            capture = getattr(self.stream.stream, "depth", None)
+            if capture is not None:
+                capture.close()            # flush the depth JSONL tail
 
     async def run(self, duration_s: float | None = None,
                   tick_interval_s: float = 5.0):

@@ -56,11 +56,20 @@ BINANCE_WS = "wss://stream.binance.com:9443/ws/!miniTicker@arr"
 BINANCE_STREAM_BASE = "wss://stream.binance.com:9443/stream?streams="
 
 
-def binance_kline_url(symbols, intervals, base: str = BINANCE_STREAM_BASE) -> str:
+def binance_kline_url(symbols, intervals, base: str = BINANCE_STREAM_BASE,
+                      depth_symbols=()) -> str:
     """Combined-stream subscription URL for every (symbol × interval) kline
-    channel — the one-socket fan-in the supervisor reconnects."""
-    streams = "/".join(f"{s.lower()}@kline_{iv}"
-                       for s in symbols for iv in intervals)
+    channel — the one-socket fan-in the supervisor reconnects.
+
+    ``depth_symbols`` subscribes TWO capture channels each: ``@depth``
+    diffs (the full-fidelity recorder feed with update-id gap detection)
+    and ``@depth20`` partial snapshots — the book shapes calibration and
+    the FakeExchange replay seam consume (diffs are per-level CHANGES,
+    not books)."""
+    streams = "/".join([f"{s.lower()}@kline_{iv}"
+                        for s in symbols for iv in intervals]
+                       + [f"{s.lower()}@{ch}" for s in depth_symbols
+                          for ch in ("depth", "depth20")])
     return base + streams
 
 
@@ -98,6 +107,145 @@ def kline_frame(symbol: str, interval: str, row: list, *,
         return json.dumps({"stream": f"{symbol.lower()}@kline_{interval}",
                            "data": data})
     return json.dumps(data)
+
+
+def depth_frame(symbol: str, bids, asks, *, event_ms: int = 0,
+                first_id: int = 0, final_id: int = 0,
+                snapshot: bool = False, combined: bool = False) -> str:
+    """Build a Binance-format depth frame — ``@depth`` diff
+    (``depthUpdate``) by default, or a partial-book snapshot
+    (``lastUpdateId``) with ``snapshot=True``.  The transport twin of the
+    capture parser below; tests and the calibration fixtures generate
+    recorded feeds with it (zero egress)."""
+    px = lambda lv: [str(lv[0]), str(lv[1])]  # noqa: E731
+    if snapshot:
+        data: dict = {"lastUpdateId": int(final_id),
+                      "bids": [px(b) for b in bids],
+                      "asks": [px(a) for a in asks]}
+        stream = f"{symbol.lower()}@depth20"
+    else:
+        data = {"e": "depthUpdate", "E": int(event_ms), "s": symbol,
+                "U": int(first_id), "u": int(final_id),
+                "b": [px(b) for b in bids], "a": [px(a) for a in asks]}
+        stream = f"{symbol.lower()}@depth"
+    if combined:
+        return json.dumps({"stream": stream, "data": data})
+    return json.dumps(data)
+
+
+class DepthCapture:
+    """Bounded depth-frame capture: a drop-oldest ring plus an optional
+    checksummed JSONL journal in the `utils/journal` record format (the
+    flight-recorder pattern) — the raw material `sim/calibrate.py` fits
+    `FlowParams` from and `FakeExchange`'s replay seam serves back.
+
+    Both Binance depth shapes are recorded: ``@depth`` diffs
+    (``depthUpdate`` events, update-id continuity checked) and partial
+    snapshots (``lastUpdateId`` + top-N bids/asks).  Each record
+    normalizes to ``{"symbol", "kind", "E", "U", "u", "bids", "asks"}``
+    with float [price, size] levels.  Bounded on BOTH surfaces: the ring
+    by ``ring_max`` (drop-oldest — a capture burst must never grow host
+    memory; aging out of a keep-last-N ring is RETENTION, not loss, and
+    is not counted), the journal by ``journal_max`` records (bounded
+    disk).  ``frames_dropped`` counts real capture loss: frames that
+    arrived after a configured journal exhausted its budget and were
+    therefore never persisted — the `DepthFramesDropping` /
+    `DepthCaptureSaturated` alert input."""
+
+    def __init__(self, path: str | None = None, ring_max: int = 1024,
+                 journal_max: int = 100_000, symbols=None):
+        self.path = path
+        self.ring: deque = deque(maxlen=max(int(ring_max), 1))
+        self.ring_max = max(int(ring_max), 1)
+        self.journal_max = int(journal_max)
+        self.symbols = frozenset(symbols) if symbols else None
+        self.frames_total = 0
+        self.frames_dropped = 0          # unpersisted: journal exhausted
+        self.frames_ignored = 0          # off-universe symbol filter
+        self.malformed = 0
+        self.gaps = 0                    # diff update-id discontinuities
+        self.journaled = 0
+        self._journal = None
+        self._last_u: dict[str, int] = {}
+
+    @property
+    def watermark(self) -> float:
+        """Ring fill fraction (the `depth_capture_ring_fill` gauge) —
+        informational: a long-running capture sits at 1.0 by design
+        (keep-last-N); it is NOT an alert input."""
+        return len(self.ring) / self.ring_max
+
+    @property
+    def journal_exhausted(self) -> bool:
+        """True once a configured journal has spent its record budget —
+        new frames are no longer persisted (the `DepthCaptureSaturated`
+        alert input).  Always False without a journal (ring-only capture
+        never 'loses' what it never promised to keep)."""
+        return self.path is not None and self.journaled >= self.journal_max
+
+    def _normalize(self, payload: dict) -> dict | None:
+        try:
+            if payload.get("e") == "depthUpdate":
+                return {"symbol": payload["s"], "kind": "diff",
+                        "E": int(payload.get("E", 0)),
+                        "U": int(payload.get("U", 0)),
+                        "u": int(payload.get("u", 0)),
+                        "bids": [[float(p), float(q)]
+                                 for p, q in payload.get("b", [])],
+                        "asks": [[float(p), float(q)]
+                                 for p, q in payload.get("a", [])]}
+            if "lastUpdateId" in payload:
+                return {"symbol": payload.get("s", ""), "kind": "snapshot",
+                        "E": int(payload.get("E", 0)), "U": 0,
+                        "u": int(payload["lastUpdateId"]),
+                        "bids": [[float(p), float(q)]
+                                 for p, q in payload.get("bids", [])],
+                        "asks": [[float(p), float(q)]
+                                 for p, q in payload.get("asks", [])]}
+        except (KeyError, TypeError, ValueError):
+            return None
+        return None
+
+    def ingest(self, payload: dict) -> bool:
+        """Record one parsed depth payload; returns True when captured."""
+        rec = self._normalize(payload)
+        if rec is None:
+            self.malformed += 1
+            return False
+        if self.symbols is not None and rec["symbol"] not in self.symbols:
+            self.frames_ignored += 1
+            return False
+        self.frames_total += 1
+        if rec["kind"] == "diff" and rec["symbol"] in self._last_u:
+            # Binance diff contract: each event's U must be last u + 1;
+            # a break means lost frames — counted, never papered over
+            # (the _CandleBook continuity discipline, on the book feed)
+            if rec["U"] != self._last_u[rec["symbol"]] + 1:
+                self.gaps += 1
+        if rec["kind"] == "diff":
+            self._last_u[rec["symbol"]] = rec["u"]
+        self.ring.append(rec)            # deque evicts the oldest (bounded)
+        if self.path is not None:
+            if self.journaled < self.journal_max:
+                if self._journal is None:
+                    from ai_crypto_trader_tpu.utils.journal import (
+                        WriteAheadJournal,
+                    )
+                    self._journal = WriteAheadJournal(self.path)
+                self._journal.append("depth", rec)
+                self.journaled += 1
+            else:
+                self.frames_dropped += 1     # journal budget spent: the
+                #                              frame was never persisted
+        return True
+
+    def records(self) -> list[dict]:
+        """The ring's current contents, oldest first."""
+        return list(self.ring)
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
 
 
 class _CandleBook:
@@ -208,6 +356,10 @@ class MarketStream:
     # REST fetch — a once-seeded lane whose kline channel isn't in the
     # subscription must never freeze its indicators on stale rows
     book_fresh_s: float = 90.0
+    # bounded depth-frame capture (None = depth frames are ignored).  The
+    # capture rides the SAME parsed-frame path as klines/miniTickers, so
+    # a mixed combined-stream subscription needs no second socket.
+    depth: DepthCapture | None = None
     _last_seen: dict = field(default_factory=dict)
     # dict-backed ordered set: O(1) membership + insertion order preserved
     # (the old list scanned O(batch·pending) under burst load)
@@ -243,11 +395,17 @@ class MarketStream:
         except (json.JSONDecodeError, TypeError):
             self.malformed_frames += 1
             return []
+        stream_name = None
         if isinstance(payload, dict) and "stream" in payload:
+            # the envelope's stream name is the ONLY place a partial-depth
+            # snapshot carries its symbol — keep it for the depth path
+            stream_name = str(payload.get("stream") or "")
             payload = payload.get("data")        # combined-stream envelope
         if isinstance(payload, dict):
             if payload.get("e") == "kline":
                 return self._ingest_kline(payload)
+            if payload.get("e") == "depthUpdate" or "lastUpdateId" in payload:
+                return self._ingest_depth(payload, stream_name)
             payload = payload.get("data", [])    # legacy {"data": [...]}
         if not isinstance(payload, list):
             self.malformed_frames += 1
@@ -397,6 +555,22 @@ class MarketStream:
         if self._mark_dirty(symbol, now, force=(closed
                                                 or status == "seed_needed")):
             return [symbol]
+        return []
+
+    def _ingest_depth(self, payload: dict,
+                      stream_name: str | None = None) -> list[str]:
+        """Route one depth frame into the capture (never into the candle
+        path — depth is flight-recorder material, not a market-data
+        publication; no symbols are marked dirty).  Snapshot payloads
+        carry no symbol field of their own — recover it from the
+        combined-stream channel name (``btcusdc@depth20``)."""
+        if self.depth is None:
+            self.frames_ignored += 1             # no capture configured
+            return []
+        if "s" not in payload and stream_name:
+            payload = {**payload,
+                       "s": stream_name.split("@", 1)[0].upper()}
+        self.depth.ingest(payload)               # counts its own outcomes
         return []
 
     def _book(self, symbol: str, interval: str) -> _CandleBook:
@@ -701,6 +875,17 @@ class StreamSupervisor:
               d("stream_out_of_order_total", st.ooo_frames))
         m.inc("stream_malformed_frames_total",
               d("stream_malformed_frames_total", st.malformed_frames))
+        dc = st.depth
+        if dc is not None:
+            # depth-capture telemetry rides the same export: totals as
+            # monotonic counters, the ring watermark as a gauge (the
+            # leading indicator the DepthCaptureSaturated alert watches)
+            m.inc("depth_frames_total",
+                  d("depth_frames_total", dc.frames_total))
+            m.inc("depth_frames_dropped_total",
+                  d("depth_frames_dropped_total", dc.frames_dropped))
+            m.inc("depth_gaps_total", d("depth_gaps_total", dc.gaps))
+            m.set_gauge("depth_capture_ring_fill", dc.watermark)
 
     # -- the wall-clock transport loop ----------------------------------------
     def _backoff_delay(self) -> float:

@@ -16,9 +16,16 @@ Layering (host → device):
   engine.py      the vmapped strategy-vs-market rollout: ONE jitted
                  dispatch for the whole scenario batch, donated
                  schedules, one host readback, devprof cost card
+  lob.py         the limit-order book: [L] levels per side, queue
+                 position, order-flow agents (FlowParams), FakeExchange
+                 parity at top-of-book, `lob_sweep` behind the
+                 Partitioner seam (JAX-LOB, arXiv:2308.13289)
+  calibrate.py   fits FlowParams from captured depth frames
+                 (shell/stream.DepthCapture) — arrival rates, depth
+                 profiles, cancel ratios, spread geometry
 
 See docs/SIMULATOR.md for the scenario spec, the parity-oracle pattern,
-and bench rows.
+the LOB + calibration loop, and bench rows.
 """
 
 from ai_crypto_trader_tpu.sim.scenarios import (  # noqa: F401
@@ -32,3 +39,7 @@ from ai_crypto_trader_tpu.sim.scenarios import (  # noqa: F401
     preset,
     preset_names,
 )
+# NOTE: lob/calibrate/engine are NOT imported here on purpose — this
+# package surface stays numpy-only (the scenario layer) so jax-free
+# consumers (the bench gate, docs jobs) can import it; reach the traced
+# layers via their submodules (`from ai_crypto_trader_tpu.sim import lob`).

@@ -418,12 +418,18 @@ def _stress_backtest_jit(candles: dict, params, initial_balance,
 def backtest_under_stress(key, scenario="mixed", num_scenarios: int = 256,
                           steps: int = 1024, params=None,
                           initial_balance: float = 10_000.0,
-                          seed: int = 0):
+                          seed: int = 0, dynamics: str = "gbm",
+                          flow=None):
     """Evaluate the real backtest engine over a batch of adversarial
     markets: [B] stats (or [B, P] with a stacked StrategyParams
     population) — scenario-quantile robustness instead of one historical
     path.  Returns (stats, summary) with host-side robustness quantiles.
-    """
+
+    ``dynamics`` picks the market generator: ``"gbm"`` (regime GBM paths)
+    or ``"lob"`` — candles emitted by the order-flow limit-order book
+    (`sim/lob.lob_candles`, optionally with calibrated ``flow`` params),
+    so the stress presets reshape the microstructure (thin books, wide
+    spreads) the backtest trades through, not just the price path."""
     if isinstance(scenario, scenarios.ShockSchedule):
         sched, labels = scenario, None
     else:
@@ -431,7 +437,7 @@ def backtest_under_stress(key, scenario="mixed", num_scenarios: int = 256,
             [scenario] if isinstance(scenario, str) else list(scenario))
         sched, labels = scenarios.mixed_schedules(names, num_scenarios,
                                                   steps, seed=seed)
-    candles = paths.gbm_candles(key, sched)
+    candles = _stress_candles(key, sched, dynamics, flow)
     population = (params is not None
                   and jax.tree.leaves(params)[0].ndim >= 1)
     stats = _stress_backtest_jit(
@@ -450,17 +456,38 @@ def backtest_under_stress(key, scenario="mixed", num_scenarios: int = 256,
     return stats, summary
 
 
+def _stress_candles(key, sched, dynamics: str, flow):
+    """Candle batch for the stress workloads: GBM paths or the LOB's
+    order-flow markets (lazy import — lob.py imports from this module)."""
+    if dynamics == "gbm":
+        return paths.gbm_candles(key, sched)
+    if dynamics == "lob":
+        from ai_crypto_trader_tpu.sim import lob
+
+        return lob.lob_candles(key, sched, flow=flow)
+    raise ValueError(f"unknown market dynamics {dynamics!r} "
+                     "(expected 'gbm' or 'lob')")
+
+
 # --------------------------------------------------------------------------
 # workload 3: a scenario-diverse RL environment
 # --------------------------------------------------------------------------
 
 def scenario_env_params(key, scenario="mixed", num_scenarios: int = 64,
                         steps: int = 1024, episode_len: int = 256,
-                        fee_rate: float = 0.0, seed: int = 0):
+                        fee_rate: float = 0.0, seed: int = 0,
+                        dynamics: str = "gbm", flow=None):
     """Build `rl/env.py` EnvParams whose close/obs tables carry a leading
     scenario axis: every `env_reset` draws (scenario, start offset), so a
     vmapped DQN rollout trains against flash crashes and liquidity holes,
-    not just the one historical path.  Returns (EnvParams, labels)."""
+    not just the one historical path.  Returns (EnvParams, labels).
+
+    ``dynamics="lob"`` generates the markets from the order-flow book
+    AND appends two book-state columns to the observation table — the
+    relative spread (per mille) and the top-of-book depth normalized by
+    the flow's steady-state depth — so the policy can SEE the
+    microstructure regime it is trading through.  The env observation
+    widens; size networks with `rl.env.obs_size(params)`."""
     from ai_crypto_trader_tpu import ops
     from ai_crypto_trader_tpu.rl.env import make_env_params
 
@@ -468,8 +495,16 @@ def scenario_env_params(key, scenario="mixed", num_scenarios: int = 64,
         [scenario] if isinstance(scenario, str) else list(scenario))
     sched, labels = scenarios.mixed_schedules(names, num_scenarios, steps,
                                               seed=seed)
-    candles = paths.gbm_candles(key, sched)
+    candles = _stress_candles(key, sched, dynamics, flow)
     ind = ops.compute_indicators(
         {k: candles[k] for k in ("open", "high", "low", "close", "volume")})
+    extra = None
+    if dynamics == "lob":
+        from ai_crypto_trader_tpu.sim import lob
+
+        fl = flow or lob.flow_params()
+        steady = fl.limit_rate / jnp.maximum(fl.cancel_rate, 1e-6)
+        extra = jnp.stack([candles["spread"] * 1e3,
+                           jnp.tanh(candles["cap"] / steady)], axis=-1)
     return make_env_params(ind, episode_len=episode_len,
-                           fee_rate=fee_rate), labels
+                           fee_rate=fee_rate, extra_features=extra), labels

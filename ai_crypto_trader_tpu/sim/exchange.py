@@ -152,7 +152,7 @@ def settle_pending(s: ExchState, candle: dict, t, fee_rate, spread, halt):
 
 
 def match_candle(s: ExchState, candle: dict, t, liquidity_cap, halt,
-                 fee_rate):
+                 fee_rate, gate=None):
     """Match every resting slot against the candle, in slot order —
     FakeExchange._match_orders, vectorized over the batch but unrolled
     over the (small, static) K slots so each fill sees the balances the
@@ -162,7 +162,13 @@ def match_candle(s: ExchState, candle: dict, t, liquidity_cap, halt,
     (FakeExchange.max_fill_base × the schedule's liquidity_mult; inf = no
     cap): a capped fill leaves the remainder resting — partial-fill
     carryover.  A REJECTED fill (insufficient balance) leaves the order
-    resting untouched, exactly like the oracle."""
+    resting untouched, exactly like the oracle.
+
+    ``gate`` ([K] bool, optional) is an extra per-slot fill precondition
+    on top of the price trigger — the LOB's queue-position seam
+    (sim/lob.py): a resting LIMIT whose queue ahead is not yet consumed is
+    price-triggered but gated.  ``None`` (every caller outside the LOB)
+    traces to exactly the ungated program."""
     K = s.book.active.shape[0]
     low, high = candle["low"], candle["high"]
     for k in range(K):
@@ -176,6 +182,8 @@ def match_candle(s: ExchState, candle: dict, t, liquidity_cap, halt,
         price = jnp.where(kind == STOP,
                           jnp.where(lp > 0.0, lp, sp), lp)
         trig = b.active[k] & (halt == 0.0) & (limit_trig | stop_trig)
+        if gate is not None:
+            trig = trig & gate[k]
         fill_qty = jnp.minimum(b.qty[k], liquidity_cap)
         s, ok = _fill(s, t, k + 1, side,
                       jnp.where(trig, fill_qty, 0.0), price, fee_rate)

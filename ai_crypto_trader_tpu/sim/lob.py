@@ -1,0 +1,633 @@
+"""Device-resident limit-order book with stochastic order-flow agents.
+
+The candle simulator (`sim/engine.py`) matches at candle granularity, so
+queue position, partial-depth sweeps and book-shape microstructure —
+everything `ops/orderbook.py` knows how to *analyze* — could not be
+*generated* or traded against.  This module closes that gap the JAX-LOB
+way (arXiv:2308.13289): the whole book is a fixed-shape array program —
+[L] price levels per side on a relative tick grid around the mid, with
+queue-position arrays for the agent's resting orders — stepped inside a
+`lax.scan`, vmapped over a [B] scenario axis, and routed through the
+`Partitioner.population_eval` seam so the sweep shards over the mesh data
+axis exactly like the GA and backtest sweeps (FinRL-Podracer, arXiv:
+2111.05188: keep the whole scenario population device-resident).
+
+Model (a Cont-style zero-intelligence flow, every knob an array param —
+`FlowParams` — so calibration from captured depth is a pure fit):
+
+  * **Grid**  bid level i sits at ``mid·(1 − tick·(s + i))``, ask level i
+    at ``mid·(1 + tick·(s + i))`` where ``s`` is the half-spread in ticks.
+    When the mid moves m ticks the level arrays shift by m (vacated
+    levels refill through arrivals) — the book is always exactly [L]
+    levels per side, never crossed by construction.
+  * **Flow agents** per step and side: limit-order arrivals of expected
+    size ``limit_rate · exp(−depth_decay·i)`` per level (mean-preserving
+    lognormal noise), proportional cancels of expected fraction
+    ``cancel_rate``, and with probability ``market_rate`` a market order
+    of mean size ``market_size`` that sweeps the opposite side
+    level-by-level (deterministic price-time matching: the cumulative-sum
+    walk of `ops.orderbook.price_impact`, as a state update).
+  * **Scenario channels drive the FLOW, not just prices** (the
+    ShockSchedule mapping documented in sim/scenarios.py): a liquidity
+    hole scales arrivals toward zero so the book thins out; a spread
+    blowout widens the quoted half-spread; logret/vol move the mid; halt
+    freezes the venue; latency parks market orders — so the stress
+    presets reshape the *microstructure* the agent trades against.
+
+**FakeExchange parity at top-of-book.**  Each step emits a candle of the
+mid path (open/close = mid before/after, high/low extended by the sweep
+extremes — prices that actually traded), the measured relative spread
+(market BUYs pay the ask, SELLs receive the bid — the `sim/exchange.py`
+spread convention, here *measured* from the book instead of scheduled)
+and the measured top-of-book liquidity cap (the per-candle partial-fill
+cap, measured instead of scheduled).  The agent's execution then reuses
+`sim/exchange.py` verbatim — `settle_pending` / `match_candle` /
+`apply_action` — with ONE addition: a queue gate on resting LIMITs
+(`queue_frac` of the standing level size must be consumed by traded flow
+before the order fills; ``queue_frac=0`` is bit-identical to the ungated
+program).  tests/test_lob.py pins a single-scenario rollout
+trade-by-trade against FakeExchange driven through the identical
+decisions on the emitted candle/cap/spread series (the parity-oracle
+pattern of tests/test_sim.py), across calm / liquidity_hole /
+spread_blowout presets.
+
+The agent is a price-taker whose own fills are NOT fed back into the
+book state — the same one-way coupling FakeExchange has, and the
+property that makes trade-by-trade parity well-defined.
+
+`lob_sweep` is the one-dispatch entry: B scenarios × T steps as one
+compiled program behind the partitioner, schedule buffers donated and
+aliased onto the [B, T] outputs, ONE [B]-sized host readback, `lob_sweep`
+devprof cost card + donation verification, meshprof recompile/transfer
+sentinel — the same contract every hot program in the repo meets.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_crypto_trader_tpu.sim import exchange as sx
+from ai_crypto_trader_tpu.sim import scenarios
+from ai_crypto_trader_tpu.sim.engine import (
+    N_SLOTS,
+    SimStrategy,
+    StratState,
+    _requests_to_action,
+    _strategy_step,
+    default_strategy,
+)
+from ai_crypto_trader_tpu.utils import devprof, meshprof
+
+DEFAULT_LEVELS = 32
+
+# (scenarios, steps, levels, log_capacity, devices) shapes already
+# dispatched once — the LOB sweep's cold-run ledger for the recompile
+# sentinel (the sim/engine.py pattern)
+_LOB_SHAPES_SEEN: set = set()
+
+
+def host_read(tree):
+    """THE per-sweep device→host sync (module seam so tests can count it;
+    the tick-engine / sim-sweep pattern)."""
+    t0 = time.perf_counter()
+    with meshprof.allow_transfers():   # THE sanctioned device→host sync
+        out = jax.device_get(tree)
+    devprof.observe_latency("host_read", time.perf_counter() - t0)
+    return out
+
+
+class FlowParams(NamedTuple):
+    """Order-flow agent knobs, all f32 scalars (broadcastable — a [B]
+    batch of flows vmaps like the market does).  These are exactly the
+    quantities `sim/calibrate.py` fits from captured depth frames.
+
+    limit_rate    expected limit-order arrival size (base units) per step
+                  per side at level 0; level i receives
+                  ``limit_rate · exp(−depth_decay·i)``
+    depth_decay   exponential decay of the arrival depth profile
+    cancel_rate   expected fraction of each level's standing size
+                  cancelled per step (meaningful ≤ 0.5: the uniform
+                  draw ``clip(2c·u, 0, 1)`` is mean-c only there —
+                  `sim/calibrate.py` clips its fit accordingly)
+    market_rate   probability of a market order per step per side
+    market_size   mean market-order size (base units)
+    size_sigma    lognormal sigma of arrival/market size noise
+                  (mean-preserving: ``exp(σz − σ²/2)``)
+    tick          relative tick size (price step / mid)
+    spread0       baseline half-spread in ticks (floor; the schedule's
+                  spread channel can only widen it)
+    queue_frac    0..1 — fraction of the standing level size counted as
+                  queue AHEAD of a newly placed agent limit (0 = arrive
+                  at the front: FakeExchange parity semantics)
+    mid0          initial mid price
+    drift         per-step log-drift of the mid
+    vol           per-step log-vol of the mid (scaled by the schedule's
+                  vol_mult channel)
+    """
+
+    limit_rate: jnp.ndarray
+    depth_decay: jnp.ndarray
+    cancel_rate: jnp.ndarray
+    market_rate: jnp.ndarray
+    market_size: jnp.ndarray
+    size_sigma: jnp.ndarray
+    tick: jnp.ndarray
+    spread0: jnp.ndarray
+    queue_frac: jnp.ndarray
+    mid0: jnp.ndarray
+    drift: jnp.ndarray
+    vol: jnp.ndarray
+
+
+def flow_params(limit_rate: float = 2.0, depth_decay: float = 0.12,
+                cancel_rate: float = 0.08, market_rate: float = 0.35,
+                market_size: float = 4.0, size_sigma: float = 0.8,
+                tick: float = 1e-4, spread0: float = 1.0,
+                queue_frac: float = 0.0, mid0: float = 40_000.0,
+                drift: float = 0.0, vol: float = 0.0015) -> FlowParams:
+    """Defaults give a liquid, mildly noisy book: steady-state depth
+    ``limit_rate/cancel_rate = 25`` base units at the touch, decaying over
+    ~8 levels, with market orders turning over a few units per step."""
+    f = lambda v: jnp.asarray(v, jnp.float32)  # noqa: E731
+    return FlowParams(limit_rate=f(limit_rate), depth_decay=f(depth_decay),
+                      cancel_rate=f(cancel_rate), market_rate=f(market_rate),
+                      market_size=f(market_size), size_sigma=f(size_sigma),
+                      tick=f(tick), spread0=f(spread0),
+                      queue_frac=f(queue_frac), mid0=f(mid0),
+                      drift=f(drift), vol=f(vol))
+
+
+class LobState(NamedTuple):
+    """One scenario's book: the mid anchor, the half-spread in ticks, and
+    [L] sizes per side on the relative tick grid."""
+
+    mid: jnp.ndarray        # f32 mid price
+    s_ticks: jnp.ndarray    # f32 half-spread in ticks
+    bid_sz: jnp.ndarray     # [L] f32
+    ask_sz: jnp.ndarray     # [L] f32
+
+
+class LobSummary(NamedTuple):
+    """Per-scenario outcomes, every leaf [B] (the RolloutSummary shape
+    plus the book-microstructure aggregates)."""
+
+    final_equity: jnp.ndarray
+    final_quote: jnp.ndarray
+    final_base: jnp.ndarray
+    fees: jnp.ndarray
+    n_fills: jnp.ndarray
+    dropped_fills: jnp.ndarray
+    entries: jnp.ndarray
+    max_drawdown: jnp.ndarray
+    min_equity: jnp.ndarray
+    mean_spread: jnp.ndarray      # mean relative bid-ask spread
+    mean_top_depth: jnp.ndarray   # mean top-of-book size (bid side)
+    traded_volume: jnp.ndarray    # exogenous market-order volume filled
+
+
+def init_book(flow: FlowParams, levels: int = DEFAULT_LEVELS) -> LobState:
+    """Steady-state seed: arrivals/cancels balance at
+    ``limit_rate·profile/cancel_rate`` per level."""
+    prof = depth_profile(flow, levels)
+    steady = flow.limit_rate * prof / jnp.maximum(flow.cancel_rate, 1e-6)
+    return LobState(mid=flow.mid0, s_ticks=flow.spread0,
+                    bid_sz=steady, ask_sz=steady)
+
+
+def depth_profile(flow: FlowParams, levels: int) -> jnp.ndarray:
+    """[L] arrival depth profile ``exp(−depth_decay·i)``."""
+    return jnp.exp(-flow.depth_decay * jnp.arange(levels, dtype=jnp.float32))
+
+
+def _shift_zero(arr, m):
+    """Shift level sizes to index ``i+m`` (m traced, either sign),
+    zero-filling vacated levels — the grid roll when the mid moves m
+    ticks."""
+    L = arr.shape[-1]
+    idx = jnp.arange(L)
+    src = idx - m
+    valid = (src >= 0) & (src < L)
+    return jnp.where(valid, arr[jnp.clip(src, 0, L - 1)], 0.0)
+
+
+def _sweep_side(sizes, m):
+    """Consume ``m`` base units from level 0 upward (deterministic
+    price-time matching: best price first, full level before the next) —
+    the cumulative-sum walk of `ops.orderbook.price_impact` as a state
+    update.  Returns (sizes', take[L], filled, deepest-touched level)."""
+    cum = jnp.cumsum(sizes)
+    prev = cum - sizes
+    take = jnp.clip(m - prev, 0.0, sizes)
+    filled = jnp.minimum(m, cum[-1])
+    touched = take > 0.0
+    deepest = jnp.max(jnp.where(touched, jnp.arange(sizes.shape[0]), 0))
+    return sizes - take, take, filled, deepest
+
+
+def _lognorm(key, sigma, shape=()):
+    """Mean-1 lognormal noise ``exp(σz − σ²/2)`` — mean-preserving so the
+    calibration fit recovers the rate parameters directly."""
+    z = jax.random.normal(key, shape)
+    return jnp.exp(sigma * z - 0.5 * sigma * sigma)
+
+
+def _level_of(price, mid, s_ticks, tick, side):
+    """Grid level index of an absolute price: offset in ticks from the
+    mid, minus the half-spread.  ``side`` +1 = ask grid (above mid),
+    -1 = bid grid (below)."""
+    off = jnp.where(side > 0, price / mid - 1.0, 1.0 - price / mid) / tick
+    return jnp.round(off - s_ticks).astype(jnp.int32)
+
+
+def flow_step(book: LobState, key, sched_t: dict, flow: FlowParams):
+    """One step of exogenous book evolution.  Returns the new book plus
+    the step's market view: a candle dict (open/high/low/close/volume),
+    the measured relative spread, the measured top-of-book cap, and the
+    per-level traded volume (the queue-decrement signal).
+
+    A halted candle freezes the book entirely (the venue is unreachable —
+    no arrivals, no cancels, no trades), matching the exchange-outage
+    semantics of `sim/exchange.py`."""
+    L = book.bid_sz.shape[0]
+    k_mid, k_arr, k_can, k_mkt = jax.random.split(key, 4)
+    halt = sched_t["halt"]
+    live = halt == 0.0
+
+    # 1. mid path: exogenous fundamental (schedule crash/vol channels)
+    ret = (flow.drift + sched_t["logret_shift"]
+           + flow.vol * sched_t["vol_mult"] * jax.random.normal(k_mid))
+    mid_new = book.mid * jnp.exp(jnp.where(live, ret, 0.0))
+    m_ticks = jnp.round((mid_new / book.mid - 1.0) / flow.tick).astype(
+        jnp.int32)
+    # grid roll: mid up m ticks → bid offsets grow by m, ask offsets
+    # shrink by m (deep asks come into range empty; arrivals refill)
+    bid_sz = _shift_zero(book.bid_sz, m_ticks)
+    ask_sz = _shift_zero(book.ask_sz, -m_ticks)
+
+    # 2. spread target: the schedule's full relative spread, floored at
+    # the baseline — a spread blowout WIDENS the book, per-candle
+    s_ticks = jnp.maximum(flow.spread0,
+                          sched_t["spread"] / (2.0 * flow.tick))
+
+    # 3. cancels: each level loses a uniform fraction, mean cancel_rate
+    u = jax.random.uniform(k_can, (2, L))
+    frac = jnp.clip(2.0 * flow.cancel_rate * u, 0.0, 1.0)
+    bid_sz = bid_sz * jnp.where(live, 1.0 - frac[0], 1.0)
+    ask_sz = ask_sz * jnp.where(live, 1.0 - frac[1], 1.0)
+
+    # 4. limit arrivals: rate × depth profile × mean-1 noise, scaled by
+    # the liquidity channel — a liquidity hole starves the book
+    prof = depth_profile(flow, L)
+    noise = _lognorm(k_arr, flow.size_sigma, (2, L))
+    arr_scale = flow.limit_rate * sched_t["liquidity_mult"]
+    bid_sz = bid_sz + jnp.where(live, arr_scale * prof * noise[0], 0.0)
+    ask_sz = ask_sz + jnp.where(live, arr_scale * prof * noise[1], 0.0)
+
+    # 5. market orders: bernoulli arrival per side, lognormal size,
+    # swept deterministically through the opposite side's levels
+    k_b, k_s, k_bs, k_ss = jax.random.split(k_mkt, 4)
+    want_buy = jax.random.uniform(k_b) < flow.market_rate
+    want_sell = jax.random.uniform(k_s) < flow.market_rate
+    m_buy = jnp.where(want_buy & live,
+                      flow.market_size * _lognorm(k_bs, flow.size_sigma), 0.0)
+    m_sell = jnp.where(want_sell & live,
+                       flow.market_size * _lognorm(k_ss, flow.size_sigma),
+                       0.0)
+    ask_sz, take_ask, filled_buy, deep_buy = _sweep_side(ask_sz, m_buy)
+    bid_sz, take_bid, filled_sell, deep_sell = _sweep_side(bid_sz, m_sell)
+
+    book2 = LobState(mid=mid_new, s_ticks=s_ticks,
+                     bid_sz=bid_sz, ask_sz=ask_sz)
+
+    # 6. the step's market view: mid candle extended by traded extremes
+    tick_abs = flow.tick
+    ask_extreme = mid_new * (1.0 + tick_abs * (s_ticks + deep_buy))
+    bid_extreme = mid_new * (1.0 - tick_abs * (s_ticks + deep_sell))
+    open_, close = book.mid, mid_new
+    high = jnp.maximum(jnp.maximum(open_, close),
+                       jnp.where(filled_buy > 0, ask_extreme, close))
+    low = jnp.minimum(jnp.minimum(open_, close),
+                      jnp.where(filled_sell > 0, bid_extreme, close))
+    volume = filled_buy + filled_sell + 1e-3 * flow.market_size
+    candle = {"open": open_, "high": high, "low": low, "close": close,
+              "volume": volume}
+    spread_rel = 2.0 * flow.tick * s_ticks       # measured full spread
+    cap = bid_sz[0]                              # measured touch liquidity
+    return book2, candle, spread_rel, cap, take_ask, take_bid
+
+
+def _queue_update(exch: sx.ExchState, queue_ahead, book: LobState,
+                  flow: FlowParams, take_ask, take_bid):
+    """Decrement each resting LIMIT's queue by the volume traded at (or
+    beyond) its price level this step — price-time priority: flow that
+    swept PAST the level consumed everything standing at it."""
+    L = take_ask.shape[0]
+    idx = jnp.arange(L)
+
+    def eaten_for(k):
+        b = exch.book
+        lvl = _level_of(b.limit_price[k], book.mid, book.s_ticks,
+                        flow.tick, -b.side[k])   # SELL rests on ask side
+        take = jnp.where(b.side[k] < 0, take_ask, take_bid)
+        return jnp.sum(jnp.where(idx >= lvl, take, 0.0))
+
+    K = queue_ahead.shape[0]
+    eaten = jnp.stack([eaten_for(k) for k in range(K)])
+    live = exch.book.active & (exch.book.kind == sx.LIMIT)
+    return jnp.where(live, jnp.maximum(queue_ahead - eaten, 0.0), 0.0)
+
+
+def _queue_seed(exch_before: sx.ExchState, exch_after: sx.ExchState,
+                queue_ahead, book: LobState, flow: FlowParams):
+    """A newly placed LIMIT joins the back of its level's queue:
+    ``queue_frac`` of the standing exogenous size at that level is ahead
+    of it.  ``queue_frac=0`` → front of queue (parity semantics)."""
+    L = book.ask_sz.shape[0]
+    placed = exch_after.book.active & ~exch_before.book.active \
+        & (exch_after.book.kind == sx.LIMIT)
+
+    def standing(k):
+        b = exch_after.book
+        lvl = _level_of(b.limit_price[k], book.mid, book.s_ticks,
+                        flow.tick, -b.side[k])
+        sz = jnp.where(b.side[k] < 0, book.ask_sz, book.bid_sz)
+        on_grid = (lvl >= 0) & (lvl < L)
+        return jnp.where(on_grid, sz[jnp.clip(lvl, 0, L - 1)], 0.0)
+
+    K = queue_ahead.shape[0]
+    ahead = jnp.stack([standing(k) for k in range(K)]) * flow.queue_frac
+    return jnp.where(placed, ahead, queue_ahead)
+
+
+def _rollout_one(base_key, scen_id, sched_row: dict, flow: FlowParams,
+                 strat: SimStrategy, fee_rate, quote0, levels: int,
+                 log_capacity: int, return_book: bool):
+    """One scenario's full LOB rollout: a replicated base key + this
+    scenario's integer id (per-step keys derive on device via
+    ``fold_in`` — nothing key-shaped crosses the host link) + [T]
+    schedule channels in, (summary, fills, per-step series) out.
+    Vmapped over B."""
+    T = sched_row["halt"].shape[-1]
+    keys_t = jax.random.split(jax.random.fold_in(base_key, scen_id), T)
+    book0 = init_book(flow, levels)
+    exch0 = sx.init_state(quote0, K=N_SLOTS, L=log_capacity)
+    qa0 = jnp.zeros((N_SLOTS,), jnp.float32)
+    st0 = StratState(ema_fast=jnp.asarray(0.0, jnp.float32),
+                     ema_slow=jnp.asarray(0.0, jnp.float32),
+                     entry=jnp.asarray(0.0, jnp.float32),
+                     entries=jnp.asarray(0, jnp.int32))
+    eq0 = sx.equity(exch0, flow.mid0)
+    acct0 = (eq0, jnp.asarray(0.0, jnp.float32), eq0)
+
+    def step(carry, xs):
+        book, exch, st, qa, (peak, max_dd, min_eq) = carry
+        key_t, sched_t, t = xs
+        halt, latency = sched_t["halt"], sched_t["latency"]
+
+        book, candle, spread, cap, take_ask, take_bid = flow_step(
+            book, key_t, sched_t, flow)
+        # price-time queue progress BEFORE matching: the flow that traded
+        # this step is what consumed the queue ahead of the agent
+        qa = _queue_update(exch, qa, book, flow, take_ask, take_bid)
+        gate = (exch.book.kind != sx.LIMIT) | (qa <= 0.0)
+
+        exch = sx.settle_pending(exch, candle, t, fee_rate, spread, halt)
+        exch = sx.match_candle(exch, candle, t, cap, halt, fee_rate,
+                               gate=gate)
+        st, req = _strategy_step(strat, st, exch, candle["close"], t, halt)
+        before = exch
+        exch = sx.apply_action(exch, candle, t,
+                               _requests_to_action(exch, req),
+                               fee_rate, spread, halt, latency)
+        qa = _queue_seed(before, exch, qa, book, flow)
+
+        eq = sx.equity(exch, candle["close"])
+        peak = jnp.maximum(peak, eq)
+        acct = (peak, jnp.maximum(max_dd, (peak - eq) / peak),
+                jnp.minimum(min_eq, eq))
+        ys = {"equity": eq, "spread": spread, "cap": cap,
+              "candle": candle}
+        if return_book:
+            ys["bid_sz"] = book.bid_sz
+            ys["ask_sz"] = book.ask_sz
+            ys["best_bid"] = book.mid * (1.0 - flow.tick * book.s_ticks)
+            ys["best_ask"] = book.mid * (1.0 + flow.tick * book.s_ticks)
+        return (book, exch, st, qa, acct), ys
+
+    xs = (keys_t, sched_row, jnp.arange(T, dtype=jnp.int32))
+    (book, exch, st, qa, (peak, max_dd, min_eq)), ys = jax.lax.scan(
+        step, (book0, exch0, st0, qa0, acct0), xs)
+    close_last = ys["candle"]["close"][-1]
+    summary = LobSummary(
+        final_equity=sx.equity(exch, close_last),
+        final_quote=exch.quote, final_base=exch.base, fees=exch.fee_paid,
+        n_fills=exch.n_fills, dropped_fills=exch.dropped_fills,
+        entries=st.entries, max_drawdown=max_dd, min_equity=min_eq,
+        mean_spread=jnp.mean(ys["spread"]),
+        mean_top_depth=jnp.mean(ys["cap"]),
+        traded_volume=jnp.sum(ys["candle"]["volume"]))
+    return summary, exch.fills, ys
+
+
+_SCHED_KEYS = scenarios.ShockSchedule._fields
+
+
+@functools.partial(jax.jit, static_argnames=("levels", "log_capacity",
+                                             "return_book"))
+def _lob_rollout_jit(key, scen_ids, sched: dict, flow: FlowParams,
+                     strat: SimStrategy, fee_rate, quote0,
+                     levels: int = DEFAULT_LEVELS, log_capacity: int = 128,
+                     return_book: bool = False):
+    """Non-donating host-readable rollout — the entry the parity oracle,
+    the property tests and the calibration fixture drive (test-scale B)."""
+    summary, fills, ys = jax.vmap(
+        lambda i, s: _rollout_one(key, i, s, flow, strat, fee_rate, quote0,
+                                  levels, log_capacity, return_book)
+    )(scen_ids, sched)
+    return {"summary": summary._asdict(), "fills": fills, "series": ys}
+
+
+def rollout_lob(key, schedule, flow: FlowParams | None = None,
+                strategy: SimStrategy | None = None, fee_rate: float = 0.001,
+                quote_balance: float = 10_000.0,
+                levels: int = DEFAULT_LEVELS, log_capacity: int = 128,
+                return_book: bool = False, seed: int = 0) -> dict:
+    """Host entry for the fixed-schedule LOB rollout.  ``schedule`` is a
+    ShockSchedule (or preset name compiled at [1, T] — pass a compiled
+    schedule for B > 1).  The WHOLE result — summary, fill logs, per-step
+    candle/cap/spread series (and book arrays with ``return_book``) — is
+    read back: test-scale B only; `lob_sweep` is the at-scale entry."""
+    if isinstance(schedule, str):
+        schedule = scenarios.compile_schedules(schedule, 1, 256, seed=seed)
+    B = schedule.num_scenarios
+    sched = {k: jnp.asarray(getattr(schedule, k)) for k in _SCHED_KEYS}
+    out = _lob_rollout_jit(key, jnp.arange(B), sched, flow or flow_params(),
+                           strategy or default_strategy(),
+                           jnp.asarray(fee_rate, jnp.float32),
+                           jnp.asarray(quote_balance, jnp.float32),
+                           levels=levels, log_capacity=log_capacity,
+                           return_book=return_book)
+    return host_read(out)
+
+
+# --------------------------------------------------------------------------
+# the at-scale sweep: one dispatch behind the Partitioner seam
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=8)
+def _lob_program(partitioner, levels: int, log_capacity: int):
+    """One cached sharded sweep program per (partitioner, shape statics):
+    the scenario axis splits over the mesh data axis, flow/strategy/fee
+    arguments replicate, and the [B]-leaf outputs all-gather over ICI —
+    the same seam the GA and backtest sweeps ride."""
+
+    def fn(pop, key, flow, strat, fee_rate, quote0):
+        summary, _fills, ys = jax.vmap(
+            lambda i, s: _rollout_one(key, i, s, flow, strat, fee_rate,
+                                      quote0, levels, log_capacity, False)
+        )(pop["scen"], pop["sched"])
+        # six [B, T] f32 outputs alias the six donated [B, T] schedule
+        # channels 1:1, and the [B] i32 scenario ids alias an i32 summary
+        # leaf — the donation verifier proves every input buffer freed
+        return {"summary": summary._asdict(),
+                "equity_curve": ys["equity"],
+                "close": ys["candle"]["close"],
+                "high": ys["candle"]["high"], "low": ys["candle"]["low"],
+                "spread": ys["spread"], "cap": ys["cap"]}
+
+    return partitioner.population_eval(fn, name="lob_sweep",
+                                       donate_pop=True)
+
+
+def lob_sweep(key, scenario="mixed", num_scenarios: int = 1024,
+              steps: int = 256, flow: FlowParams | None = None,
+              strategy: SimStrategy | None = None, fee_rate: float = 0.001,
+              quote_balance: float = 10_000.0, seed: int = 0,
+              levels: int = DEFAULT_LEVELS, log_capacity: int = 128,
+              partitioner=None) -> dict:
+    """Run ``num_scenarios`` order-flow markets as ONE dispatch behind the
+    Partitioner seam.
+
+    ``scenario`` is a preset name, a list, "mixed", or a ready
+    ShockSchedule; ``partitioner`` defaults to `parallel.get_partitioner()`
+    (every visible device; single-device fallback elsewhere).  Returns the
+    host-side summary ([B] arrays), ``labels``, ``stats`` (dispatch
+    accounting) and ``device`` (the [B, T] equity/close/spread/cap series,
+    left device-resident — they are the donated-buffer reuse)."""
+    from ai_crypto_trader_tpu.parallel import get_partitioner
+
+    labels = None
+    if isinstance(scenario, scenarios.ShockSchedule):
+        sched = scenario
+    elif scenario == "mixed" or isinstance(scenario, (list, tuple)):
+        names = None if scenario == "mixed" else list(scenario)
+        sched, labels = scenarios.mixed_schedules(names, num_scenarios,
+                                                  steps, seed=seed)
+    else:
+        sched = scenarios.compile_schedules(scenario, num_scenarios, steps,
+                                            seed=seed)
+        labels = [str(scenario)] * sched.num_scenarios
+    B, T = sched.num_scenarios, sched.steps
+    partitioner = partitioner or get_partitioner()
+    flow = flow or flow_params()
+    strat = strategy or default_strategy()
+    fee = jnp.asarray(fee_rate, jnp.float32)
+    quote0 = jnp.asarray(quote_balance, jnp.float32)
+
+    pop = {"sched": {k: jnp.asarray(getattr(sched, k))
+                     for k in _SCHED_KEYS},
+           "scen": jnp.arange(B, dtype=jnp.int32)}
+    divisible = B % max(getattr(partitioner, "device_count", 1), 1) == 0
+    if divisible:
+        # donated carries must START on the mesh layout or XLA cannot
+        # alias them (the Partitioner contract); ragged populations pad
+        # inside population_eval instead and skip the pre-shard
+        pop = partitioner.shard_population(pop)
+    upload_bytes = sum(int(np.asarray(getattr(sched, k)).nbytes)
+                       for k in _SCHED_KEYS)
+    program = _lob_program(partitioner, int(levels), int(log_capacity))
+
+    carding = (devprof.active() is not None
+               and not devprof.has_card("lob_sweep"))
+    if carding:
+        # FLOPs/bytes only — memory_analysis would AOT-compile the
+        # biggest program in the repo a second time (the sim_sweep
+        # precedent)
+        devprof.cost_card("lob_sweep", program, pop, key, flow, strat, fee,
+                          quote0, _memory_analysis=False)
+    # donation is only CLAIMED on the alias-able layout: a ragged
+    # population pads through a concatenate (buffers free, nothing
+    # aliases), which must not page DonatedBufferNotFreed
+    donated = jax.tree.leaves(pop) if (carding and divisible) else None
+
+    cold = True
+    if meshprof.active() is not None:       # default-OFF discipline
+        shape_key = (B, T, int(levels), int(log_capacity),
+                     getattr(partitioner, "device_count", 1))
+        cold = shape_key not in _LOB_SHAPES_SEEN
+        _LOB_SHAPES_SEEN.add(shape_key)
+    t0 = time.perf_counter()
+    with meshprof.watch("lob_sweep", cold=cold):
+        out = program(pop, key, flow, strat, fee, quote0)
+        if donated is not None:
+            devprof.verify_donation("lob_sweep", donated)
+        # ONE [B]-sized host readback; the [B, T] series stay on device
+        host = host_read({"summary": out["summary"]})
+    wall = time.perf_counter() - t0
+    devprof.observe_latency("lob_sweep", wall)
+    host["device"] = {k: out[k] for k in ("equity_curve", "close", "high",
+                                          "low", "spread", "cap")}
+    host["labels"] = labels
+    host["stats"] = {
+        "dispatches": 1, "scenarios": B, "steps": T, "levels": int(levels),
+        # flow events per step: 2 market orders + per-level arrival and
+        # cancel updates on both sides (the bench row's events/s basis)
+        "events": B * T * (4 * int(levels) + 2),
+        "devices": getattr(partitioner, "device_count", 1),
+        "upload_bytes": upload_bytes, "wall_s": wall}
+    return host
+
+
+# --------------------------------------------------------------------------
+# flow-only market generation: candles for the backtester / RL env
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("levels",))
+def _lob_candles_jit(key, scen_ids, sched: dict, flow: FlowParams,
+                     levels: int = DEFAULT_LEVELS):
+    def one(scen_id, row):
+        T = row["halt"].shape[-1]
+        keys_t = jax.random.split(jax.random.fold_in(key, scen_id), T)
+        book0 = init_book(flow, levels)
+
+        def step(book, xs):
+            key_t, sched_t = xs
+            book, candle, spread, cap, _ta, _tb = flow_step(
+                book, key_t, sched_t, flow)
+            return book, {**candle, "spread": spread, "cap": cap}
+
+        _book, ys = jax.lax.scan(step, book0, (keys_t, row))
+        return ys
+
+    return jax.vmap(one)(scen_ids, sched)
+
+
+def lob_candles(key, schedule, flow: FlowParams | None = None,
+                levels: int = DEFAULT_LEVELS) -> dict:
+    """[B, T] OHLCV candles (plus per-step ``spread`` / ``cap`` book
+    channels) generated by the order-flow agents under a ShockSchedule —
+    the microstructure-native sibling of `paths.gbm_candles`, consumed by
+    `engine.backtest_under_stress(dynamics="lob")` and the RL env's
+    book-feature observations."""
+    flow = flow or flow_params()
+    B = schedule.num_scenarios
+    sched = {k: jnp.asarray(getattr(schedule, k)) for k in _SCHED_KEYS}
+    out = _lob_candles_jit(key, jnp.arange(B), sched, flow, levels=levels)
+    out["regime"] = jnp.zeros(out["close"].shape, jnp.int32)
+    return out

@@ -13,6 +13,16 @@ Event timing and magnitude are drawn per scenario row from seeded ranges,
 so a 4096-row schedule is 4096 *different* flash crashes, not one crash
 replicated — breadth comes from the batch axis (ISSUE 7 / ROADMAP item 2).
 
+Two consumers read the same six channels at different depths: the candle
+simulator applies them to PRICES and venue knobs directly, while the
+limit-order book (`sim/lob.py`) maps them onto its order-flow AGENTS —
+``liquidity_mult`` scales limit-order arrival rates (a liquidity hole
+starves the book until cancels thin it out), ``spread`` widens the
+quoted half-spread in ticks (a spread blowout reshapes the whole grid),
+``logret_shift``/``vol_mult`` drive the mid, ``halt``/``latency`` keep
+their venue semantics.  Same presets, same arrays — the pathology lands
+on the microstructure instead of only the price path.
+
 NumPy only: schedule compilation is host-side prep; nothing in this module
 may import jax (mc/engine.py imports it lazily for its stress mode).
 """

@@ -67,6 +67,16 @@ def default_rules() -> list[AlertRule]:
                   lambda s: bool(s.get("stream_degraded")),
                   "websocket feed unhealthy; monitor polling REST until "
                   "it recovers"),
+        # NOT a ring-fill alert: a keep-last-N ring sits at 1.0 forever
+        # by design.  This fires when a configured capture JOURNAL has
+        # spent its record budget — new depth frames are no longer
+        # persisted and the calibration pipeline's source goes stale.
+        # The PromQL twins ride crypto_trader_tpu_depth_frames_dropped_
+        # total, which counts exactly those unpersisted frames.
+        AlertRule("DepthCaptureSaturated", "warning",
+                  lambda s: bool(s.get("depth_journal_exhausted")),
+                  "depth-capture journal budget spent; new depth frames "
+                  "are no longer persisted"),
         # --- load & capacity observatory (utils/saturation.py) ---
         # saturated_stages is windowed AND min-sample gated at the source
         # (SaturationMonitor), so one compile-heavy cold tick can never

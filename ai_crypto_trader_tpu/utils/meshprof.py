@@ -69,7 +69,7 @@ _ACTIVE: "MeshProf | None" = None
 # "train_epoch.lstm" inherit the family's hotness.
 DEFAULT_HOT_PROGRAMS = frozenset({
     "tick_engine", "ga_scan", "backtest_sweep", "population_sweep",
-    "train_epoch", "sim_sweep", "dqn_train_iterations",
+    "train_epoch", "sim_sweep", "dqn_train_iterations", "lob_sweep",
 })
 
 # pad fraction above which MeshPaddingWasteHigh fires (a quarter of the

@@ -164,7 +164,8 @@ def append_history(rows: list, path: str | None = None,
               "BENCH_STREAM_TICKS", "BENCH_LOAD_TENANTS",
               "BENCH_LOAD_SYMBOLS", "BENCH_LOAD_TICKS",
               "BENCH_LOAD_SLO_MS",
-              "BENCH_GA_T", "BENCH_GA_POP", "BENCH_GA_GENS")
+              "BENCH_GA_T", "BENCH_GA_POP", "BENCH_GA_GENS",
+              "BENCH_LOB_SCENARIOS", "BENCH_LOB_STEPS", "BENCH_LOB_LEVELS")
              if os.environ.get(k)}
     with open(path, "a", encoding="utf-8") as f:
         for row in rows:
@@ -700,6 +701,48 @@ def bench_sim():
     emit("sim_sweep", B / dt, "scenarios/s", None, scenarios=B, steps=T,
          candle_steps_per_s=round(B * T / dt, 1),
          sweep_ms=round(dt * 1e3, 3))
+
+
+def bench_lob():
+    """lob_events_per_sec row: order-flow events processed per second per
+    chip by the device-resident limit-order book (sim/lob.py, ISSUE 13) —
+    B scenarios × T steps × (4L+2) flow events (per-level arrival+cancel
+    updates both sides + 2 market sweeps) as ONE dispatch behind the
+    Partitioner seam with one [B]-sized host readback.  Device-count
+    stamped: the sweep shards over the mesh data axis."""
+    import jax
+
+    from ai_crypto_trader_tpu.sim import lob as sim_lob
+    from ai_crypto_trader_tpu.sim import scenarios as sim_scenarios
+
+    B = int(os.environ.get("BENCH_LOB_SCENARIOS", "1024"))
+    T = int(os.environ.get("BENCH_LOB_STEPS", "256"))
+    L = int(os.environ.get("BENCH_LOB_LEVELS", "32"))
+    # schedules PRE-built host-side (the bench_sim discipline): the row
+    # measures the device sweep, not the Python schedule compiler
+    scheds = [sim_scenarios.mixed_schedules(None, B, T, seed=i)[0]
+              for i in range(4)]
+    t0 = time.perf_counter()
+    out = sim_lob.lob_sweep(jax.random.PRNGKey(0), scenario=scheds[3],
+                            levels=L)                          # compile
+    log(f"lob: sweep compile+first run {time.perf_counter()-t0:.1f}s "
+        f"(B={B} × T={T} × L={L})")
+    reps = []
+    for i in range(3):
+        out = sim_lob.lob_sweep(jax.random.PRNGKey(i + 1),
+                                scenario=scheds[i], levels=L)
+        reps.append(out["stats"]["wall_s"])
+    dt = float(np.median(reps))
+    events = out["stats"]["events"]
+    devices = out["stats"]["devices"]
+    log(f"lob: steady sweep {dt:.3f}s "
+        f"(median of {[round(v, 3) for v in reps]}) → "
+        f"{events / dt:,.0f} events/s, {B / dt:,.0f} scenarios/s "
+        f"on {devices} device(s); traded "
+        f"{float((out['summary']['n_fills'] > 0).mean()):.0%} of scenarios")
+    emit("lob_events_per_sec", events / dt, "events/s", None,
+         scenarios=B, steps=T, levels=L, devices=devices,
+         scenarios_per_s=round(B / dt, 1), sweep_ms=round(dt * 1e3, 3))
 
 
 def bench_recovery():
@@ -1524,6 +1567,7 @@ def run_worker():
         ("rl", lambda: bench_rl(ind)),
         ("mc", bench_mc),
         ("sim", bench_sim),
+        ("lob", bench_lob),
         ("nn", bench_nn),
         ("recovery", bench_recovery),
     ]

@@ -1,0 +1,695 @@
+"""Device-resident limit-order book (ISSUE 13): order-flow agents, book
+invariants, FakeExchange parity at top-of-book, the one-dispatch sweep
+behind the Partitioner seam, and the depth-capture → calibration loop.
+
+The three contracts that guard the subsystem:
+
+  * **Parity oracle** — a single-scenario LOB rollout must match
+    FakeExchange trade-by-trade (fills, fees, final equity) when driven
+    through the identical strategy decisions on the emitted
+    candle/cap/spread series (the tests/test_sim.py oracle pattern),
+    across calm / liquidity_hole / spread_blowout presets;
+  * **Sweep contract** — ≥ 1024 scenarios × ≥ 256 steps evaluate as ONE
+    dispatch with ONE host readback, zero steady-state recompiles
+    (asserted through the meshprof sentinel), a `lob_sweep` devprof cost
+    card, and verified donation of the schedule buffers;
+  * **Calibration round-trip** — FlowParams fitted from recorded depth
+    frames reproduce the source book's mean depth profile and arrival
+    rates within tolerance, and drive a LOB sweep end-to-end.
+
+Plus property tests over the stochastic flow: the book never crosses,
+level sizes never go negative, fill-ledger conservation, and bitwise
+same-seed determinism.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ai_crypto_trader_tpu.data.ingest import from_dict
+from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+from ai_crypto_trader_tpu.sim import engine, lob, scenarios
+from ai_crypto_trader_tpu.sim import exchange as sx
+from ai_crypto_trader_tpu.utils import devprof
+
+f32 = np.float32
+
+
+def _mk_rollout(preset, seed, T=512, **kw):
+    sched = scenarios.compile_schedules(preset, 1, T, seed=seed)
+    strat = kw.pop("strategy", engine.default_strategy(sl_pct=1.0,
+                                                       tp_pct=1.5))
+    flow = kw.pop("flow", lob.flow_params())
+    out = lob.rollout_lob(jax.random.PRNGKey(seed), sched, flow=flow,
+                          strategy=strat, **kw)
+    return out, strat
+
+
+# --------------------------------------------------------------------------
+# the parity oracle: LOB rollout ≡ FakeExchange at top-of-book
+# --------------------------------------------------------------------------
+
+def _oracle_run(c: dict, cap: np.ndarray, spread: np.ndarray, fee, q0, T,
+                strat: engine.SimStrategy):
+    """Drive FakeExchange through the EXACT decision rule of
+    `engine._strategy_step` on the LOB's emitted candle series, with the
+    venue-side knobs mirrored per step: the measured top-of-book cap as
+    `max_fill_base`, and the measured spread via marketable LIMIT orders
+    at the touch (FakeExchange has no spread of its own — a LIMIT BUY at
+    the ask fills immediately at the ask, which IS top-of-book market
+    execution)."""
+    al_f = f32(np.asarray(strat.alpha_fast))
+    al_s = f32(np.asarray(strat.alpha_slow))
+    margin = f32(np.asarray(strat.entry_margin))
+    sl = f32(np.asarray(strat.sl_pct))
+    tp = f32(np.asarray(strat.tp_pct))
+    frac = f32(np.asarray(strat.trade_frac))
+    min_not = float(np.asarray(strat.min_notional))
+
+    series = from_dict({k: c[k] for k in
+                        ("open", "high", "low", "close", "volume")},
+                       symbol="SIMUSDC")
+    ex = FakeExchange({"SIMUSDC": series}, quote_balance=q0, fee_rate=fee)
+    ema_f = ema_s = f32(0.0)
+    entry = f32(0.0)
+    fills, seen = [], [0]
+
+    def drain(t):
+        for fd in ex.fills[seen[0]:]:
+            fills.append((t, 1 if fd["side"] == "BUY" else -1,
+                          fd["quantity"], fd["price"], fd["fee"]))
+        seen[0] = len(ex.fills)
+
+    for t in range(T):
+        # measured per-step venue knobs, mirrored venue-side
+        ex.max_fill_base = float(cap[t])
+        if t > 0:
+            ex.advance()
+        drain(t)
+        close = c["close"][t]
+        bal = ex.get_balances()
+        quote, base = bal.get("USDC", 0.0), bal.get("SIM", 0.0)
+        if t == 0:
+            ema_f = ema_s = f32(close)
+        else:
+            ema_f = f32(ema_f + al_f * f32(close - ema_f))
+            ema_s = f32(ema_s + al_s * f32(close - ema_s))
+        flat = base * float(close) < min_not
+        resting = ex.list_open_orders("SIMUSDC")
+        if flat and resting:                      # post-exit sibling cleanup
+            for o in resting:
+                ex.cancel_order("SIMUSDC", o["order_id"])
+            resting = []
+        cross = ema_f > f32(ema_s * f32(1.0 + margin))
+        if flat and not resting and cross and t >= engine.WARMUP:
+            qty = f32(f32(frac * f32(quote)) / close)
+            # market BUY at the touch: a marketable LIMIT at the ask —
+            # sim/exchange books close·(1+spread/2), in f32
+            ask = f32(f32(close) * f32(1.0 + f32(spread[t]) * f32(0.5)))
+            ex.max_fill_base = None       # market orders are all-or-reject
+            r = ex.place_order("SIMUSDC", "BUY", "LIMIT", float(qty),
+                               price=float(ask))
+            ex.advance("SIMUSDC", steps=0)        # match against candle t
+            if ex.order_is_open("SIMUSDC", r["order_id"]):
+                # an under-funded market order is GONE, not resting
+                ex.cancel_order("SIMUSDC", r["order_id"])
+            ex.max_fill_base = float(cap[t])
+            entry = f32(close)
+            drain(t)
+        elif not flat and not resting:            # protective stop + TP
+            sp = f32(entry * f32(1.0 - f32(sl / f32(100.0))))
+            tpp = f32(entry * f32(1.0 + f32(tp / f32(100.0))))
+            ex.place_order("SIMUSDC", "SELL", "STOP_LOSS", float(base),
+                           stop_price=float(sp))
+            ex.place_order("SIMUSDC", "SELL", "LIMIT", float(base),
+                           price=float(tpp))
+    bal = ex.get_balances()
+    eq = bal.get("USDC", 0.0) + bal.get("SIM", 0.0) * float(c["close"][-1])
+    return fills, eq, sum(fd["fee"] for fd in ex.fills)
+
+
+class TestParityOracle:
+    """The acceptance contract: a single-scenario LOB run reproduces
+    FakeExchange trade-by-trade at top-of-book — including the presets
+    that reshape the BOOK (thin liquidity, wide spread), not just the
+    price path."""
+
+    @pytest.mark.parametrize("preset,seed", [
+        ("calm", 7),
+        ("liquidity_hole", 9),
+        ("spread_blowout", 4),
+        ("flash_crash", 3),
+    ])
+    def test_single_scenario_matches_fake_exchange(self, preset, seed):
+        T = 512
+        fee, q0 = 0.001, 10_000.0
+        out, strat = _mk_rollout(preset, seed, T=T, fee_rate=fee,
+                                 quote_balance=q0)
+        s = out["summary"]
+        n = int(s["n_fills"][0])
+        assert s["dropped_fills"][0] == 0
+        sim_fills = out["fills"][0][:n]
+        ser = out["series"]
+        c1 = {k: np.asarray(v[0]) for k, v in ser["candle"].items()}
+
+        oracle_fills, oracle_eq, oracle_fees = _oracle_run(
+            c1, np.asarray(ser["cap"][0]), np.asarray(ser["spread"][0]),
+            fee, q0, T, strat)
+
+        assert n == len(oracle_fills), \
+            f"{preset}: sim {n} fills vs oracle {len(oracle_fills)}"
+        for srow, orow in zip(sim_fills, oracle_fills):
+            t_s, _tag, side_s, qty_s, price_s, fee_s = map(float, srow)
+            t_o, side_o, qty_o, price_o, fee_o = orow
+            assert (t_s, side_s) == (t_o, side_o), (srow, orow)
+            np.testing.assert_allclose(qty_s, qty_o, rtol=1e-4, atol=1e-9)
+            np.testing.assert_allclose(price_s, price_o, rtol=1e-5)
+            np.testing.assert_allclose(fee_s, fee_o, rtol=1e-3, atol=1e-6)
+        np.testing.assert_allclose(float(s["fees"][0]), oracle_fees,
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(float(s["final_equity"][0]), oracle_eq,
+                                   rtol=1e-4)
+
+    def test_parity_fills_actually_happen(self):
+        """Guard the oracle itself: the pinned scenarios must trade, or
+        the parity proves nothing."""
+        total = 0
+        for preset, seed in (("calm", 7), ("liquidity_hole", 9),
+                             ("spread_blowout", 4), ("flash_crash", 3)):
+            out, _ = _mk_rollout(preset, seed, T=512)
+            total += int(out["summary"]["n_fills"][0])
+        assert total >= 12
+
+
+# --------------------------------------------------------------------------
+# book invariants: property tests over the stochastic flow
+# --------------------------------------------------------------------------
+
+class TestBookInvariants:
+    def _books(self, preset="mixed", B=8, T=256, seed=0, flow=None):
+        sched, _ = scenarios.mixed_schedules(None, B, T, seed=seed) \
+            if preset == "mixed" else (
+                scenarios.compile_schedules(preset, B, T, seed=seed), None)
+        return lob.rollout_lob(jax.random.PRNGKey(seed), sched,
+                               flow=flow, return_book=True)
+
+    def test_book_never_crosses(self):
+        out = self._books()
+        ser = out["series"]
+        assert (ser["best_bid"] < ser["best_ask"]).all()
+        assert (ser["spread"] > 0).all()
+
+    def test_level_sizes_never_negative(self):
+        out = self._books(seed=3)
+        assert float(out["series"]["bid_sz"].min()) >= 0.0
+        assert float(out["series"]["ask_sz"].min()) >= 0.0
+
+    def test_candles_well_formed(self):
+        c = self._books(seed=5)["series"]["candle"]
+        assert (c["high"] >= np.maximum(c["open"], c["close"]) - 1e-3).all()
+        assert (c["low"] <= np.minimum(c["open"], c["close"]) + 1e-3).all()
+        assert (c["low"] > 0).all() and (c["volume"] > 0).all()
+
+    def test_fill_ledger_conservation(self):
+        """Balances + fees ≡ the fill log, per scenario (the
+        sim/exchange.py fill-accounting contract, inherited through the
+        LOB's reuse of its matching)."""
+        out = self._books(B=8, T=512, seed=2)
+        s = out["summary"]
+        assert (s["n_fills"] > 0).sum() >= 4, "flow barely trades"
+        q0 = 10_000.0
+        for b in range(8):
+            n = int(s["n_fills"][b])
+            log = out["fills"][b][:n].astype(np.float64)
+            if n == 0:
+                continue
+            side, qty, price, fee = log[:, 2], log[:, 3], log[:, 4], log[:, 5]
+            buys, sells = side > 0, side < 0
+            cost = qty * price
+            quote_expect = (q0 - (cost[buys] + fee[buys]).sum()
+                            + (cost[sells] - fee[sells]).sum())
+            base_expect = qty[buys].sum() - qty[sells].sum()
+            np.testing.assert_allclose(s["final_quote"][b], quote_expect,
+                                       rtol=1e-4, atol=5e-2)
+            np.testing.assert_allclose(s["final_base"][b], base_expect,
+                                       rtol=1e-3, atol=1e-5)
+            np.testing.assert_allclose(s["fees"][b], fee.sum(),
+                                       rtol=1e-3, atol=1e-3)
+
+    def test_same_seed_bitwise_deterministic(self):
+        a = self._books(B=4, T=128, seed=7)
+        b = self._books(B=4, T=128, seed=7)
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_presets_reshape_the_microstructure(self):
+        """The tentpole's point: stress drives the FLOW.  Inside its
+        scheduled window a liquidity hole starves the book's arrivals and
+        a spread blowout widens the quoted spread — measured on the
+        emitted book channels, conditioned on the window."""
+        B, T, seed = 16, 256, 1
+        calm = self._books("calm", B=B, T=T, seed=seed)["series"]
+        hole_sched = scenarios.compile_schedules("liquidity_hole", B, T,
+                                                 seed=seed)
+        hole = lob.rollout_lob(jax.random.PRNGKey(seed), hole_sched,
+                               return_book=True)["series"]
+        blow_sched = scenarios.compile_schedules("spread_blowout", B, T,
+                                                 seed=seed)
+        blow = lob.rollout_lob(jax.random.PRNGKey(seed), blow_sched,
+                               return_book=True)["series"]
+        in_hole = np.asarray(hole_sched.liquidity_mult) < 0.5
+        assert in_hole.any()
+        assert (np.asarray(hole["cap"])[in_hole].mean()
+                < 0.3 * np.asarray(calm["cap"]).mean())
+        in_blow = np.asarray(blow_sched.spread) > 0.0
+        assert in_blow.any()
+        assert (np.asarray(blow["spread"])[in_blow].mean()
+                > 5.0 * np.asarray(calm["spread"]).mean())
+        # calm spread is exactly the baseline grid: 2·tick·spread0
+        np.testing.assert_allclose(np.asarray(calm["spread"]),
+                                   2.0e-4, rtol=1e-5)
+
+
+class TestQueuePosition:
+    def test_gate_none_equals_all_true(self):
+        """sim/exchange.match_candle with gate=None must trace to the
+        exact ungated program (the parity contract's foundation)."""
+        st = sx.init_state(1_000.0, K=2, L=16)
+        act = sx.no_action(2)._replace(
+            place=jnp.asarray([True, False]),
+            side=jnp.asarray([sx.BUY, sx.BUY], jnp.int32),
+            kind=jnp.asarray([sx.LIMIT, sx.LIMIT], jnp.int32),
+            qty=jnp.asarray([1.0, 0.0], jnp.float32),
+            limit_price=jnp.asarray([100.0, 0.0], jnp.float32))
+        candle = {k: jnp.asarray(v, jnp.float32) for k, v in
+                  {"open": 100.0, "high": 101.0, "low": 99.0,
+                   "close": 100.0}.items()}
+        z, f = jnp.asarray(0.0), jnp.asarray(0.001)
+        st = sx.apply_action(st, candle, 0, act, f, z, z, z)
+        a = sx.match_candle(st, candle, 1, jnp.asarray(np.inf), z, f)
+        b = sx.match_candle(st, candle, 1, jnp.asarray(np.inf), z, f,
+                            gate=jnp.asarray([True, True]))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        # and a False gate blocks the price-triggered LIMIT
+        c = sx.match_candle(st, candle, 1, jnp.asarray(np.inf), z, f,
+                            gate=jnp.asarray([False, True]))
+        assert bool(jax.device_get(c.book.active)[0])
+        assert not bool(jax.device_get(a.book.active)[0])
+
+    def test_queue_priority_delays_or_reduces_fills(self):
+        """With queue_frac=1 a resting TP must wait for the queue ahead
+        to trade through — fills can only happen later (or not at all)
+        vs the front-of-queue parity semantics."""
+        sched = scenarios.compile_schedules("calm", 4, 512, seed=11)
+        kw = dict(strategy=engine.default_strategy(sl_pct=1.0, tp_pct=0.3))
+        front = lob.rollout_lob(jax.random.PRNGKey(1), sched,
+                                flow=lob.flow_params(queue_frac=0.0), **kw)
+        back = lob.rollout_lob(jax.random.PRNGKey(1), sched,
+                               flow=lob.flow_params(queue_frac=1.0), **kw)
+        nf_f = front["summary"]["n_fills"].sum()
+        nf_b = back["summary"]["n_fills"].sum()
+        assert nf_f > 0
+        assert nf_b <= nf_f
+        # same flow, same candles: the MARKET view is identical — only
+        # the agent's queue standing differs
+        np.testing.assert_array_equal(
+            np.asarray(front["series"]["candle"]["close"]),
+            np.asarray(back["series"]["candle"]["close"]))
+
+
+# --------------------------------------------------------------------------
+# the sweep contract: ≥1024 scenarios, one dispatch behind the Partitioner
+# --------------------------------------------------------------------------
+
+class TestSweepContract:
+    def test_1024_scenarios_one_dispatch_zero_recompile(self, monkeypatch):
+        from ai_crypto_trader_tpu.utils import meshprof
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        B, T = 1024, 256
+        syncs = {"n": 0}
+        real_read = lob.host_read
+
+        def counting_read(tree):
+            syncs["n"] += 1
+            return real_read(tree)
+
+        monkeypatch.setattr(lob, "host_read", counting_read)
+        m = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=m)
+        with devprof.use(devprof.DevProf(metrics=m)) as dp, \
+                meshprof.use(mp):
+            out = lob.lob_sweep(jax.random.PRNGKey(0), scenario="mixed",
+                                num_scenarios=B, steps=T)  # compile + card
+            assert syncs["n"] == 1
+            assert out["stats"]["dispatches"] == 1
+            assert out["stats"]["scenarios"] == B
+            assert out["summary"]["final_equity"].shape == (B,)
+            assert len(out["labels"]) == B
+            # cost card + donation check (acceptance criteria)
+            card = dp.cards["lob_sweep"]
+            assert card.error is None and card.flops > 0
+            assert card.donation_ok is True
+            assert dp.donation_failures == []
+            # the big series stayed on device — the one sync is [B]-sized
+            assert out["device"]["close"].shape == (B, T)
+            assert out["device"]["equity_curve"].shape == (B, T)
+            # the partitioner registered the layout card
+            assert mp.layouts["lob_sweep"].population == B
+
+            out2 = lob.lob_sweep(jax.random.PRNGKey(1), scenario="mixed",
+                                 num_scenarios=B, steps=T, seed=1)
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+            assert mp.recompiles.windows["lob_sweep"] == 2
+            assert mp.transfers.total() == 0
+            assert syncs["n"] == 2
+        # different keys/schedules → different outcomes
+        assert not np.array_equal(out["summary"]["final_equity"],
+                                  out2["summary"]["final_equity"])
+
+    def test_sweep_same_seed_deterministic(self):
+        a = lob.lob_sweep(jax.random.PRNGKey(5), scenario="flash_crash",
+                          num_scenarios=32, steps=128, seed=2)
+        b = lob.lob_sweep(jax.random.PRNGKey(5), scenario="flash_crash",
+                          num_scenarios=32, steps=128, seed=2)
+        for k, v in a["summary"].items():
+            np.testing.assert_array_equal(v, b["summary"][k], err_msg=k)
+
+    def test_adversarial_presets_hurt_more_than_calm(self):
+        kw = dict(num_scenarios=48, steps=256, seed=4,
+                  strategy=engine.default_strategy(sl_pct=1.0, tp_pct=1.5))
+        calm = lob.lob_sweep(jax.random.PRNGKey(9), scenario="calm", **kw)
+        swan = lob.lob_sweep(jax.random.PRNGKey(9), scenario="black_swan",
+                             **kw)
+        assert (swan["summary"]["min_equity"].min()
+                < calm["summary"]["min_equity"].min())
+        assert (swan["summary"]["max_drawdown"].max()
+                > calm["summary"]["max_drawdown"].max())
+
+    def test_sweep_accepts_calibrated_flow(self):
+        out = lob.lob_sweep(jax.random.PRNGKey(2), scenario="calm",
+                            num_scenarios=16, steps=64,
+                            flow=lob.flow_params(limit_rate=5.0,
+                                                 cancel_rate=0.2),
+                            levels=16)
+        assert out["stats"]["levels"] == 16
+        assert np.isfinite(out["summary"]["final_equity"]).all()
+
+
+# --------------------------------------------------------------------------
+# calibration: captured depth frames → FlowParams → LOB (the round trip)
+# --------------------------------------------------------------------------
+
+class TestCalibration:
+    TRUE = dict(limit_rate=3.0, depth_decay=0.15, cancel_rate=0.10,
+                market_rate=0.4, market_size=5.0, vol=0.0, drift=0.0)
+
+    def _measure(self, flow, key, T=600):
+        """Mean depth profile + net arrival rates of a flow's book, from
+        its own emitted depth records — the observable the round trip
+        must reproduce."""
+        from ai_crypto_trader_tpu.sim import calibrate
+
+        sched = scenarios.compile_schedules("calm", 1, T, seed=2)
+        out = lob.rollout_lob(key, sched, flow=flow, return_book=True)
+        recs = calibrate.records_from_lob_series(
+            out["series"], tick=float(np.asarray(flow.tick)))
+        arr = calibrate.frames_to_arrays(recs)
+        depth = (arr["bids"][:, :, 1].mean(0)
+                 + arr["asks"][:, :, 1].mean(0)) / 2.0
+        db = np.diff(arr["bids"][:, :, 1], axis=0)
+        da = np.diff(arr["asks"][:, :, 1], axis=0)
+        inflow = (np.maximum(db, 0).mean(0) + np.maximum(da, 0).mean(0)) / 2.0
+        return recs, depth, inflow
+
+    def test_fit_recovers_flow_parameters(self):
+        from ai_crypto_trader_tpu.sim import calibrate
+
+        true = lob.flow_params(**self.TRUE)
+        recs, _, _ = self._measure(true, jax.random.PRNGKey(3))
+        fitted, report = calibrate.fit_flow_params(recs)
+        # geometry is exact; gross rates come out of the delta regression
+        np.testing.assert_allclose(float(fitted.tick), 1e-4, rtol=0.05)
+        np.testing.assert_allclose(float(fitted.spread0), 1.0, rtol=0.05)
+        np.testing.assert_allclose(float(fitted.mid0), 40_000.0, rtol=0.01)
+        np.testing.assert_allclose(float(fitted.depth_decay), 0.15,
+                                   rtol=0.25)
+        np.testing.assert_allclose(float(fitted.limit_rate), 3.0, rtol=0.30)
+        np.testing.assert_allclose(float(fitted.cancel_rate), 0.10,
+                                   rtol=0.35)
+        assert report["frames"] == 600
+        # the batched-orderbook analytics rode along
+        assert report["mean_impact_curve"].shape == (3,)
+        assert np.isfinite(report["mean_near_pressure"])
+
+    def test_round_trip_through_capture_journal(self, tmp_path):
+        """The acceptance loop: depth frames → DepthCapture JSONL →
+        load_depth_records → fit → the fitted flow's book reproduces the
+        SOURCE's mean depth profile and arrival rates — and drives a
+        sweep end-to-end."""
+        from ai_crypto_trader_tpu.shell.exchange import load_depth_records
+        from ai_crypto_trader_tpu.shell.stream import DepthCapture
+        from ai_crypto_trader_tpu.sim import calibrate
+
+        true = lob.flow_params(**self.TRUE)
+        recs, depth_src, inflow_src = self._measure(true,
+                                                    jax.random.PRNGKey(3))
+        path = str(tmp_path / "depth.jsonl")
+        dc = DepthCapture(path=path, ring_max=64)
+        for r in recs:
+            dc.ingest({"lastUpdateId": r["u"], "s": r["symbol"],
+                       "bids": r["bids"], "asks": r["asks"]})
+        dc.close()
+        fitted, _ = calibrate.fit_flow_params(load_depth_records(path))
+
+        _, depth_fit, inflow_fit = self._measure(fitted,
+                                                 jax.random.PRNGKey(11))
+        depth_err = np.abs(depth_fit - depth_src).mean() / depth_src.mean()
+        inflow_err = (np.abs(inflow_fit - inflow_src).mean()
+                      / inflow_src.mean())
+        assert depth_err < 0.25, depth_err
+        assert inflow_err < 0.25, inflow_err
+
+        out = lob.lob_sweep(jax.random.PRNGKey(4), scenario="mixed",
+                            num_scenarios=32, steps=64, flow=fitted)
+        assert np.isfinite(out["summary"]["final_equity"]).all()
+
+    def test_fit_needs_frames(self):
+        from ai_crypto_trader_tpu.sim import calibrate
+
+        with pytest.raises(ValueError, match="no depth frames"):
+            calibrate.fit_flow_params([])
+
+    def test_diff_records_are_not_books(self):
+        """@depth diff records are per-level CHANGES, not standing books:
+        both the fit and the replay seam must refuse them rather than
+        silently produce garbage."""
+        from ai_crypto_trader_tpu.shell.exchange import load_depth_records
+        from ai_crypto_trader_tpu.sim import calibrate
+
+        diffs = [{"symbol": "BTCUSDC", "kind": "diff", "E": i,
+                  "U": i, "u": i,
+                  "bids": [[100.0, 0.0]], "asks": [[100.1, 2.0]]}
+                 for i in range(10)]
+        assert load_depth_records(diffs) == []
+        with pytest.raises(ValueError, match="no depth frames"):
+            calibrate.fit_flow_params(diffs)
+
+    def test_explicit_symbol_miss_raises(self):
+        from ai_crypto_trader_tpu.sim import calibrate
+
+        recs = [{"symbol": "BTCUSDC", "kind": "snapshot", "E": 0, "u": 0,
+                 "bids": [[100.0 - i, 1.0] for i in range(4)],
+                 "asks": [[101.0 + i, 1.0] for i in range(4)]}] * 3
+        with pytest.raises(ValueError, match="ETHUSDC"):
+            calibrate.fit_flow_params(recs, symbol="ETHUSDC")
+
+
+# --------------------------------------------------------------------------
+# satellites: FakeExchange replay seam, batched ops/orderbook, workloads
+# --------------------------------------------------------------------------
+
+class TestDepthReplaySeam:
+    def _records(self, n=5, symbol="BTCUSDC"):
+        return [{"symbol": symbol, "kind": "snapshot", "E": i, "u": i,
+                 "bids": [[100.0 - 0.1 * j, 1.0 + i + j] for j in range(8)],
+                 "asks": [[100.1 + 0.1 * j, 2.0 + i + j] for j in range(8)]}
+                for i in range(n)]
+
+    def _exchange(self, records):
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+
+        d = generate_ohlcv(n=64, seed=1)
+        series = {"BTCUSDC": from_dict(
+            {k: v for k, v in d.items() if k != "regime"},
+            symbol="BTCUSDC")}
+        return FakeExchange(series, depth_capture=records)
+
+    def test_replay_serves_captured_books(self):
+        ex = self._exchange(self._records())
+        book = ex.get_order_book("BTCUSDC", limit=5)
+        assert book["captured"] is True
+        assert book["bids"][0] == [100.0, 1.0]
+        assert len(book["bids"]) == 5                  # limit respected
+        again = ex.get_order_book("BTCUSDC", limit=5)
+        assert again["bids"] == book["bids"]           # cursor-deterministic
+        ex.advance()
+        nxt = ex.get_order_book("BTCUSDC", limit=5)
+        assert nxt["bids"][0] == [100.0, 2.0]          # clock picks records
+
+    def test_replay_from_journal_path(self, tmp_path):
+        from ai_crypto_trader_tpu.shell.stream import DepthCapture
+
+        path = str(tmp_path / "cap.jsonl")
+        dc = DepthCapture(path=path)
+        for r in self._records(3):
+            dc.ingest({"lastUpdateId": r["u"], "s": r["symbol"],
+                       "bids": r["bids"], "asks": r["asks"]})
+        dc.close()
+        ex = self._exchange(path)
+        assert ex.get_order_book("BTCUSDC")["captured"] is True
+
+    def test_empty_capture_falls_back_to_synthetic(self):
+        ex = self._exchange([])
+        book = ex.get_order_book("BTCUSDC")
+        assert "captured" not in book
+        assert len(book["bids"]) == 20
+
+    def test_other_symbols_capture_never_served_cross_symbol(self):
+        """A capture holding only another symbol's books must NOT replay
+        them under this symbol's price scale — synthetic fallback, not a
+        silently mislabeled `captured` book."""
+        ex = self._exchange(self._records(symbol="ETHUSDC"))
+        book = ex.get_order_book("BTCUSDC")
+        assert "captured" not in book
+        # symbol-less hand-built records still serve any symbol
+        anon = [dict(r, symbol="") for r in self._records(2)]
+        ex2 = self._exchange(anon)
+        assert ex2.get_order_book("BTCUSDC")["captured"] is True
+
+    def test_analytics_consume_replayed_books(self):
+        from ai_crypto_trader_tpu.ops.orderbook import orderbook_signal
+
+        ex = self._exchange(self._records())
+        book = ex.get_order_book("BTCUSDC", limit=8)
+        sig = orderbook_signal(np.asarray(book["bids"], np.float32),
+                               np.asarray(book["asks"], np.float32))
+        assert sig["signal"] in ("BUY", "SELL", "NEUTRAL")
+
+
+class TestBatchedOrderbook:
+    def _books(self, B=6, N=12, seed=0):
+        rng = np.random.default_rng(seed)
+        px = 100.0 * (1.0 + 0.01 * rng.random((B, 1)))
+        lv = np.arange(1, N + 1)
+        bids = np.stack([np.broadcast_to(px - 0.01 * lv, (B, N)),
+                         rng.random((B, N)) * 5 + 0.5], axis=-1)
+        asks = np.stack([np.broadcast_to(px + 0.01 * lv, (B, N)),
+                         rng.random((B, N)) * 5 + 0.5], axis=-1)
+        return (jnp.asarray(bids, jnp.float32),
+                jnp.asarray(asks, jnp.float32))
+
+    def test_price_impact_batched_matches_loop(self):
+        from ai_crypto_trader_tpu.ops.orderbook import price_impact
+
+        bids, _ = self._books()
+        sizes = jnp.asarray([100.0, 500.0, 2000.0], jnp.float32)
+        batched = np.asarray(price_impact(bids, sizes))
+        assert batched.shape == (6, 3)
+        for b in range(6):
+            np.testing.assert_array_equal(batched[b],
+                                          np.asarray(price_impact(bids[b],
+                                                                  sizes)))
+
+    def test_find_walls_batched_matches_loop(self):
+        from ai_crypto_trader_tpu.ops.orderbook import find_walls
+
+        bids, _ = self._books(seed=3)
+        batched = np.asarray(find_walls(bids))
+        assert batched.shape == (6, 12)
+        for b in range(6):
+            np.testing.assert_array_equal(batched[b],
+                                          np.asarray(find_walls(bids[b])))
+
+    def test_pressure_metrics_batched_matches_loop(self):
+        from ai_crypto_trader_tpu.ops.orderbook import pressure_metrics
+
+        bids, asks = self._books(seed=5)
+        batched = {k: np.asarray(v)
+                   for k, v in pressure_metrics(bids, asks).items()}
+        assert batched["microprice"].shape == (6,)
+        for b in range(6):
+            one = pressure_metrics(bids[b], asks[b])
+            for k, v in one.items():
+                np.testing.assert_allclose(batched[k][b], np.asarray(v),
+                                           rtol=1e-6, err_msg=k)
+
+    def test_extra_leading_dims(self):
+        from ai_crypto_trader_tpu.ops.orderbook import price_impact
+
+        bids, _ = self._books()
+        stacked = jnp.stack([bids, bids])              # [2, 6, N, 2]
+        sizes = jnp.asarray([100.0], jnp.float32)
+        out = np.asarray(price_impact(stacked, sizes))
+        assert out.shape == (2, 6, 1)
+        np.testing.assert_array_equal(out[0], out[1])
+
+
+class TestLobWorkloads:
+    def test_backtest_under_stress_lob_dynamics(self):
+        stats, summary = engine.backtest_under_stress(
+            jax.random.PRNGKey(20), scenario=["calm", "liquidity_hole"],
+            num_scenarios=6, steps=512, dynamics="lob")
+        assert np.asarray(stats.final_balance).shape == (6,)
+        assert summary["worst_final_balance"] > 0
+        with pytest.raises(ValueError, match="unknown market dynamics"):
+            engine.backtest_under_stress(jax.random.PRNGKey(0),
+                                         num_scenarios=2, steps=64,
+                                         dynamics="nope")
+
+    def test_env_params_carry_book_features(self):
+        from ai_crypto_trader_tpu.rl import env_reset, env_step, obs_size
+
+        p, labels = engine.scenario_env_params(
+            jax.random.PRNGKey(30), scenario=["calm", "spread_blowout"],
+            num_scenarios=4, steps=512, episode_len=32, dynamics="lob")
+        assert p.obs_table.shape == (4, 512, 10)       # 8 market + 2 book
+        assert obs_size(p) == 12
+        keys = jax.random.split(jax.random.PRNGKey(0), 16)
+        states, obs = jax.vmap(lambda k: env_reset(p, k))(keys)
+        assert obs.shape == (16, 12)
+        s2, obs2, r, done = jax.vmap(
+            lambda s: env_step(p, s, jnp.asarray(1)))(states)
+        assert obs2.shape == (16, 12)
+        assert np.isfinite(np.asarray(r)).all()
+        # the spread column actually varies across scenarios (blowout
+        # rows see wider books than calm rows)
+        spread_col = np.asarray(p.obs_table[..., 8])
+        assert spread_col.max() > 2.0 * max(spread_col.min(), 1e-9)
+
+    def test_default_env_unchanged(self):
+        from ai_crypto_trader_tpu.rl import obs_size
+        from ai_crypto_trader_tpu.rl.env import OBS_SIZE
+
+        p, _ = engine.scenario_env_params(
+            jax.random.PRNGKey(31), scenario="calm", num_scenarios=2,
+            steps=256, episode_len=32)
+        assert p.obs_table.shape[-1] == 8
+        assert obs_size(p) == OBS_SIZE
+
+    def test_dqn_trains_on_book_feature_env(self):
+        from ai_crypto_trader_tpu.rl import (DQNConfig, dqn_init, obs_size,
+                                             train_iterations)
+
+        p, _ = engine.scenario_env_params(
+            jax.random.PRNGKey(40), scenario=["calm", "liquidity_hole"],
+            num_scenarios=4, steps=384, episode_len=64, dynamics="lob")
+        cfg = DQNConfig(num_envs=8, rollout_len=4, state_size=obs_size(p))
+        st = dqn_init(jax.random.PRNGKey(1), p, cfg)
+        st, metrics = train_iterations(p, st, cfg, n_iters=2)
+        assert np.isfinite(np.asarray(metrics["loss"])).all()

@@ -21,9 +21,11 @@ from ai_crypto_trader_tpu.shell.exchange import FakeExchange
 from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
 from ai_crypto_trader_tpu.shell.stream import (
     BinanceStreamSource,
+    DepthCapture,
     MarketStream,
     StreamSupervisor,
     binance_kline_url,
+    depth_frame,
     kline_frame,
     replay_frames,
 )
@@ -634,6 +636,186 @@ class TestSupervisor:
                      "stream_queue_depth", "stream_frames_total",
                      "stream_malformed_frames_total"):
             assert f"crypto_trader_tpu_{name}" in text, name
+
+
+# ---------------------------------------------------------------------------
+# depth-frame capture: ring + journal + telemetry (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def _depth_frames(symbol="BTCUSDC", n=6, combined=False, snapshot=False):
+    frames = []
+    for i in range(n):
+        bids = [[100.0 - 0.1 * j, 5.0 + i + j] for j in range(4)]
+        asks = [[100.1 + 0.1 * j, 4.0 + i + j] for j in range(4)]
+        frames.append(depth_frame(symbol, bids, asks, event_ms=1000 + i,
+                                  first_id=10 * i + 1, final_id=10 * (i + 1),
+                                  snapshot=snapshot, combined=combined))
+    return frames
+
+
+class TestDepthCapture:
+    def _stream(self, **capture_kw):
+        clock, bus, mon = _setup()
+        dc = DepthCapture(**capture_kw)
+        return MarketStream(mon, now_fn=clock, depth=dc), dc
+
+    def test_diff_and_snapshot_frames_round_trip(self):
+        st, dc = self._stream()
+        for f in _depth_frames(n=3):
+            st.ingest_frame(f)
+        st.ingest_frame(_depth_frames(n=1, snapshot=True,
+                                      combined=True)[0])
+        assert dc.frames_total == 4 and dc.malformed == 0
+        recs = dc.records()
+        assert recs[0]["kind"] == "diff" and recs[-1]["kind"] == "snapshot"
+        assert recs[0]["bids"][0] == [100.0, 5.0]      # floats, not strings
+        assert recs[0]["symbol"] == "BTCUSDC"
+        # a snapshot payload has no symbol field — it is recovered from
+        # the combined-stream channel name
+        assert recs[-1]["symbol"] == "BTCUSDC"
+        # contiguous diff ids (U == last u + 1): no gap counted
+        assert dc.gaps == 0
+
+    def test_symbol_filter_sees_enveloped_snapshots(self):
+        st, dc = self._stream(symbols={"BTCUSDC"})
+        st.ingest_frame(_depth_frames(n=1, snapshot=True, combined=True)[0])
+        assert dc.frames_total == 1 and dc.frames_ignored == 0
+
+    def test_update_id_gap_counted(self):
+        st, dc = self._stream()
+        frames = _depth_frames(n=4)
+        st.ingest_frame(frames[0])
+        st.ingest_frame(frames[2])                     # skipped frames[1]
+        assert dc.gaps == 1
+
+    def test_ring_bounded_drop_oldest_and_watermark(self):
+        st, dc = self._stream(ring_max=4)
+        for f in _depth_frames(n=7):
+            st.ingest_frame(f)
+        assert len(dc.records()) == 4
+        assert dc.watermark == 1.0
+        # aging out of a keep-last-N ring is RETENTION, not loss: the
+        # drop counter (the alert input) stays untouched
+        assert dc.frames_dropped == 0
+        # the oldest three frames are gone, the newest four remain
+        assert [r["E"] for r in dc.records()] == [1003, 1004, 1005, 1006]
+
+    def test_journal_checksummed_jsonl(self, tmp_path):
+        from ai_crypto_trader_tpu.utils.journal import replay
+
+        path = str(tmp_path / "depth.jsonl")
+        st, dc = self._stream(path=path)
+        for f in _depth_frames(n=5):
+            st.ingest_frame(f)
+        dc.close()
+        records, stats = replay(path)
+        assert stats["replayed"] == 5 and stats["corrupt_records"] == 0
+        assert all(r["kind"] == "depth" for r in records)
+        assert records[0]["data"]["bids"][0] == [100.0, 5.0]
+
+    def test_journal_bounded_and_exhaustion_counted(self, tmp_path):
+        path = str(tmp_path / "depth.jsonl")
+        st, dc = self._stream(path=path, journal_max=3)
+        for f in _depth_frames(n=6):
+            st.ingest_frame(f)
+        assert dc.journaled == 3                       # disk stays bounded
+        assert dc.frames_total == 6                    # ring keeps capturing
+        assert dc.frames_dropped == 3                  # unpersisted frames
+        assert dc.journal_exhausted is True
+        # a ring-only capture never reports loss or exhaustion
+        st2, dc2 = self._stream(ring_max=2)
+        for f in _depth_frames(n=5):
+            st2.ingest_frame(f)
+        assert dc2.frames_dropped == 0
+        assert dc2.journal_exhausted is False
+
+    def test_symbol_filter(self):
+        st, dc = self._stream(symbols={"ETHUSDC"})
+        for f in _depth_frames(symbol="BTCUSDC", n=2):
+            st.ingest_frame(f)
+        assert dc.frames_total == 0 and dc.frames_ignored == 2
+
+    def test_no_capture_configured_ignores_depth_frames(self):
+        clock, bus, mon = _setup()
+        st = MarketStream(mon, now_fn=clock)
+        before = st.frames_ignored
+        st.ingest_frame(_depth_frames(n=1)[0])
+        assert st.frames_ignored == before + 1         # counted, no crash
+
+    def test_malformed_depth_counted(self):
+        st, dc = self._stream()
+        st.ingest_frame(json.dumps({"e": "depthUpdate", "b": [["x", "y"]]}))
+        assert dc.malformed == 1
+
+    def test_depth_url_channels(self):
+        url = binance_kline_url(["BTCUSDC"], ["1m"],
+                                depth_symbols=["BTCUSDC"])
+        assert "btcusdc@kline_1m" in url and "btcusdc@depth" in url
+
+    def test_telemetry_exported_with_stream_gauges(self, tmp_path):
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        clock, bus, mon = _setup()
+        dc = DepthCapture(path=str(tmp_path / "d.jsonl"), ring_max=4,
+                          journal_max=4)
+        st = MarketStream(mon, now_fn=clock, depth=dc)
+        sup = StreamSupervisor(st, now_fn=clock,
+                               metrics=MetricsRegistry(now_fn=clock))
+        for f in _depth_frames(n=7):
+            sup.offer(f)
+
+        async def go():
+            await sup.step()
+
+        asyncio.run(go())
+        text = sup.metrics.exposition()
+        assert "crypto_trader_tpu_depth_frames_total 7" in text
+        # 3 frames arrived after the 4-record journal budget was spent
+        assert "crypto_trader_tpu_depth_frames_dropped_total 3" in text
+        assert "crypto_trader_tpu_depth_capture_ring_fill 1" in text
+
+    def test_alert_coherence_in_process_and_promql(self):
+        """DepthCaptureSaturated exists in BOTH rule engines (the PR 1
+        coherence guarantee, extended to the capture) — keyed on journal
+        exhaustion, NOT ring fill (a keep-last-N ring sits full by
+        design)."""
+        import yaml
+
+        from ai_crypto_trader_tpu.utils.alerts import AlertManager
+
+        mgr = AlertManager(now_fn=lambda: 1000.0)
+        fired = mgr.evaluate({"depth_journal_exhausted": True,
+                              "depth_ring_fill": 1.0})
+        assert any(a["name"] == "DepthCaptureSaturated" for a in fired)
+        # a full ring alone must NOT fire (retention, not loss)
+        mgr.evaluate({"depth_journal_exhausted": False,
+                      "depth_ring_fill": 1.0})
+        assert "DepthCaptureSaturated" not in mgr.active
+        # absent state (no capture attached) never fires
+        assert not any(a["name"] == "DepthCaptureSaturated"
+                       for a in AlertManager(
+                           now_fn=lambda: 1000.0).evaluate({}))
+        rules = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/alert_rules.yml")))
+        names = {r.get("alert") for g in rules["groups"] for r in g["rules"]}
+        assert {"DepthCaptureSaturated", "DepthFramesDropping",
+                "DepthFeedGaps"} <= names
+
+    def test_launcher_feeds_capture_state_into_alerts(self, tmp_path):
+        clock, sys_, sup, ex, counting = _streamed_system()
+        dc = DepthCapture(path=str(tmp_path / "d.jsonl"), ring_max=2,
+                          journal_max=3)
+        sup.stream.depth = dc
+        for f in _depth_frames(n=5):
+            dc.ingest(json.loads(f))
+        state = sys_._alert_state()
+        assert state["depth_ring_fill"] == 1.0
+        assert state["depth_journal_exhausted"] is True
+        # shutdown flushes the buffered depth JSONL tail
+        sys_.shutdown()
+        from ai_crypto_trader_tpu.utils.journal import replay
+
+        assert replay(str(tmp_path / "d.jsonl"))[1]["replayed"] == 3
 
 
 # ---------------------------------------------------------------------------
