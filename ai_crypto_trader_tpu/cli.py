@@ -457,7 +457,8 @@ def cmd_load(args):
 
     cfg = LoadConfig(tenants=args.tenants, symbols=args.symbols,
                      ticks=args.ticks, window=args.window,
-                     slo_p99_ms=args.slo_ms, seed=args.seed)
+                     slo_p99_ms=args.slo_ms, seed=args.seed,
+                     mode=getattr(args, "mode", "objects"))
     if args.ramp:
         out = ramp(cfg)
     else:
@@ -744,6 +745,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="closed-loop ramp: step tenants until the p99 "
                          "SLO breaches; report max sustainable point + "
                          "the telemetry-named saturated stage")
+    mode = sp.add_mutually_exclusive_group()
+    mode.add_argument("--vmapped", dest="mode", action="store_const",
+                      const="vmapped",
+                      help="tenants as a batch axis: ONE TenantEngine "
+                           "dispatch per tick for all N tenants "
+                           "(ops/tenant_engine.py)")
+    mode.add_argument("--object-lanes", dest="mode", action="store_const",
+                      const="objects",
+                      help="per-tenant Python SignalAnalyzer/TradeExecutor "
+                           "lanes (the PR 10 baseline / parity oracle)")
+    sp.set_defaults(mode="objects")
     sp.add_argument("--seed", type=int, default=0)
     sp.set_defaults(fn=cmd_load)
     sp = sub.add_parser("scan", help="discover + rank tradable pairs")

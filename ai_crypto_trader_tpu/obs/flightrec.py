@@ -54,6 +54,24 @@ GATES = (
     "quarantine",             # executor stage quarantined mid-flight
 )
 
+# Executor gate evaluation ORDER — the priority in which
+# `TradeExecutor.veto_reason` + its sizing gate test a signal, and the
+# priority the vmapped tenant engine's traced predicates resolve in
+# (ops/tenant_engine.py).  Both implementations derive from THIS tuple so
+# the recorded gate can never depend on which path decided; the
+# gate-for-gate parity sweep in tests/test_tenant_engine.py pins it.
+VETO_ORDER = (
+    "nan_gate",
+    "confidence_floor",
+    "strength_floor",
+    "not_buy",
+    "signal_disagreement",
+    "position_open",
+    "pending_intent",
+    "max_positions",
+    "risk_min_size",
+)
+
 
 def _new_id() -> str:
     return uuid.uuid4().hex[:16]

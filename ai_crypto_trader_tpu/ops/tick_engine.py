@@ -230,6 +230,7 @@ class TickEngine:
         self.full_seeds = 0
         self.last_valid = np.zeros((S, F), bool)
         self.last_stats: dict = {}
+        self.last_out: dict | None = None   # newest host output pytree
 
     # -- ingest ---------------------------------------------------------------
     def _seed_slot(self, s: int, f: int, ts: np.ndarray, arr: np.ndarray):
@@ -427,6 +428,10 @@ class TickEngine:
             self.drift_ref_uploads += 1
         self.last_drift = {"psi": drift_psi, "hist": drift_hist,
                            "ref_set": ref_was_set}
+        # newest host output pytree: the tenant engine's feed
+        # (ops/tenant_engine.py reads its [S, F] feature columns directly —
+        # no per-symbol dict assembly between the two fused programs)
+        self.last_out = host
         self.last_stats = {
             "dispatches": 1, "upload_rows": int(n_writes),
             "upload_bytes": int(upload_bytes), "full_seed": bool(seeded),

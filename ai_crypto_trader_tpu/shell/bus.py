@@ -56,6 +56,12 @@ class EventBus:
                  metrics=None, log=None, overflow: dict | None = None,
                  warn_interval_s: float = 30.0):
         self._subs: dict[str, list[asyncio.Queue]] = defaultdict(list)
+        # subscription patterns containing fnmatch wildcards — the ONLY
+        # ones a publish must scan.  Exact-name subscriptions resolve by
+        # dict lookup, so N tenant lanes subscribed to their own
+        # `trading_signals.<lane>` cost a publish O(1), not O(N) fnmatch
+        # calls per message (the vmapped-tenant scale contract).
+        self._wild: set[str] = set()
         self._kv: dict[str, Any] = {}
         self._max_queue = max_queue
         self._now = now_fn
@@ -102,11 +108,16 @@ class EventBus:
         maxsize = 0 if self._policy(channel) == "grow" else self._max_queue
         q: asyncio.Queue = asyncio.Queue(maxsize)
         self._subs[channel].append(q)
+        if any(c in channel for c in "*?["):
+            self._wild.add(channel)
         return q
 
     def unsubscribe(self, channel: str, q: asyncio.Queue) -> None:
         if q in self._subs.get(channel, []):
             self._subs[channel].remove(q)
+            if not self._subs[channel]:
+                del self._subs[channel]
+                self._wild.discard(channel)
 
     async def publish(self, channel: str, message: Any) -> int:
         self.published_counts[channel] += 1
@@ -120,19 +131,24 @@ class EventBus:
             envelope["trace"] = ctx
         fanout_t0 = time.perf_counter() if self.metrics is not None else 0.0
         depth = 0
-        for pattern, queues in list(self._subs.items()):
-            if pattern == channel or fnmatch.fnmatch(channel, pattern):
-                for q in queues:
-                    if q.full():
-                        try:
-                            q.get_nowait()          # drop oldest
-                            dropped += 1
-                        except asyncio.QueueEmpty:
-                            pass
-                    q.put_nowait(envelope)
-                    delivered += 1
-                    if q.qsize() > depth:
-                        depth = q.qsize()
+        # exact-match fast path + wildcard patterns only: fanout cost is
+        # O(subscribers of THIS channel + wildcard patterns), independent
+        # of how many tenant lanes subscribed to their own channels
+        targets = list(self._subs.get(channel, ()))
+        for pattern in self._wild:
+            if pattern != channel and fnmatch.fnmatch(channel, pattern):
+                targets.extend(self._subs.get(pattern, ()))
+        for q in targets:
+            if q.full():
+                try:
+                    q.get_nowait()          # drop oldest
+                    dropped += 1
+                except asyncio.QueueEmpty:
+                    pass
+            q.put_nowait(envelope)
+            delivered += 1
+            if q.qsize() > depth:
+                depth = q.qsize()
         # capture fanout latency BEFORE the drop-logging below: the flushed
         # log write would otherwise inflate exactly the incidents this
         # metric exists to diagnose

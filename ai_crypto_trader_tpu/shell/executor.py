@@ -127,7 +127,15 @@ class TradeExecutor:
         """WHICH gate rejects this signal (None = executable) — the single
         source of truth behind ``should_execute`` AND the flight
         recorder's per-decision rejection reason, so the recorded gate can
-        never drift from the gate actually applied."""
+        never drift from the gate actually applied.
+
+        Gate names AND their evaluation order are the flight recorder's
+        shared vocabulary (`obs.flightrec.GATES` / `VETO_ORDER`): the
+        vmapped tenant engine (ops/tenant_engine.py) re-expresses these
+        same checks as traced predicates resolving in the same priority,
+        and the gate-for-gate parity sweep in tests/test_tenant_engine.py
+        pins the two paths equal.  Changing a check here without updating
+        the traced twin (and VETO_ORDER) fails that sweep."""
         # poisoned-payload gate: a NaN/zero price reaching the sizer would
         # turn into a NaN-quantity order and poison the venue balances —
         # reject non-finite numerics at the door (docs/RESILIENCE.md)

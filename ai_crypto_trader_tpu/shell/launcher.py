@@ -167,6 +167,13 @@ class TradingSystem:
         self.saturation = (SaturationMonitor(metrics=self.metrics,
                                              tick_budget_s=self.tick_budget_s)
                            if self.enable_saturation else None)
+        if self.saturation is not None:
+            # the launcher is the one-tenant deployment: its decision
+            # lanes are 1 tenant × the symbol universe, evaluated through
+            # per-symbol Python services — tenant_lanes{mode="objects"}.
+            # The vmapped tenant engine (ops/tenant_engine.py) stamps
+            # mode="vmapped" through the load harness.
+            self.saturation.set_tenant_lanes(len(self.symbols), "objects")
         self.loop_lag = EventLoopLagProbe()
         # decision provenance & model quality (obs/): flight recorder +
         # prediction scorecard + PnL attribution, default-on (the trading

@@ -3,31 +3,45 @@ ramp that finds a host's max sustainable tenants×symbols at a fixed tick
 latency SLO.
 
 ROADMAP item 4's "millions of users" axis gets its first *measured* number
-here: N independent tenant decision lanes driven through the REAL serving
-path — recorded kline frames offered to a `StreamSupervisor`, drained
-through `MarketMonitor.poll` into ONE fused `TickEngine` dispatch, then
-every tenant's `SignalAnalyzer` → `TradeExecutor` lane (each with its own
-FakeExchange venue) on the shared bus.  Nothing is mocked below the frame
+here: N tenant decision lanes driven through the REAL serving path —
+recorded kline frames offered to a `StreamSupervisor`, drained through
+`MarketMonitor.poll` into ONE fused `TickEngine` dispatch, then the tenant
+decision layer on the shared bus.  Nothing is mocked below the frame
 transport: the harness exercises the same parse/continuity/scatter-
 list/dispatch/fan-out machinery production runs, so the latency it
 measures is the latency a host would serve (Podracer, arXiv:2104.06272:
 throughput claims only mean something as a closed loop against a
 latency/utilization budget).
 
-Two layers:
+Two tenant modes (`LoadConfig.mode`):
+
+  * **"objects"** — each tenant is its own `SignalAnalyzer` +
+    `TradeExecutor` Python object pair on per-lane
+    `trading_signals.<lane>` channels.  Host cost grows O(N·S) in
+    interpreter work: the PR 10 baseline, kept as the parity oracle.
+  * **"vmapped"** — tenants are DATA (ops/tenant_engine.py): one
+    `TenantEngine` dispatch evaluates every (tenant, symbol) verdict,
+    veto gate and position size straight from the fused tick engine's
+    output pytree, and only the EXECUTABLE decisions fan out to lazily
+    created per-tenant executors (fills/journaling keep the per-tenant
+    client-order-id namespace — the thin Python rim the venue forces).
+    One shared `market_updates` subscription feeds every lane.
+
+Layers:
 
   * **`SyntheticTenantTraffic`** — one deterministic, seeded load point
     (`tenants × symbols` at full tick rate).  Each tick: advance the
     venue clock, build the tick's kline frames (`testing/chaos.py
-    kline_frames_for` — the recorded-feed builders), offer them to the
-    supervisor, drain, run every tenant lane, and record the wall-clock
-    event→decision latency.  A `SaturationMonitor` (utils/saturation.py)
-    times every stage against the SLO budget, so a breach is *attributed*
-    by telemetry, never inferred.  `analyzer_lag_s` / `executor_lag_s`
-    inject a per-lane blocking delay (tests force a KNOWN stage to
-    saturate; the event-loop-lag probe sees the block too).
-  * **`ramp()`** — the closed-loop controller: step the tenant count up a
-    schedule, measure each point, stop at the first p99 SLO breach, and
+    kline_frames_for`), offer them to the supervisor, drain, run the
+    tenant layer, and record the wall-clock event→decision latency.  A
+    `SaturationMonitor` (utils/saturation.py) times every stage against
+    the SLO budget, so a breach is *attributed* by telemetry, never
+    inferred.  `set_tenants()` re-provisions the tenant layer in place
+    (the stream stays warm) and `reset_measurement()` re-windows every
+    sliding quantile/duty window — each ramp step measures ONLY itself.
+  * **`ramp()`** — the closed-loop controller: ONE traffic harness, the
+    tenant count stepped up a schedule, each point measured in a fresh
+    window, stop at the first p99 SLO breach, bisect to ±1 tenant, and
     report the max sustainable point plus the saturated stage(s) the
     gauges name at the breach.  `bench.py`'s `capacity` row and
     `cli load --ramp` both drive this.
@@ -48,6 +62,7 @@ import numpy as np
 from ai_crypto_trader_tpu.config import TradingParams
 from ai_crypto_trader_tpu.data.ingest import OHLCV
 from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.ops.tenant_engine import TenantEngine
 from ai_crypto_trader_tpu.shell.analyzer import SignalAnalyzer
 from ai_crypto_trader_tpu.shell.bus import EventBus
 from ai_crypto_trader_tpu.shell.exchange import FakeExchange
@@ -80,12 +95,18 @@ class LoadConfig:
     min_samples: int = 4              # saturation window gate (short steps)
     duty_threshold: float = 0.75
     tick_step_s: float = 60.0         # virtual-clock advance per tick
+    # Tenant evaluation mode: "objects" (per-lane Python services — the
+    # PR 10 baseline and parity oracle) or "vmapped" (one TenantEngine
+    # dispatch for all N tenants, ops/tenant_engine.py).
+    mode: str = "objects"
     # Per-lane injected BLOCKING delay per tick (seconds) — deterministic
     # saturation for tests/drills: total stage busy grows linearly with
     # tenants, so the ramp breaches at a known point and the named stage
-    # is the one that was actually loaded.
+    # is the one that was actually loaded.  (objects mode; in vmapped
+    # mode `engine_lag_s` blocks once per tick inside the tenant stage.)
     analyzer_lag_s: float = 0.0
     executor_lag_s: float = 0.0
+    engine_lag_s: float = 0.0
     # Per-tenant execution gates: default params veto most signals (the
     # decision fan-out IS the load); permissive params open real positions
     # so the venue/SL-TP path is loaded too.
@@ -96,8 +117,8 @@ class LoadConfig:
 class _TenantLane:
     name: str
     venue: FakeExchange
-    analyzer: SignalAnalyzer
     executor: TradeExecutor
+    analyzer: SignalAnalyzer | None = None
 
 
 def _synthetic_series(cfg: LoadConfig, n_hist: int) -> dict:
@@ -115,21 +136,28 @@ def _synthetic_series(cfg: LoadConfig, n_hist: int) -> dict:
 
 
 class SyntheticTenantTraffic:
-    """One load point, fully assembled: venue → frames → supervisor →
-    fused monitor → N tenant (analyzer, executor) lanes on one bus."""
+    """One load harness, fully assembled: venue → frames → supervisor →
+    fused monitor → the tenant decision layer on one bus.
 
-    def __init__(self, cfg: LoadConfig, metrics: MetricsRegistry | None = None):
+    ``points`` sizes the synthetic history for that many measurement
+    windows, so `ramp()` can reuse ONE harness (warm stream, shared
+    compiled programs) across its whole schedule."""
+
+    def __init__(self, cfg: LoadConfig, metrics: MetricsRegistry | None = None,
+                 points: int = 1):
         self.cfg = cfg
         self.clock = {"t": 0.0}
         self.metrics = metrics if metrics is not None else MetricsRegistry(
             now_fn=self._now)
         mult = max(int(np.ceil(interval_ms(iv) / 60_000))
                    for iv in cfg.intervals)
-        n_hist = cfg.window * mult + cfg.ticks + cfg.warmup_ticks + 64
-        series = _synthetic_series(cfg, n_hist)
-        self.market = FakeExchange(series)
-        self.market.advance(steps=n_hist - cfg.ticks - cfg.warmup_ticks - 8)
-        self.symbols = sorted(series)
+        per_point = cfg.ticks + cfg.warmup_ticks
+        n_hist = cfg.window * mult + per_point * max(int(points), 1) + 64
+        self._series = _synthetic_series(cfg, n_hist)
+        self.market = FakeExchange(self._series)
+        self.market.advance(steps=n_hist - per_point * max(int(points), 1)
+                            - 8)
+        self.symbols = sorted(self._series)
         # transport-call counter: the steady state must serve from the
         # stream's candle books, ZERO REST kline calls (the PR 9 contract
         # — at load, REST fallback would BE the bottleneck)
@@ -148,39 +176,183 @@ class SyntheticTenantTraffic:
             self.metrics, tick_budget_s=cfg.slo_p99_ms / 1e3,
             min_samples=cfg.min_samples, duty_threshold=cfg.duty_threshold)
         self.loop_lag = EventLoopLagProbe()
-        self.lanes = [self._lane(i, series) for i in range(cfg.tenants)]
+        self.lanes: list[_TenantLane] = []
+        self.tenant_engine: TenantEngine | None = None
+        self._updates_q = None
+        self._vm_lanes: dict[int, _TenantLane] = {}
+        self.last_fanout: list[tuple[int, int]] = []
         self.latencies_ms: list[float] = []
         self.published = self.analyzed = self.executed = 0
         self._seed_rest_calls = 0
+        self.set_tenants(cfg.tenants)
 
     def _now(self) -> float:
         return self.clock["t"]
 
-    def _lane(self, i: int, series: dict) -> _TenantLane:
+    # -- tenant provisioning --------------------------------------------------
+    def _lane(self, i: int, with_analyzer: bool = True) -> _TenantLane:
         name = f"t{i}"
-        venue = FakeExchange(series, quote_balance=10_000.0)
+        venue = FakeExchange(self._series, quote_balance=10_000.0)
         venue.cursor = dict(self.market.cursor)      # lockstep prices
-        analyzer = SignalAnalyzer(self.bus, now_fn=self._now,
-                                  analysis_interval_s=0.0, lane=name)
         executor = TradeExecutor(self.bus, venue, now_fn=self._now,
                                  lane=name, coid_prefix=f"ld{i}",
                                  trading=self.cfg.trading or TradingParams())
-        # subscribe before the first publish (the launcher discipline)
-        analyzer._queue()
+        analyzer = None
+        if with_analyzer:
+            analyzer = SignalAnalyzer(self.bus, now_fn=self._now,
+                                      analysis_interval_s=0.0, lane=name)
+            # subscribe before the first publish (the launcher discipline)
+            analyzer._queue()
         executor._queue()
-        return _TenantLane(name, venue, analyzer, executor)
+        return _TenantLane(name, venue, executor, analyzer)
 
+    def _drop_lane(self, lane: _TenantLane) -> None:
+        if lane.analyzer is not None and hasattr(lane.analyzer, "_q"):
+            self.bus.unsubscribe("market_updates", lane.analyzer._q)
+        if hasattr(lane.executor, "_q"):
+            self.bus.unsubscribe(f"trading_signals.{lane.name}",
+                                 lane.executor._q)
+
+    def set_tenants(self, n: int) -> None:
+        """Re-provision the tenant layer for ``n`` tenants in place: the
+        stream/monitor stay warm (their compiled programs and candle
+        books carry over), tenant state starts fresh — each ramp step is
+        a clean load point over a hot serving path."""
+        self.cfg = replace(self.cfg, tenants=int(n))
+        for lane in self.lanes:
+            self._drop_lane(lane)
+        for lane in self._vm_lanes.values():
+            self._drop_lane(lane)
+        self._vm_lanes = {}
+        self.lanes = []
+        if self.cfg.mode == "vmapped":
+            if self._updates_q is None:
+                # ONE shared market_updates subscription feeds all lanes
+                self._updates_q = self.bus.subscribe("market_updates")
+            if self.tenant_engine is None:
+                self.tenant_engine = TenantEngine(
+                    self.symbols, n, trading=self.cfg.trading)
+            else:
+                self.tenant_engine.configure(n, trading=self.cfg.trading)
+        else:
+            self.lanes = [self._lane(i) for i in range(self.cfg.tenants)]
+        self.saturation.set_tenant_lanes(
+            self.cfg.tenants * self.cfg.symbols, self.cfg.mode)
+
+    def reset_measurement(self) -> None:
+        """Start a fresh measurement window: latencies, throughput
+        counters, saturation duty/quantile windows and the loop-lag
+        probe all reset so a heavy step's tail can NEVER bleed into the
+        next step's p99 (the ramp bisect's correctness contract)."""
+        self.latencies_ms = []
+        self.published = self.analyzed = self.executed = 0
+        self._seed_rest_calls = self.counting.kline_calls
+        self.saturation.reset_windows()
+        self.loop_lag.reset()
+
+    # -- vmapped decision layer ----------------------------------------------
+    def _vm_lane(self, i: int) -> _TenantLane:
+        lane = self._vm_lanes.get(i)
+        if lane is None:
+            # executors exist per tenant only once the tenant actually
+            # trades — the venue-forced rim stays O(executing tenants)
+            lane = self._vm_lanes[i] = self._lane(i, with_analyzer=False)
+        return lane
+
+    async def _vm_tick(self) -> set[int]:
+        """Drain the shared market_updates subscription, run ONE tenant
+        engine dispatch over the fused tick output, fan the executable
+        decisions out on their per-lane channels.  Returns the lane
+        indices that received signals (only those executors drain)."""
+        eng = self.tenant_engine
+        updates: dict = {}
+        q = self._updates_q
+        while not q.empty():
+            u = q.get_nowait()["data"]
+            updates[u["symbol"]] = u
+        if not updates:
+            return set()
+        live = self.bus.get("strategy_params") or {}
+        eng.set_live_overrides(
+            live.get("stop_loss") if isinstance(live.get("stop_loss"),
+                                                (int, float)) else None,
+            live.get("take_profit") if isinstance(live.get("take_profit"),
+                                                  (int, float)) else None)
+        tick_eng = self.monitor._engine
+        due = np.zeros(eng.S, bool)
+        for sym in updates:
+            s = eng.sym_index.get(sym)
+            if s is not None:
+                due[s] = True
+        if tick_eng is not None and tick_eng.last_out is not None:
+            feats = eng.feats_from_tick(tick_eng.last_out,
+                                        tick_eng.last_valid, due_mask=due)
+        else:                        # per-symbol monitor path fallback
+            feats = eng.feats_from_updates(updates)
+        out = eng.decide(feats)
+        if self.cfg.engine_lag_s:
+            time.sleep(self.cfg.engine_lag_s)        # BLOCKING on purpose
+        self.analyzed += eng.n_tenants * len(updates)
+        for gate, count in eng.veto_counts(out).items():
+            self.metrics.inc("decision_vetoes_total", count, gate=gate)
+        self.last_fanout = eng.executable(out)
+        dirty: set[int] = set()
+        for n, s in self.last_fanout:
+            sym = self.symbols[s]
+            u = updates.get(sym)
+            if u is None:
+                continue
+            lane = self._vm_lane(n)
+            signal = {
+                "symbol": sym, "timestamp": self._now(),
+                "current_price": u.get("current_price"),
+                "signal": u.get("signal", "NEUTRAL"),
+                "signal_strength": u.get("signal_strength", 0.0),
+                "volatility": u.get("volatility", 0.0),
+                "avg_volume": u.get("avg_volume", 0.0),
+                "decision": "BUY",
+                "confidence": float(out["confidence"][n, s]),
+                "reasoning": "vmapped tenant engine",
+                "model_version": None,
+                "top_family": u.get("top_family"),
+                "structure_version": u.get("structure_version"),
+                "lane": lane.name,
+            }
+            await self.bus.publish(f"trading_signals.{lane.name}", signal)
+            dirty.add(n)
+        return dirty
+
+    def _vm_reconcile(self) -> None:
+        """Venue truth wins, per MATERIALIZED tenant: the engine's open
+        set re-anchors on the executor's books (an entry that never
+        landed is cleared; a position the executor closed — protective
+        SL/TP filled venue-side, exit sold — frees its position_open
+        flag and max_positions slot) and the balance re-anchors on the
+        venue (closure proceeds / protective credits the engine's entry
+        model never sees — exactly what object-lane executors size
+        from).  O(trading tenants) host work; a correction re-seeds from
+        the mirror on the next dispatch (a transfer, never a compile)."""
+        for n, lane in self._vm_lanes.items():
+            self.tenant_engine.sync_positions(
+                n, lane.executor.active_trades)
+            self.tenant_engine.sync_balance(
+                n, lane.venue.get_balances().get("USDC", 0.0))
+
+    # -- one tick -------------------------------------------------------------
     async def tick(self, timed: bool = True) -> float:
         """One full load tick; returns the wall event→decision latency in
         ms.  The timed region starts when the tick's frames hit the
-        supervisor (`offer`) and ends when every tenant lane has drained
-        its decisions — frame parse + continuity + scatter-list upload +
-        ONE fused dispatch + ONE host readback + bus fan-out + N×(analyze
-        + execute)."""
+        supervisor (`offer`) and ends when every tenant decision has been
+        drained — frame parse + continuity + scatter-list upload + ONE
+        fused dispatch + ONE host readback + bus fan-out + the tenant
+        layer (N×(analyze + execute) in objects mode; ONE TenantEngine
+        dispatch + executable-only fan-out in vmapped mode)."""
         cfg, sat = self.cfg, self.saturation
         self.clock["t"] += cfg.tick_step_s
         self.market.advance(steps=1)
         for lane in self.lanes:
+            lane.venue.advance(steps=1)
+        for lane in self._vm_lanes.values():
             lane.venue.advance(steps=1)
         frames = kline_frames_for(self.market, self.symbols, cfg.intervals)
         if timed:
@@ -192,16 +364,27 @@ class SyntheticTenantTraffic:
             self.supervisor.offer(f)
         with sat.stage("stream"):
             self.published += await self.supervisor.step()
-        with sat.stage("analyzer"):
-            for lane in self.lanes:
-                self.analyzed += await lane.analyzer.run_once()
-                if cfg.analyzer_lag_s:
-                    time.sleep(cfg.analyzer_lag_s)   # BLOCKING on purpose
-        with sat.stage("executor"):
-            for lane in self.lanes:
-                self.executed += await lane.executor.run_once()
-                if cfg.executor_lag_s:
-                    time.sleep(cfg.executor_lag_s)
+        if cfg.mode == "vmapped":
+            with sat.stage("tenant_engine"):
+                dirty = await self._vm_tick()
+            with sat.stage("executor"):
+                for n in sorted(dirty):
+                    lane = self._vm_lanes[n]
+                    self.executed += await lane.executor.run_once()
+                    if cfg.executor_lag_s:
+                        time.sleep(cfg.executor_lag_s)
+                self._vm_reconcile()
+        else:
+            with sat.stage("analyzer"):
+                for lane in self.lanes:
+                    self.analyzed += await lane.analyzer.run_once()
+                    if cfg.analyzer_lag_s:
+                        time.sleep(cfg.analyzer_lag_s)  # BLOCKING on purpose
+            with sat.stage("executor"):
+                for lane in self.lanes:
+                    self.executed += await lane.executor.run_once()
+                    if cfg.executor_lag_s:
+                        time.sleep(cfg.executor_lag_s)
         wall_ms = (time.perf_counter() - t0) * 1e3
         # one real loop iteration so the lag probe's callback (and any
         # call_soon work the stages queued) completes inside this tick
@@ -222,9 +405,10 @@ class SyntheticTenantTraffic:
         for _ in range(self.cfg.warmup_ticks):
             await self.tick(timed=False)
         # measured window starts clean: warmup publishes/analyses (and
-        # the REST seeds) belong to compile/seed, not the load point
-        self._seed_rest_calls = self.counting.kline_calls
-        self.published = self.analyzed = self.executed = 0
+        # the REST seeds) belong to compile/seed, not the load point —
+        # and on a REUSED harness the previous step's quantile/duty
+        # windows must not bleed into this one
+        self.reset_measurement()
         for _ in range(self.cfg.ticks):
             await self.tick(timed=True)
         return self.report()
@@ -235,6 +419,7 @@ class SyntheticTenantTraffic:
         return {
             "tenants": cfg.tenants, "symbols": cfg.symbols,
             "lanes": cfg.tenants * cfg.symbols,
+            "mode": cfg.mode,
             "ticks": len(self.latencies_ms),
             "p50_ms": round(float(np.percentile(lat, 50)), 3),
             "p99_ms": round(float(np.percentile(lat, 99)), 3),
@@ -278,6 +463,13 @@ def ramp(base: LoadConfig, tenant_steps: list[int] | None = None,
     the breach (the acceptance contract: attribution comes from the
     duty-cycle gauges, not from guessing).
 
+    ONE harness serves the whole schedule: `set_tenants()` re-provisions
+    the tenant layer per step over the warm stream/engine, and
+    `reset_measurement()` re-windows every sliding quantile/duty window
+    per step — a heavy step's latency tail must never pollute the next
+    step's p99, or the bisect converges on a stale breach (the
+    regression tests/test_loadgen.py pins).
+
     ``refine`` (default on) bisects the gap between the last sustainable
     step and the breaching step down to ±1 tenant.  The doubling
     schedule alone quantizes the headline to powers of two — a breach
@@ -286,9 +478,18 @@ def ramp(base: LoadConfig, tenant_steps: list[int] | None = None,
     moves by at most one tenant's worth instead."""
     steps = tenant_steps or default_tenant_steps(base.tenants)
     slo_ms = base.slo_p99_ms
+    # history capacity for every scheduled step + the bisect's worst case
+    # (bounded by log2 of the LARGEST step — caller-supplied schedules may
+    # exceed base.tenants, and exhausting the synthetic series would
+    # silently freeze prices at the cursor clamp)
+    cap = max(max(steps), base.tenants, 2)
+    points = len(steps) + int(np.ceil(np.log2(cap))) + 4
+    traffic = SyntheticTenantTraffic(replace(base, tenants=steps[0]),
+                                     metrics=metrics, points=points)
 
     def measure(tenants: int) -> dict:
-        rep = run_load(replace(base, tenants=tenants), metrics=metrics)
+        traffic.set_tenants(tenants)
+        rep = asyncio.run(traffic.run())
         rep["slo_p99_ms"] = slo_ms
         rep["breached"] = rep["p99_ms"] > slo_ms
         return rep
@@ -319,6 +520,7 @@ def ramp(base: LoadConfig, tenant_steps: list[int] | None = None,
 
     return {
         "slo_p99_ms": slo_ms,
+        "mode": base.mode,
         "steps": reports,
         "max_sustainable": point(max_sustainable) if max_sustainable else None,
         "breach": point(breach) if breach else None,

@@ -129,6 +129,13 @@ class EventLoopLagProbe:
         self.samples += 1
         self._pending = False
 
+    def reset(self) -> None:
+        """Fresh measurement window (the load ramp's per-step re-window;
+        an in-flight sample completes into the new window)."""
+        self.last_lag_s = 0.0
+        self.max_lag_s = 0.0
+        self.samples = 0
+
     def sample(self) -> float:
         """Schedule one measurement on the running loop (no-op while one
         is in flight, or with no loop running — e.g. sync tests); returns

@@ -35,7 +35,9 @@ Exported gauges (MetricsRegistry): ``stage_duty_cycle{stage}``,
 ``saturation_samples{stage}``, ``stage_busy_seconds_total{stage}``,
 ``bus_queue_utilization{channel}``, ``bus_queue_high_watermark{channel}``,
 ``scatter_list_occupancy``, ``host_readback_share``,
-``event_loop_lag_seconds``.  `alert_state()` feeds the in-process
+``event_loop_lag_seconds``, ``tenant_lanes{mode=}`` (decision lanes
+served, object-lane vs vmapped tenant engine).  `alert_state()` feeds the
+in-process
 StageSaturated / BusBackpressure / EventLoopLagHigh rules
 (utils/alerts.py); monitoring/alert_rules.yml carries the PromQL twins.
 `status()` is the `capacity` block on the dashboard's /state.json.
@@ -93,6 +95,12 @@ class SaturationMonitor:
         self.bus_watermarks: dict[str, int] = {}
         self.last_duty: dict[str, float] = {}
         self.last_wall_s = 0.0
+        # tenant decision lanes currently served (tenants × symbols) and
+        # how they are evaluated: "objects" = per-lane Python services,
+        # "vmapped" = the batched tenant engine (ops/tenant_engine.py).
+        # Exported as tenant_lanes{mode=} and carried on status().
+        self.tenant_lanes = 0
+        self.tenant_mode = "objects"
 
     # -- per-stage busy time --------------------------------------------------
     @contextmanager
@@ -192,6 +200,31 @@ class SaturationMonitor:
         would otherwise pollute the attribution surface)."""
         self._busy.clear()
 
+    def set_tenant_lanes(self, lanes: int, mode: str = "objects") -> None:
+        self.tenant_lanes = int(lanes)
+        self.tenant_mode = str(mode)
+
+    def reset_windows(self) -> None:
+        """Start a fresh measurement window: clear the sliding duty /
+        host-read-share quantile windows, the per-tick busy accumulation,
+        bus snapshots and watermarks.  The load ramp calls this between
+        steps — without it a heavy step's tail bleeds into the next
+        step's windows and the bisect can converge on a STALE breach
+        (the regression tests/test_loadgen.py pins).  Cumulative busy
+        totals survive (they are counters, not windows)."""
+        self.ticks = 0
+        self._busy.clear()
+        self._windows.clear()
+        self._share_window.clear()
+        self._engine = {}
+        self._engine_src = None
+        self._engine_fresh = False
+        self.last_loop_lag_s = 0.0
+        self.last_bus = {}
+        self.bus_watermarks = {}
+        self.last_duty = {}
+        self.last_wall_s = 0.0
+
     # -- views ----------------------------------------------------------------
     def windowed_duty(self) -> dict:
         """{stage: mean duty over the window} — the attribution surface."""
@@ -260,6 +293,9 @@ class SaturationMonitor:
         m.set_gauge("scatter_list_occupancy", self.scatter_occupancy())
         m.set_gauge("host_readback_share", self.host_read_share())
         m.set_gauge("event_loop_lag_seconds", self.last_loop_lag_s)
+        if self.tenant_lanes:
+            m.set_gauge("tenant_lanes", self.tenant_lanes,
+                        mode=self.tenant_mode)
 
     def status(self) -> dict:
         """JSON-able snapshot — the `capacity` block on /state.json."""
@@ -267,6 +303,8 @@ class SaturationMonitor:
         return {
             "ticks": self.ticks,
             "tick_budget_s": self.tick_budget_s,
+            "tenant_lanes": self.tenant_lanes,
+            "tenant_mode": self.tenant_mode,
             "stage_duty": {k: round(v, 4) for k, v in sorted(duty.items())},
             "stage_busy_seconds_total": {
                 k: round(v, 4)

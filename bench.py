@@ -17,7 +17,13 @@ The other target rows print one JSON line each ahead of it:
   capacity                max sustainable tenants×symbols per host at a
                           fixed p99 tick-latency SLO (testing/loadgen.py
                           closed-loop ramp; breach attributed to a named
-                          saturated stage by utils/saturation.py gauges)
+                          saturated stage by utils/saturation.py gauges).
+                          Measured in BOTH tenant modes — object lanes
+                          (per-tenant Python services) and vmapped (ONE
+                          ops/tenant_engine.py dispatch for all N
+                          tenants); headline = vmapped lanes, the row
+                          carries object_lanes + speedup, and mode +
+                          tenants_cap key the gate
   flightrec               decision-provenance recorder (obs/flightrec.py):
                           records/s through ring + checksummed JSONL, and
                           % overhead on the fused tick path (recorder on
@@ -162,6 +168,7 @@ def append_history(rows: list, path: str | None = None,
               "BENCH_FLIGHTREC_N", "BENCH_FLIGHTREC_SYMBOLS",
               "BENCH_RECOVERY_TRADES", "BENCH_STREAM_SYMBOLS",
               "BENCH_STREAM_TICKS", "BENCH_LOAD_TENANTS",
+              "BENCH_LOAD_TENANTS_VMAPPED",
               "BENCH_LOAD_SYMBOLS", "BENCH_LOAD_TICKS",
               "BENCH_LOAD_SLO_MS",
               "BENCH_GA_T", "BENCH_GA_POP", "BENCH_GA_GENS",
@@ -227,10 +234,18 @@ def _gate_key(r: dict) -> tuple:
     additionally key on the count: a 1-chip dev-host trajectory and an
     8-chip pod trajectory are different curves of the same metric.  Rows
     without the stamp read as 1 chip, so pre-stamp history keeps gating
-    single-device runs."""
+    single-device runs.
+
+    MODE-stamped rows (the capacity row's mode=vmapped|objects and its
+    tenants_cap ramp ceiling) key on those too: a vmapped-tenant run
+    must never gate an object-lane history row — the two measure
+    different serving architectures of the same metric.  Rows without
+    the stamps (pre-refactor history) key as empty and keep gating only
+    each other."""
     scale = r.get("scale") or {}
     return (r["metric"], r.get("device_kind", "unknown"),
-            tuple(sorted(scale.items())), int(r.get("devices") or 1))
+            tuple(sorted(scale.items())), int(r.get("devices") or 1),
+            str(r.get("mode") or ""), str(r.get("tenants_cap") or ""))
 
 
 def gate_history(rows: list, tolerance: float = GATE_TOLERANCE):
@@ -257,7 +272,7 @@ def gate_history(rows: list, tolerance: float = GATE_TOLERANCE):
                 best_prior[key] = r
     ok, report = True, []
     for key in sorted(latest):
-        metric, device_kind, scale, devices = key
+        metric, device_kind, scale, devices, mode, tenants_cap = key
         row, best = latest[key], best_prior.get(key)
         rec = {"metric": metric, "device_kind": device_kind,
                "value": row["value"], "unit": row.get("unit")}
@@ -265,6 +280,10 @@ def gate_history(rows: list, tolerance: float = GATE_TOLERANCE):
             rec["scale"] = dict(scale)
         if devices != 1:
             rec["devices"] = devices
+        if mode:
+            rec["mode"] = mode
+        if tenants_cap:
+            rec["tenants_cap"] = tenants_cap
         if best is None:
             rec.update(status="new")
         else:
@@ -316,7 +335,9 @@ def trend_table(rows: list, report: list, last_n: int = 5) -> list[str]:
             continue
         key = (rec["metric"], rec["device_kind"],
                tuple(sorted((rec.get("scale") or {}).items())),
-               int(rec.get("devices") or 1))
+               int(rec.get("devices") or 1),
+               str(rec.get("mode") or ""),
+               str(rec.get("tenants_cap") or ""))
         trail = by_key.get(key, [])[-last_n:]
         if not trail:
             continue
@@ -1142,44 +1163,63 @@ def bench_stream():
 def bench_capacity():
     """capacity row: max sustainable tenants×symbols per host at a fixed
     p99 tick-latency SLO (testing/loadgen.py closed-loop ramp — ROADMAP
-    item 4's first measured "millions of users" number).
+    item 4's "millions of users" number), measured in BOTH tenant modes.
 
-    The ramp doubles tenant decision lanes over an S-symbol universe
-    through the REAL serving path (stream supervisor → fused tick engine
-    → per-tenant analyzer/executor lanes on one bus) until the measured
-    p99 breaches BENCH_LOAD_SLO_MS; the headline value is the last
-    sustainable tenants×symbols product, and the saturation gauges'
-    attribution (which stage ate the budget at the breach) rides the row.
-    BENCH_LOAD_* knobs land in the history scale stamp, so a dev-scale
-    run never gates a full-scale one."""
+    The object-lane ramp (per-tenant Python SignalAnalyzer/TradeExecutor
+    services — the PR 10 baseline) runs to BENCH_LOAD_TENANTS; the
+    vmapped ramp (ONE ops/tenant_engine.py dispatch for all N tenants)
+    runs to BENCH_LOAD_TENANTS_VMAPPED.  Both drive the REAL serving path
+    (stream supervisor → fused tick engine → decision layer on one bus)
+    until the measured p99 breaches BENCH_LOAD_SLO_MS.  The HEADLINE
+    value is the vmapped sustainable tenants×symbols product; the row
+    carries both numbers plus the speedup, and stamps mode + tenants_cap
+    into the gate key so a vmapped run never gates an object-lane
+    history row (and vice versa).  The saturation gauges' attribution
+    (which stage ate the budget at the breach) rides the row."""
     from ai_crypto_trader_tpu.testing.loadgen import LoadConfig, ramp
 
     tenants = int(os.environ.get("BENCH_LOAD_TENANTS", "8"))
+    vm_tenants = int(os.environ.get("BENCH_LOAD_TENANTS_VMAPPED", "256"))
     symbols = int(os.environ.get("BENCH_LOAD_SYMBOLS", "4"))
     ticks = int(os.environ.get("BENCH_LOAD_TICKS", "10"))
     slo_ms = float(os.environ.get("BENCH_LOAD_SLO_MS", "250"))
-    base = LoadConfig(tenants=tenants, symbols=symbols, ticks=ticks,
-                      slo_p99_ms=slo_ms)
-    t0 = time.perf_counter()
-    out = ramp(base)
-    best = out["max_sustainable"]
-    log(f"capacity: ramp over {[s['tenants'] for s in out['steps']]} tenants "
-        f"× {symbols} symbols @ p99 SLO {slo_ms:.0f} ms took "
-        f"{time.perf_counter() - t0:.1f}s")
-    if best is None:
-        log("capacity: SLO breached at the FIRST step — no sustainable "
-            "point at this scale")
-    log(f"capacity: max sustainable "
-        f"{(best or {}).get('lanes', 0)} tenant×symbol lanes "
-        f"(p99 {(best or {}).get('p99_ms')} ms); breach "
-        f"{out['breach']} attributed to {out['saturated_stages'] or None} "
-        f"(bottleneck: {out['bottleneck_stage']})")
-    emit("capacity", float((best or {}).get("lanes", 0)), "tenant_symbols",
-         None, tenants=(best or {}).get("tenants", 0), symbols=symbols,
-         p99_ms=(best or {}).get("p99_ms"), slo_p99_ms=slo_ms,
-         breach=out["breach"],
-         saturated_stages=out["saturated_stages"],
-         bottleneck_stage=out["bottleneck_stage"])
+
+    def run_mode(mode: str, cap: int) -> tuple[dict, dict]:
+        base = LoadConfig(tenants=cap, symbols=symbols, ticks=ticks,
+                          slo_p99_ms=slo_ms, mode=mode)
+        t0 = time.perf_counter()
+        out = ramp(base)
+        best = out["max_sustainable"] or {}
+        log(f"capacity[{mode}]: ramp over "
+            f"{[s['tenants'] for s in out['steps']]} tenants × {symbols} "
+            f"symbols @ p99 SLO {slo_ms:.0f} ms took "
+            f"{time.perf_counter() - t0:.1f}s — max sustainable "
+            f"{best.get('lanes', 0)} lanes (p99 {best.get('p99_ms')} ms); "
+            f"breach {out['breach']} attributed to "
+            f"{out['saturated_stages'] or None} "
+            f"(bottleneck: {out['bottleneck_stage']})")
+        return out, best
+
+    out_obj, best_obj = run_mode("objects", tenants)
+    out_vm, best_vm = run_mode("vmapped", vm_tenants)
+    obj_lanes = int(best_obj.get("lanes", 0))
+    vm_lanes = int(best_vm.get("lanes", 0))
+    speedup = vm_lanes / obj_lanes if obj_lanes else None
+    log(f"capacity: vmapped {vm_lanes} vs object-lane {obj_lanes} "
+        f"tenant×symbol lanes at the same SLO "
+        f"({'%.1fx' % speedup if speedup else 'n/a'})")
+    emit("capacity", float(vm_lanes), "tenant_symbols", None,
+         mode="vmapped", tenants_cap=vm_tenants,
+         tenants=best_vm.get("tenants", 0), symbols=symbols,
+         p99_ms=best_vm.get("p99_ms"), slo_p99_ms=slo_ms,
+         breach=out_vm["breach"],
+         saturated_stages=out_vm["saturated_stages"],
+         bottleneck_stage=out_vm["bottleneck_stage"],
+         vmapped_lanes=vm_lanes, object_lanes=obj_lanes,
+         object_p99_ms=best_obj.get("p99_ms"),
+         object_tenants_cap=tenants,
+         object_bottleneck_stage=out_obj["bottleneck_stage"],
+         speedup=round(speedup, 2) if speedup else None)
 
 
 def bench_flightrec():

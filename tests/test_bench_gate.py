@@ -226,6 +226,36 @@ class TestGateLogic:
         ok, report = self.bench.gate_history(rows, tolerance=0.10)
         assert not ok and report[0]["status"] == "REGRESSION"
 
+    def test_cross_mode_rows_never_gate(self):
+        """A vmapped-tenant capacity run must not gate (or be gated by)
+        an object-lane history row: mode + tenants_cap are part of the
+        gate key (ISSUE 14).  Pre-refactor rows carry neither stamp and
+        keep gating only each other."""
+        rows = [
+            {"run_id": "r0", "metric": "capacity", "value": 32.0,
+             "unit": "tenant_symbols", "device_kind": "cpu"},
+            {"run_id": "r1", "metric": "capacity", "value": 1024.0,
+             "unit": "tenant_symbols", "device_kind": "cpu",
+             "mode": "vmapped", "tenants_cap": 256},
+        ]
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert ok
+        by_mode = {r.get("mode"): r for r in report}
+        assert by_mode["vmapped"]["status"] == "new"
+        assert by_mode["vmapped"]["tenants_cap"] == "256"
+        # a LOWER vmapped follow-up against a vmapped prior DOES gate
+        rows.append({"run_id": "r2", "metric": "capacity", "value": 512.0,
+                     "unit": "tenant_symbols", "device_kind": "cpu",
+                     "mode": "vmapped", "tenants_cap": 256})
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert not ok
+        failing = [r for r in report if r["status"] == "REGRESSION"]
+        assert len(failing) == 1 and failing[0]["mode"] == "vmapped"
+        # ...but never against an object-lane prior with a different cap
+        rows[-1]["tenants_cap"] = 512
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert ok
+
     def test_best_prior_not_just_last(self):
         """The gate compares against the BEST prior row, so two
         successive small regressions cannot ratchet the bar down."""

@@ -511,6 +511,125 @@ class TestLoadHarness:
         assert "StageSaturated" in system.alerts.active
 
 
+class TestRampWindowIsolation:
+    """Satellite regression: ramp() reuses ONE harness across steps, so a
+    heavy step's latency tail / duty windows MUST be re-windowed per step
+    — otherwise the bisect converges on a stale breach."""
+
+    def test_reset_windows_clears_sliding_state(self):
+        sat = SaturationMonitor(tick_budget_s=1.0, min_samples=1)
+        sat.observe_stage("analyzer", 0.9)
+        sat.end_tick(1.0)
+        assert sat.saturated_stages()
+        sat.reset_windows()
+        assert sat.windowed_duty() == {}
+        assert sat.saturated_stages() == {}
+        assert sat.bottleneck_stage() is None
+        assert sat.ticks == 0
+        # cumulative busy counters survive (they are counters)
+        assert sat._busy_total["analyzer"] > 0
+
+    def test_heavy_step_tail_does_not_bleed_into_next_step(self):
+        """Measure a deliberately-saturated step, then a clean one on the
+        SAME harness: the clean step's p99, duty windows and loop-lag max
+        must reflect only its own ticks."""
+        import asyncio
+        from dataclasses import replace
+
+        from ai_crypto_trader_tpu.testing.loadgen import (
+            SyntheticTenantTraffic)
+
+        base = _load_config(tenants=2, ticks=4, min_samples=2,
+                            slo_p99_ms=120.0)
+        traffic = SyntheticTenantTraffic(base, points=3)
+        asyncio.run(traffic.run())                      # warm step
+        traffic.cfg = replace(traffic.cfg, analyzer_lag_s=0.08)
+        heavy = asyncio.run(traffic.run())
+        assert heavy["p99_ms"] > 120.0
+        assert "analyzer" in heavy["saturated_stages"]
+        assert heavy["event_loop_lag_max_s"] >= 0.08
+        # the clean step on the SAME harness: fresh windows throughout
+        traffic.cfg = replace(traffic.cfg, analyzer_lag_s=0.0)
+        traffic.set_tenants(2)
+        clean = asyncio.run(traffic.run())
+        assert clean["ticks"] == 4                      # only its own ticks
+        assert len(traffic.latencies_ms) == 4
+        assert clean["p99_ms"] < heavy["p99_ms"] / 2, \
+            "heavy step's tail bled into the next step's p99"
+        assert clean["saturated_stages"] == {}, \
+            "stale duty window kept the previous step's saturation"
+        assert clean["event_loop_lag_max_s"] < 0.08
+        # the saturation windows hold exactly this step's samples
+        for stage, window in traffic.saturation._windows.items():
+            assert len(window) == 4, stage
+
+    def test_ramp_reuses_one_harness(self, monkeypatch):
+        """ramp() builds ONE SyntheticTenantTraffic for the whole
+        schedule (warm stream, shared compiles) and re-provisions tenants
+        per step."""
+        from ai_crypto_trader_tpu.testing import loadgen
+
+        built = []
+        real = loadgen.SyntheticTenantTraffic
+
+        class Counting(real):
+            def __init__(self, *a, **kw):
+                built.append(1)
+                super().__init__(*a, **kw)
+
+        monkeypatch.setattr(loadgen, "SyntheticTenantTraffic", Counting)
+        out = loadgen.ramp(_load_config(tenants=4, ticks=3, min_samples=2))
+        assert len(built) == 1
+        assert [s["tenants"] for s in out["steps"]][:3] == [1, 2, 4]
+
+
+class TestVmappedMode:
+    """Tenants as a batch axis through the load harness (the rim around
+    ops/tenant_engine.py — decision parity itself is pinned in
+    tests/test_tenant_engine.py)."""
+
+    def test_vmapped_load_point_zero_rest_and_gauges(self):
+        from ai_crypto_trader_tpu.testing.loadgen import run_load
+
+        m = MetricsRegistry()
+        rep = run_load(_load_config(mode="vmapped", tenants=5), metrics=m)
+        assert rep["mode"] == "vmapped"
+        assert rep["ticks"] == 6 and rep["lanes"] == 10
+        assert rep["published"] == 6 * 2
+        # every tenant×published-symbol decision evaluated, ONE dispatch
+        assert rep["analyzed"] == 6 * 2 * 5
+        assert rep["rest_kline_calls_steady"] == 0
+        assert "tenant_engine" in rep["stage_duty"]
+        assert rep["capacity"]["tenant_lanes"] == 10
+        assert rep["capacity"]["tenant_mode"] == "vmapped"
+        text = m.exposition()
+        assert 'crypto_trader_tpu_tenant_lanes{mode="vmapped"} 10' in text
+        # vetoes keep flowing per gate in vmapped mode (aggregated counts)
+        assert 'crypto_trader_tpu_decision_vetoes_total{gate=' in text
+
+    def test_vmapped_ramp_breach_attributed_to_engine_stage(self):
+        """The vmapped twin of the objects-mode acceptance ramp: a forced
+        blocking lag inside the tenant stage breaches the SLO and the
+        duty gauges name tenant_engine."""
+        from ai_crypto_trader_tpu.testing.loadgen import ramp
+
+        base = _load_config(mode="vmapped", tenants=4, ticks=5,
+                            slo_p99_ms=100.0, engine_lag_s=0.12,
+                            min_samples=2)
+        out = ramp(base)
+        assert out["mode"] == "vmapped"
+        assert out["breach"] is not None
+        assert "tenant_engine" in out["saturated_stages"]
+        assert out["bottleneck_stage"] == "tenant_engine"
+
+    def test_object_mode_report_stamps_mode(self):
+        from ai_crypto_trader_tpu.testing.loadgen import run_load
+
+        rep = run_load(_load_config())
+        assert rep["mode"] == "objects"
+        assert rep["capacity"]["tenant_mode"] == "objects"
+
+
 @pytest.mark.slow
 class TestLoadSoak:
     def test_soak_ramp_full(self):

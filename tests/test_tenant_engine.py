@@ -1,0 +1,568 @@
+"""Tenants as a batch axis (ops/tenant_engine.py + the vmapped loadgen rim).
+
+Covers:
+  * veto-gate PARITY: the traced per-tenant gate program agrees
+    gate-for-gate with `TradeExecutor.veto_reason` + its sizing gate on a
+    randomized sweep of signals (NaN/zero-price poisoned payloads
+    included), randomized tenant params and position state — the flight
+    recorder vocabulary (`obs.flightrec.GATES` / `VETO_ORDER`) stays the
+    single source of truth;
+  * the one-dispatch/one-sync/zero-recompile CONTRACT on the meshprof
+    sentinel counter (the PR 12 pattern), cost card + donation verifier,
+    plus the N-changes-recompile NEGATIVE test (an undeclared tenant-axis
+    shape change is counted and alerted);
+  * pad/mask layout-card assertions for ragged tenant counts on the 8-way
+    test mesh, sharded ≡ single-device (`-m slow`);
+  * the HARNESS parity oracle: the vmapped loadgen path pins decisions
+    (verdict/gate, execution, quantity) tick-for-tick against the
+    per-lane Python object path on identical seeds — veto-heavy default
+    params AND a permissive config that opens real venue positions;
+  * sequential within-tick semantics (the symbol-axis scan carry:
+    max_positions and balance updates are visible to later symbols);
+  * venue-truth corrections (`revert_entry`) re-seed without recompiling.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.config import TradingParams
+from ai_crypto_trader_tpu.obs.flightrec import GATES, VETO_ORDER
+from ai_crypto_trader_tpu.ops import tenant_engine
+from ai_crypto_trader_tpu.ops.tenant_engine import (
+    EXECUTABLE,
+    GATE_NAME,
+    NO_DECISION,
+    TenantEngine,
+)
+from ai_crypto_trader_tpu.utils import devprof, meshprof
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+SYMS = [f"P{i:03d}USDC" for i in range(4)]
+
+
+def _feats(eng, price, signal, strength, vol, avol, valid=None):
+    """[S]-padded feature columns from per-symbol lists."""
+    S, n = eng.S, len(price)
+    pad = lambda a, dt: np.asarray(        # noqa: E731
+        list(a) + [0] * (S - n), dt)
+    return {
+        "price": pad(price, np.float32),
+        "signal": pad(signal, np.int32),
+        "strength": pad(strength, np.float32),
+        "volatility": pad(vol, np.float32),
+        "avg_volume": pad(avol, np.float32),
+        "valid": pad(valid if valid is not None else [True] * n,
+                     bool),
+    }
+
+
+class TestGateVocabulary:
+    def test_gate_ids_index_the_flightrec_vocabulary(self):
+        for name, gid in tenant_engine.GATE_ID.items():
+            assert GATES[gid] == name
+        assert set(VETO_ORDER) <= set(GATES)
+        assert EXECUTABLE == -1 and NO_DECISION == -2
+
+
+class TestGateParity:
+    """Randomized sweep: traced gates == the executor's real decision
+    path, gate-for-gate, including NaN/zero-price poisoned payloads."""
+
+    PARAM_GRID = [
+        TradingParams(),
+        TradingParams(ai_confidence_threshold=0.5, min_signal_strength=50.0,
+                      max_positions=2),
+        TradingParams(ai_confidence_threshold=0.3, min_signal_strength=20.0,
+                      min_trade_amount=400.0),
+    ]
+
+    def _random_features(self, rng):
+        """One symbol's feature row, poisoned ~20% of the time."""
+        price = float(rng.choice(
+            [rng.uniform(10.0, 500.0), rng.uniform(10.0, 500.0),
+             rng.uniform(10.0, 500.0), rng.uniform(10.0, 500.0),
+             0.0, -5.0, np.nan]))
+        strength = float(rng.choice(
+            [rng.uniform(0.0, 120.0), rng.uniform(0.0, 120.0),
+             rng.uniform(0.0, 120.0), np.nan]))
+        vol = float(rng.choice([rng.uniform(0.0, 0.05),
+                                rng.uniform(0.0, 0.05), np.nan]))
+        avol = float(rng.choice([rng.uniform(0.0, 120_000.0),
+                                 rng.uniform(0.0, 120_000.0), np.nan]))
+        sig = int(rng.choice([1, 1, -1, 0]))
+        return price, sig, strength, vol, avol
+
+    @staticmethod
+    def _signal_dict(sym, price, sig, strength, vol, avol):
+        """The payload the analyzer would publish for these features:
+        deterministic backend verdict (TechnicalPolicyBackend rule)."""
+        sig_str = {1: "BUY", -1: "SELL", 0: "NEUTRAL"}[sig]
+        decision = sig_str if sig_str in ("BUY", "SELL") else "HOLD"
+        # the backend rounds its JSON confidence to 3 decimals
+        confidence = min(strength / 100.0, 1.0) * 0.9
+        confidence = round(confidence, 3) if np.isfinite(confidence) \
+            else confidence
+        return {"symbol": sym, "current_price": price, "signal": sig_str,
+                "signal_strength": strength, "volatility": vol,
+                "avg_volume": avol, "decision": decision,
+                "confidence": confidence}
+
+    def _oracle_case(self, trading, balance, open_syms, pending_syms,
+                     signals):
+        """Run one tenant-case through the REAL executor, symbol by
+        symbol (the sequential drain): returns per-symbol (gate | None,
+        quantity | None) from a capturing flight recorder."""
+        from ai_crypto_trader_tpu.data.ingest import OHLCV
+        from ai_crypto_trader_tpu.obs.flightrec import FlightRecorder
+        from ai_crypto_trader_tpu.shell.bus import EventBus
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.executor import TradeExecutor
+
+        series = {}
+        for sym, s in signals.items():
+            p = s["current_price"]
+            p = p if np.isfinite(p) and p > 0 else 1.0   # vetoed anyway
+            series[sym] = OHLCV(
+                timestamp=np.arange(4, dtype=np.int64) * 60_000,
+                open=np.full(4, p), high=np.full(4, p),
+                low=np.full(4, p), close=np.full(4, p),
+                volume=np.full(4, 1.0), symbol=sym)
+        from types import SimpleNamespace
+
+        venue = FakeExchange(series, quote_balance=balance)
+        fr = FlightRecorder()
+        ex = TradeExecutor(EventBus(), venue, trading=trading, flightrec=fr)
+        ex.active_trades = {sym: SimpleNamespace() for sym in open_syms}
+        ex.pending_intents = {f"x-{i}": {"symbol": sym}
+                              for i, sym in enumerate(pending_syms)}
+
+        out = {}
+        for sym in sorted(signals):
+            rid = f"d-{sym}"
+            sig = dict(signals[sym], decision_id=rid)
+            trade = asyncio.run(ex.handle_signal(sig))
+            rec = fr._by_id.get(rid)
+            if trade is not None:
+                out[sym] = (None, trade.quantity)
+            elif rec is not None and rec["status"] == "vetoed":
+                out[sym] = (rec["gate"], None)
+            else:                       # vetoed before any recording
+                out[sym] = (ex.veto_reason(sig), None)
+        return out
+
+    def test_randomized_sweep_gate_for_gate(self):
+        rng = np.random.default_rng(20260805)
+        rounds, n_cases = 6, 8
+        checked = 0
+        seen_gates = set()
+        for r in range(rounds):
+            rows = [self._random_features(rng) for _ in SYMS]
+            feats = _feats(
+                None or type("E", (), {"S": 8})(),  # placeholder, below
+                [x[0] for x in rows], [x[1] for x in rows],
+                [x[2] for x in rows], [x[3] for x in rows],
+                [x[4] for x in rows])
+            cases = []
+            for i in range(n_cases):
+                trading = self.PARAM_GRID[int(rng.integers(
+                    len(self.PARAM_GRID)))]
+                balance = float(rng.uniform(50.0, 20_000.0))
+                open_syms = [s for s in SYMS if rng.random() < 0.25]
+                pending_syms = [s for s in SYMS
+                                if s not in open_syms and rng.random() < 0.15]
+                cases.append((trading, balance, open_syms, pending_syms))
+
+            eng = TenantEngine(SYMS, n_cases)
+            for i, (trading, balance, open_syms, pending_syms) in \
+                    enumerate(cases):
+                eng.set_tenant(
+                    i, balance=balance, open_symbols=open_syms,
+                    pending_symbols=pending_syms,
+                    conf_threshold=trading.ai_confidence_threshold,
+                    min_strength=trading.min_signal_strength,
+                    max_positions=trading.max_positions,
+                    min_trade=trading.min_trade_amount)
+            out = eng.decide(feats)
+
+            for i, (trading, balance, open_syms, pending_syms) in \
+                    enumerate(cases):
+                signals = {sym: self._signal_dict(sym, *rows[s])
+                           for s, sym in enumerate(SYMS)}
+                oracle = self._oracle_case(trading, balance, open_syms,
+                                           pending_syms, signals)
+                for s, sym in enumerate(SYMS):
+                    gate_py, qty_py = oracle[sym]
+                    gid = int(out["gate"][i, s])
+                    gate_vm = None if gid == EXECUTABLE \
+                        else GATE_NAME.get(gid, gid)
+                    assert gate_vm == gate_py, (
+                        f"round {r} tenant {i} {sym}: vmapped={gate_vm} "
+                        f"oracle={gate_py} features={rows[s]} "
+                        f"params={trading} balance={balance} "
+                        f"open={open_syms} pending={pending_syms}")
+                    seen_gates.add(gate_py)
+                    if gate_py is None:
+                        assert qty_py == pytest.approx(
+                            float(out["qty"][i, s]), rel=1e-4)
+                    checked += 1
+        assert checked == rounds * n_cases * len(SYMS)
+        # the sweep exercised a meaningful slice of the vocabulary
+        # (poisoned payloads AND executable decisions included)
+        assert {"nan_gate", None} <= seen_gates
+        assert len(seen_gates - {None}) >= 5, seen_gates
+
+    def test_sequential_semantics_max_positions_and_balance(self):
+        """Symbol k's entry is visible to symbol k+1 in the SAME tick —
+        the scan carry mirrors the executor's sequential drain."""
+        eng = TenantEngine(SYMS, 1,
+                           trading=TradingParams(ai_confidence_threshold=0.5,
+                                                 min_signal_strength=50.0,
+                                                 max_positions=2))
+        feats = _feats(eng, [100.0] * 4, [1] * 4, [90.0] * 4,
+                       [0.015] * 4, [60_000.0] * 4)
+        out = eng.decide(feats)
+        gates = [int(g) for g in out["gate"][0]][:4]
+        # first two executable, the rest hit the cap WITHIN the tick
+        assert gates[0] == EXECUTABLE and gates[1] == EXECUTABLE
+        assert GATE_NAME[gates[2]] == "max_positions"
+        assert GATE_NAME[gates[3]] == "max_positions"
+        # the balance carry funded both entries (fee included)
+        spent = float(out["size"][0, 0] + out["size"][0, 1]) * 1.001
+        assert eng.balances()[0] == pytest.approx(10_000.0 - spent, rel=1e-5)
+
+    def test_revert_entry_refunds_and_reseeds(self):
+        eng = TenantEngine(SYMS, 1,
+                           trading=TradingParams(ai_confidence_threshold=0.5,
+                                                 min_signal_strength=50.0))
+        feats = _feats(eng, [100.0], [1], [90.0], [0.015], [60_000.0])
+        out = eng.decide(feats)
+        assert (0, 0) in eng.executable(out)
+        bal = eng.balances()[0]
+        eng.revert_entry(0, SYMS[0])
+        assert eng._need_seed
+        assert eng.balances()[0] == pytest.approx(10_000.0, rel=1e-5)
+        assert eng.balances()[0] > bal
+        # next decide re-seeds (a transfer) and the symbol is entryable
+        out2 = eng.decide(feats)
+        assert int(out2["gate"][0, 0]) == EXECUTABLE
+
+
+class TestContract:
+    """One dispatch + one sync per decide, zero steady-state recompiles on
+    the meshprof sentinel, cost card + donation verified — and the
+    NEGATIVE: an undeclared tenant-axis shape change is counted+alerted."""
+
+    def test_one_dispatch_one_sync_zero_recompile(self, monkeypatch):
+        syncs = {"n": 0}
+        real_read = tenant_engine.host_read
+
+        def counting_read(tree):
+            syncs["n"] += 1
+            return real_read(tree)
+
+        monkeypatch.setattr(tenant_engine, "host_read", counting_read)
+        m = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=m)
+        with devprof.use(devprof.DevProf(metrics=m)) as dp, \
+                meshprof.use(mp):
+            eng = TenantEngine(SYMS, 48)     # pads to 64
+            feats = _feats(eng, [100.0, 50.0, 200.0, 80.0], [1, -1, 1, 0],
+                           [90.0, 70.0, 40.0, 90.0], [0.015] * 4,
+                           [60_000.0] * 4)
+            eng.decide(feats)                # compile + card (cold)
+            assert syncs["n"] == 1
+            assert eng.last_stats["dispatches"] == 1
+            assert eng.last_stats["tenant_pad"] == 64
+            card = dp.cards["tenant_engine"]
+            assert card.error is None and card.flops > 0
+            assert card.donation_ok is True
+            assert dp.donation_failures == []
+            # layout card registered through the Partitioner seam
+            assert mp.layouts["tenant_engine"].population == 64
+            assert mp.layouts["tenant_engine"].pad == 0
+
+            eng.decide(feats)                # steady state
+            assert syncs["n"] == 2
+            assert mp.recompiles.steady_total() == 0, mp.recompiles.status()
+            assert mp.recompiles.windows["tenant_engine"] == 2
+            assert mp.transfers.total() == 0
+            # donated carry: the previous pop buffers were freed
+            assert not eng._need_seed and eng.full_seeds == 1
+
+    def test_n_changes_recompile_negative(self):
+        """A tenant-axis shape change NOT declared cold is a counted
+        steady-state recompile + SteadyStateRecompile alert (the
+        sentinel's production invariant, PR 12 pattern)."""
+        m = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=m)
+        with meshprof.use(mp):
+            eng = TenantEngine(SYMS, 8)
+            feats = _feats(eng, [100.0] * 4, [0] * 4, [50.0] * 4,
+                           [0.01] * 4, [50_000.0] * 4)
+            eng.decide(feats)
+            eng.decide(feats)
+            assert mp.recompiles.steady_total() == 0
+            # resize the tenant axis but FORGE the cold declaration —
+            # exactly the bug the sentinel exists to catch
+            eng.configure(24)                # pads to 32: a new shape
+            eng._cold = False
+            eng.decide(feats)
+            assert mp.recompiles.steady["tenant_engine"] >= 1
+            assert "tenant_engine" in mp.recompiles.alerted
+            assert "tenant_engine" in mp.alert_state()[
+                "steady_recompile_programs"]
+        # declared-cold resizes never count (the ramp's legitimate path)
+        m2 = MetricsRegistry()
+        mp2 = meshprof.MeshProf(metrics=m2)
+        with meshprof.use(mp2):
+            eng2 = TenantEngine(SYMS, 8)
+            eng2.decide(feats)
+            eng2.decide(feats)
+            eng2.configure(24)               # _cold=True by design
+            eng2.decide(feats)
+            assert mp2.recompiles.steady_total() == 0
+
+
+@pytest.mark.slow
+class TestMeshLayout:
+    def test_ragged_tenants_pad_mask_on_mesh8(self, mesh8):
+        """Tenant count 10 on the 8-way mesh: population_eval pads 10→16
+        (pad_fraction 0.375), the layout card records it, and the sharded
+        decisions equal the single-device ones."""
+        from ai_crypto_trader_tpu.parallel import MeshPartitioner
+
+        feats_src = ([100.0, 50.0, 200.0, 80.0], [1, -1, 1, 1],
+                     [90.0, 70.0, 40.0, 85.0], [0.015, 0.01, 0.03, 0.02],
+                     [60_000.0, 1_000.0, 60_000.0, 55_000.0])
+        m = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=m)
+        with meshprof.use(mp):
+            part = MeshPartitioner(mesh8)
+            eng = TenantEngine(SYMS, 10, partitioner=part, pad_pow2=False)
+            eng.set_tenant(3, open_symbols=[SYMS[0]])
+            eng.set_tenant(7, conf_threshold=0.3, min_strength=20.0)
+            out = eng.decide(_feats(eng, *feats_src))
+            card = mp.layouts["tenant_engine"]
+            assert card.population == 10 and card.pad == 6
+            assert card.devices == 8
+            assert card.pad_fraction == pytest.approx(0.375)
+            assert out["gate"].shape[0] == 10
+            # ragged carry regression: population_eval SLICES the padded
+            # all-gather back to 10, so feeding the carry straight into
+            # the next dispatch would change input sharding and retrace
+            # EVERY tick — the engine must re-seed from the mirror
+            # instead (found by the verify drive; zero steady recompiles
+            # across repeat dispatches is the pinned contract)
+            eng.decide(_feats(eng, *feats_src))
+            eng.decide(_feats(eng, *feats_src))
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+            assert mp.recompiles.windows["tenant_engine"] == 3
+        single = TenantEngine(SYMS, 10, pad_pow2=False)
+        single.set_tenant(3, open_symbols=[SYMS[0]])
+        single.set_tenant(7, conf_threshold=0.3, min_strength=20.0)
+        ref = single.decide(_feats(single, *feats_src))
+        for k in ("gate", "decision", "exec"):
+            np.testing.assert_array_equal(out[k], ref[k])
+        for k in ("confidence", "size", "qty", "sl_pct", "tp_pct"):
+            np.testing.assert_allclose(out[k], ref[k], rtol=1e-6,
+                                       equal_nan=True)
+        np.testing.assert_allclose(eng.balances(), single.balances(),
+                                   rtol=1e-6)
+
+
+class TestHarnessParityOracle:
+    """The acceptance oracle: vmapped loadgen decisions (verdict/gate,
+    execution, quantity) pinned tick-for-tick against the per-lane Python
+    object path on identical seeds."""
+
+    def _collect_vmapped(self, cfg, ticks):
+        from ai_crypto_trader_tpu.testing.loadgen import (
+            SyntheticTenantTraffic)
+
+        traffic = SyntheticTenantTraffic(cfg)
+        decisions = {}                   # (t, tenant, symbol) -> record
+
+        async def go():
+            for _ in range(ticks):
+                await traffic.tick(timed=False)
+                eng = traffic.tenant_engine
+                out = eng.last_out
+                if out is None:
+                    continue
+                t = traffic.clock["t"]
+                for i in range(eng.n_tenants):
+                    for s, sym in enumerate(traffic.symbols):
+                        gid = int(out["gate"][i, s])
+                        if gid == NO_DECISION:
+                            continue
+                        decisions[(t, i, sym)] = {
+                            "gate": (None if gid == EXECUTABLE
+                                     else GATE_NAME[gid]),
+                            "confidence": float(out["confidence"][i, s]),
+                            "qty": float(out["qty"][i, s]),
+                        }
+        asyncio.run(go())
+        return traffic, decisions
+
+    def _collect_objects(self, cfg, ticks):
+        from ai_crypto_trader_tpu.obs.flightrec import FlightRecorder
+        from ai_crypto_trader_tpu.testing.loadgen import (
+            SyntheticTenantTraffic)
+
+        traffic = SyntheticTenantTraffic(cfg)
+        frs = []
+        for lane in traffic.lanes:
+            fr = FlightRecorder(now_fn=traffic._now)
+            lane.analyzer.flightrec = fr
+            lane.executor.flightrec = fr
+            frs.append(fr)
+
+        async def go():
+            for _ in range(ticks):
+                await traffic.tick(timed=False)
+        asyncio.run(go())
+
+        decisions = {}
+        for i, fr in enumerate(frs):
+            for rec in fr.query(limit=0):
+                if rec["status"] == "open":
+                    continue             # published but never terminal
+                verdict = rec.get("verdict") or {}
+                ex = rec.get("exec") or {}
+                fills = rec.get("fills") or []
+                decisions[(rec["t"], i, rec["symbol"])] = {
+                    "gate": rec["gate"],
+                    "confidence": verdict.get("confidence"),
+                    "qty": (fills[0]["quantity"] if fills
+                            else ex.get("quantity")),
+                }
+        return traffic, decisions
+
+    def _compare(self, trading, ticks=6):
+        from ai_crypto_trader_tpu.testing.loadgen import LoadConfig
+
+        kw = dict(tenants=3, symbols=3, ticks=ticks, warmup_ticks=0,
+                  window=64, seed=5, trading=trading)
+        vm_traffic, vm = self._collect_vmapped(
+            LoadConfig(mode="vmapped", **kw), ticks)
+        obj_traffic, obj = self._collect_objects(
+            LoadConfig(mode="objects", **kw), ticks)
+        assert vm, "vmapped path produced no decisions"
+        assert set(vm) == set(obj), (
+            f"decision keys diverge: only_vm={set(vm) - set(obj)} "
+            f"only_obj={set(obj) - set(vm)}")
+        executed = 0
+        for key in sorted(vm):
+            assert vm[key]["gate"] == obj[key]["gate"], \
+                (key, vm[key], obj[key])
+            if obj[key]["confidence"] is not None:
+                assert vm[key]["confidence"] == pytest.approx(
+                    obj[key]["confidence"], rel=1e-5, abs=1e-6), key
+            if vm[key]["gate"] is None:
+                executed += 1
+                assert obj[key]["qty"] == pytest.approx(
+                    vm[key]["qty"], rel=1e-4), key
+        return vm_traffic, obj_traffic, executed
+
+    def test_parity_default_params_veto_heavy(self):
+        vm_t, obj_t, executed = self._compare(TradingParams())
+        # the decision fan-out is the load; default gates veto everything
+        assert executed == 0
+        assert vm_t.tenant_engine.open_positions() == 0
+
+    def test_venue_balance_reanchors_engine_state(self):
+        """A venue-side credit the engine's entry model never saw (a
+        protective SL/TP fill on a later candle) re-anchors the tenant's
+        device balance on venue truth at the next reconcile — the
+        object-lane executors size from exactly this balance."""
+        from ai_crypto_trader_tpu.testing.loadgen import (
+            LoadConfig, SyntheticTenantTraffic)
+
+        cfg = LoadConfig(mode="vmapped", tenants=2, symbols=3, ticks=4,
+                         warmup_ticks=0, window=64, seed=5,
+                         trading=TradingParams(ai_confidence_threshold=0.1,
+                                               min_signal_strength=10.0))
+        traffic = SyntheticTenantTraffic(cfg)
+
+        async def go(n):
+            for _ in range(n):
+                await traffic.tick(timed=False)
+        asyncio.run(go(8))
+        assert traffic._vm_lanes, "no tenant ever traded — nothing to sync"
+        n = next(iter(traffic._vm_lanes))
+        lane = traffic._vm_lanes[n]
+        # in lockstep the engine already mirrors the venue (within f32)
+        assert traffic.tenant_engine.balances()[n] == pytest.approx(
+            lane.venue.get_balances()["USDC"], rel=1e-4)
+        # a protective fill credits quote venue-side; the engine model
+        # never sees it — the next tick's reconcile must re-anchor
+        lane.venue.balances["USDC"] += 1234.5
+        asyncio.run(go(1))
+        assert traffic.tenant_engine.balances()[n] == pytest.approx(
+            lane.venue.get_balances()["USDC"], rel=1e-4)
+        # within-tolerance f32 wobble never thrashes the re-seed path
+        assert not traffic.tenant_engine.sync_balance(
+            n, float(traffic.tenant_engine.balances()[n]) * (1 + 1e-7))
+
+    def test_venue_side_close_frees_engine_position_slot(self):
+        """A position the executor no longer holds (protective SL/TP
+        filled venue-side, exit sold) must clear the engine's open flag —
+        a stale True would veto every re-entry via position_open and
+        consume a max_positions slot in the scan carry forever."""
+        from ai_crypto_trader_tpu.testing.loadgen import (
+            LoadConfig, SyntheticTenantTraffic)
+
+        cfg = LoadConfig(mode="vmapped", tenants=2, symbols=3, ticks=4,
+                         warmup_ticks=0, window=64, seed=5,
+                         trading=TradingParams(ai_confidence_threshold=0.1,
+                                               min_signal_strength=10.0))
+        traffic = SyntheticTenantTraffic(cfg)
+
+        async def go(n):
+            for _ in range(n):
+                await traffic.tick(timed=False)
+        asyncio.run(go(8))
+        assert traffic._vm_lanes, "no tenant ever traded"
+        n = next(iter(traffic._vm_lanes))
+        lane = traffic._vm_lanes[n]
+        sym, trade = next(iter(lane.executor.active_trades.items()))
+        s = traffic.tenant_engine.sym_index[sym]
+        assert traffic.tenant_engine._state_np["open"][n, s]
+        # simulate a venue-side closure: the executor pops the trade and
+        # the venue credits the sale proceeds
+        lane.executor.active_trades.pop(sym)
+        lane.venue.balances["USDC"] += trade.quantity * trade.entry_price
+        asyncio.run(go(1))
+        eng = traffic.tenant_engine
+        assert not eng._state_np["open"][n, s], \
+            "venue-side close left the engine position flag stale"
+        assert eng.balances()[n] == pytest.approx(
+            lane.venue.get_balances()["USDC"], rel=1e-4)
+
+    def test_parity_permissive_params_real_entries(self):
+        # thresholds low enough that the synthetic market's BUY ticks
+        # execute (reference strengths run 35-50 on this window), cap 2
+        # so the within-tick max_positions carry is exercised too
+        trading = TradingParams(ai_confidence_threshold=0.1,
+                                min_signal_strength=10.0, max_positions=2)
+        vm_t, obj_t, executed = self._compare(trading, ticks=8)
+        assert executed > 0, "permissive config never executed — the " \
+                             "oracle exercised no entry path"
+        # the venue-side books agree lane-for-lane: same symbols held,
+        # same client-order-id namespace partitioning
+        for i, obj_lane in enumerate(obj_t.lanes):
+            vm_lane = obj_t.lanes and vm_t._vm_lanes.get(i)
+            obj_syms = sorted(obj_lane.executor.active_trades)
+            vm_syms = (sorted(vm_lane.executor.active_trades)
+                       if vm_lane else [])
+            assert obj_syms == vm_syms, f"lane {i}"
+            if vm_lane:
+                for sym, trade in vm_lane.executor.active_trades.items():
+                    assert trade.entry_coid.startswith(f"ld{i}-ent-{sym}")
+        # engine device state mirrors the venue books
+        assert vm_t.tenant_engine.open_positions() == sum(
+            len(lane.executor.active_trades)
+            for lane in vm_t._vm_lanes.values())
