@@ -306,6 +306,7 @@ def cmd_trade(args):
                            journal_path=args.journal,
                            enable_devprof=args.devprof,
                            enable_meshprof=args.meshprof,
+                           enable_fleetscope=args.fleetscope,
                            flightrec_path=args.flightrec)
     if args.full_stack:
         from ai_crypto_trader_tpu.shell.stack import build_full_stack
@@ -370,16 +371,21 @@ def cmd_why(args):
     decisions with their rejecting gate or execution chain
     (signal → client_order_id → fill → closure PnL) plus the structured
     explanation narrative.  Reads the checksummed decision JSONL a run
-    wrote (`trade --flightrec PATH`), or queries a live dashboard server's
-    /decisions endpoint with --url."""
+    wrote (`trade --flightrec PATH`, `load --vmapped --flightrec PATH`),
+    or queries a live dashboard server's /decisions endpoint with --url.
+    `--lane N` filters to one vmapped tenant lane's sampled provenance
+    (obs/fleetscope.py crc32 lane sample) — the fleet twin of the
+    per-symbol question."""
     from ai_crypto_trader_tpu.obs.flightrec import format_why, load_decisions
 
     if args.url:
         import urllib.parse
         import urllib.request
 
-        query = urllib.parse.urlencode(
-            {"symbol": args.symbol, "limit": args.last})
+        params = {"symbol": args.symbol, "limit": args.last}
+        if args.lane is not None:
+            params["lane"] = args.lane
+        query = urllib.parse.urlencode(params)
         with urllib.request.urlopen(f"{args.url}/decisions?{query}",
                                     timeout=10) as resp:
             records = json.loads(resp.read())
@@ -391,12 +397,16 @@ def cmd_why(args):
             return
         records, stats = load_decisions(args.file)
         records = [r for r in records if r.get("symbol") == args.symbol]
+        if args.lane is not None:
+            records = [r for r in records if r.get("lane") == args.lane]
         records = list(reversed(records[-args.last:]))
         if stats.get("corrupt_records") or stats.get("torn_tail"):
             print(f"(journal: {stats['corrupt_records']} corrupt records "
                   f"skipped, torn tail={stats['torn_tail']})")
     if not records:
-        print(f"no recorded decisions for {args.symbol}")
+        where = f"{args.symbol}" + (f" lane {args.lane}"
+                                    if args.lane is not None else "")
+        print(f"no recorded decisions for {where}")
         return
     for line in format_why(records):
         print(line)
@@ -458,7 +468,9 @@ def cmd_load(args):
     cfg = LoadConfig(tenants=args.tenants, symbols=args.symbols,
                      ticks=args.ticks, window=args.window,
                      slo_p99_ms=args.slo_ms, seed=args.seed,
-                     mode=getattr(args, "mode", "objects"))
+                     mode=getattr(args, "mode", "objects"),
+                     fleetscope=not args.no_fleetscope,
+                     flightrec_path=args.flightrec)
     if args.ramp:
         out = ramp(cfg)
     else:
@@ -551,6 +563,85 @@ def cmd_mesh(args):
           f"{pad / padded if padded else 0.0:.4f}"
           + (" — MeshPaddingWasteHigh would fire"
              if padded and pad / padded > 0.25 else ""))
+
+
+def _render_fleet(block: dict) -> None:
+    """Operator rendering of a fleet-observatory status block
+    (obs/fleetscope.py): headline, gate mix, dispersion, rank table."""
+    if not block:
+        print("no fleet block — is the fleet observatory enabled and a "
+              "vmapped tenant engine deciding?")
+        return
+    print(f"fleet: {block.get('tenants', 0)} tenants "
+          f"({block.get('active_lanes', 0)} active lanes), "
+          f"{block.get('decides', 0)} decides, "
+          f"{block.get('decisions', 0)} decisions last tick "
+          f"({block.get('executable', 0)} executable)")
+    sampled = block.get("sampled_lanes", [])
+    n_sampled = block.get("sampled_lane_count", len(sampled))
+    more = ", …" if n_sampled > len(sampled) else ""
+    print(f"starved lanes (windowed min): {block.get('starved_lanes', 0)}; "
+          f"balance drift max: {block.get('balance_drift_max', 0.0)}; "
+          f"sampled lanes ({n_sampled}): {sampled}{more}")
+    mix = block.get("gate_mix") or {}
+    total = sum(mix.values()) or 1
+    if mix:
+        print("\ngate mix (windowed):")
+        for gate, count in sorted(mix.items(), key=lambda kv: -kv[1]):
+            bar = "#" * max(int(40 * count / total), 1)
+            print(f"  {gate:<22}{count:>9}  {count / total:>7.1%} {bar}")
+        if block.get("dominant_gate"):
+            print(f"  dominant veto gate: {block['dominant_gate']} "
+                  f"({block.get('gate_dominance', 0.0):.1%} of vetoes)")
+    pnl, bal = block.get("pnl") or {}, block.get("balance") or {}
+    if pnl:
+        print("\ndispersion over lanes:")
+        qs = sorted(set(pnl) | set(bal))
+        print("  " + "".join(f"{q:>14}" for q in [""] + qs))
+        print("  " + f"{'pnl':<2}" + "".join(
+            f"{pnl.get(q, float('nan')):>14,.2f}" for q in qs)
+            + f"   spread {block.get('pnl_spread', 0.0):,.2f}")
+        print("  " + f"{'balance':<2}" + "".join(
+            f"{bal.get(q, float('nan')):>14,.2f}" for q in qs))
+        if block.get("max_drawdown_max") is not None:
+            print(f"  worst max-drawdown: "
+                  f"{block['max_drawdown_max']:,.2f}")
+    best, worst = block.get("best") or [], block.get("worst") or []
+    if best:
+        print("\nlane rank (rolling PnL):")
+        print(f"  {'':>4}{'best lane':>10}{'pnl':>12}   "
+              f"{'worst lane':>10}{'pnl':>12}")
+        for i in range(max(len(best), len(worst))):
+            b = best[i] if i < len(best) else {}
+            w = worst[i] if i < len(worst) else {}
+            print(f"  #{i:<3}{b.get('lane', ''):>10}"
+                  f"{b.get('pnl', float('nan')):>12,.2f}   "
+                  f"{w.get('lane', ''):>10}"
+                  f"{w.get('pnl', float('nan')):>12,.2f}")
+
+
+def cmd_fleet(args):
+    """Fleet observatory operator view (obs/fleetscope.py, ISSUE 15): the
+    device-aggregated health of a vmapped tenant fleet — lane rank table
+    by rolling PnL, the windowed veto-gate mix, PnL/balance dispersion
+    quantiles, starvation and balance-drift signals.  With `--url`, reads
+    a LIVE system's /state.json `fleet` block; without it, drives a short
+    local vmapped load burst (testing/loadgen.py) so the view is
+    demonstrable on any dev host."""
+    if args.url:
+        state = _fetch_state(args.url)
+        _render_fleet(state.get("fleet") or {})
+        return
+    from ai_crypto_trader_tpu.testing.loadgen import LoadConfig, run_load
+
+    cfg = LoadConfig(tenants=args.tenants, symbols=args.symbols,
+                     ticks=args.ticks, seed=args.seed, mode="vmapped",
+                     min_samples=2)
+    rep = run_load(cfg)
+    print(f"(local demo fleet: {args.tenants} tenants × {args.symbols} "
+          f"symbols, {args.ticks} measured ticks, p99 "
+          f"{rep['p99_ms']:.1f} ms)\n")
+    _render_fleet(rep.get("fleet") or {})
 
 
 def cmd_status(args):
@@ -709,6 +800,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "recompile/transfer sentinels on the hot "
                          "dispatches, sharded-program layout cards, "
                          "per-device memory-imbalance gauges")
+    sp.add_argument("--fleetscope", action="store_true",
+                    help="fleet observatory (obs/fleetscope.py): device-"
+                         "aggregated lane telemetry for any vmapped "
+                         "tenant engine in this process — fleet_* "
+                         "gauges, /state.json fleet block, Fleet* alerts")
     sp.set_defaults(fn=cmd_trade)
     sp = sub.add_parser("why", help="decision provenance for a symbol "
                                     "(flight-recorder query)")
@@ -719,6 +815,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="query a live dashboard server instead "
                          "(e.g. http://127.0.0.1:8050)")
     sp.add_argument("--last", type=int, default=10)
+    sp.add_argument("--lane", type=int, default=None,
+                    help="filter to one vmapped tenant lane's sampled "
+                         "provenance (fleet observatory crc32 sample)")
     sp.set_defaults(fn=cmd_why)
     sp = sub.add_parser("profile",
                         help="capture a TensorBoard XPlane device profile "
@@ -756,6 +855,13 @@ def build_parser() -> argparse.ArgumentParser:
                       help="per-tenant Python SignalAnalyzer/TradeExecutor "
                            "lanes (the PR 10 baseline / parity oracle)")
     sp.set_defaults(mode="objects")
+    sp.add_argument("--no-fleetscope", action="store_true",
+                    help="measure the bare vmapped engine (no fleet "
+                         "observatory — the overhead-probe configuration)")
+    sp.add_argument("--flightrec", default=None, metavar="PATH",
+                    help="persist sampled-lane decision provenance as "
+                         "checksummed JSONL (vmapped mode; query with "
+                         "`why SYMBOL --lane N --file PATH`)")
     sp.add_argument("--seed", type=int, default=0)
     sp.set_defaults(fn=cmd_load)
     sp = sub.add_parser("scan", help="discover + rank tradable pairs")
@@ -773,6 +879,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="read a live system's /state.json mesh block "
                          "instead (e.g. http://127.0.0.1:8050)")
     sp.set_defaults(fn=cmd_mesh)
+    sp = sub.add_parser("fleet", help="fleet observatory operator view: "
+                                      "lane rank table, gate mix, "
+                                      "dispersion (obs/fleetscope.py)")
+    sp.add_argument("--url", default=None,
+                    help="read a live system's /state.json fleet block "
+                         "instead of running a local demo fleet")
+    sp.add_argument("--tenants", type=int, default=8,
+                    help="local demo fleet size (no --url)")
+    sp.add_argument("--symbols", type=int, default=4)
+    sp.add_argument("--ticks", type=int, default=6)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.set_defaults(fn=cmd_fleet)
     sp = sub.add_parser("status", help="operator summary from a live "
                                        "dashboard server (/state.json)")
     sp.add_argument("--url", default=None,
@@ -792,7 +910,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _JAX_COMMANDS = {"backtest", "train", "evolve", "mc", "trade", "dashboard",
-                 "scan", "profile", "load", "mesh"}
+                 "scan", "profile", "load", "mesh", "fleet"}
 
 
 def main(argv=None):

@@ -18,6 +18,12 @@ from its reference.  Four instruments:
                    computes on-device (ops/tick_engine.py)
   * attribution  — realized-PnL / win-rate folding of journal closures
                    by entry signal family / strategy / model
+  * fleetscope   — the fleet observatory: device-aggregated lane
+                   telemetry for vmapped tenant fleets (gate histogram,
+                   dispersion quantiles, top-k lane rank — computed
+                   INSIDE the tenant engine's dispatch), bounded-
+                   cardinality fleet_* export and crc32-sampled lane
+                   provenance
 """
 
 from ai_crypto_trader_tpu.obs.attribution import PnLAttribution
@@ -27,11 +33,12 @@ from ai_crypto_trader_tpu.obs.drift import (
     PSI_ALERT_THRESHOLD,
     reference_histogram,
 )
+from ai_crypto_trader_tpu.obs.fleetscope import FleetScope
 from ai_crypto_trader_tpu.obs.flightrec import FlightRecorder, load_decisions
 from ai_crypto_trader_tpu.obs.scorecard import Scorecard
 
 __all__ = [
     "DRIFT_FEATURES", "N_BINS", "PSI_ALERT_THRESHOLD",
-    "FlightRecorder", "PnLAttribution", "Scorecard",
+    "FleetScope", "FlightRecorder", "PnLAttribution", "Scorecard",
     "load_decisions", "reference_histogram",
 ]

@@ -112,11 +112,15 @@ class FlightRecorder:
 
     # -- record lifecycle ----------------------------------------------------
     def _blank(self, symbol: str | None, decision_id: str | None = None,
-               trace_fallback: bool = False) -> dict:
+               trace_fallback: bool = False,
+               lane: int | None = None) -> dict:
         """One decision record in the canonical shape.  Synthetic records
         (veto/execution on an id the ring no longer holds — post-restart
         paths) leave trace_id None when no trace is active, so a journal
-        re-append never clobbers the original record's trace on replay."""
+        re-append never clobbers the original record's trace on replay.
+        ``lane`` tags a vmapped tenant lane's sampled decision
+        (obs/fleetscope.py provenance sampling) so `cli why --lane N`
+        can filter the fleet the way `--symbol` filters the universe."""
         sp = tracing.current()
         trace_id = sp.trace_id if sp is not None and sp.trace_id else None
         if trace_id is None and trace_fallback:
@@ -125,6 +129,7 @@ class FlightRecorder:
             "id": decision_id or self._id_fn(),
             "trace_id": trace_id,
             "symbol": symbol,
+            "lane": lane,
             "t": self.now_fn(),
             "features": {},
             "predictions": {},
@@ -141,11 +146,12 @@ class FlightRecorder:
     def begin(self, symbol: str, features: dict | None = None,
               predictions: dict | None = None,
               verdict: dict | None = None,
-              explanation: dict | None = None) -> str:
+              explanation: dict | None = None,
+              lane: int | None = None) -> str:
         """Open a decision record; returns its id (the analyzer stamps it
         onto the published signal as ``decision_id`` so the executor can
         finalize the same record)."""
-        rec = self._blank(symbol, trace_fallback=True)
+        rec = self._blank(symbol, trace_fallback=True, lane=lane)
         rec["features"] = features or {}
         rec["predictions"] = predictions or {}
         rec["verdict"] = verdict
@@ -290,8 +296,9 @@ class FlightRecorder:
 
     # -- queries -------------------------------------------------------------
     def query(self, symbol: str | None = None, trace_id: str | None = None,
-              limit: int = 50) -> list[dict]:
-        """Newest-first decision records filtered by symbol / trace_id."""
+              limit: int = 50, lane: int | None = None) -> list[dict]:
+        """Newest-first decision records filtered by symbol / trace_id /
+        sampled tenant lane."""
         with self._lock:
             records = list(self._ring)
         out = []
@@ -299,6 +306,8 @@ class FlightRecorder:
             if symbol is not None and rec.get("symbol") != symbol:
                 continue
             if trace_id is not None and rec.get("trace_id") != trace_id:
+                continue
+            if lane is not None and rec.get("lane") != lane:
                 continue
             out.append(rec)
             if limit and len(out) >= limit:
@@ -397,6 +406,8 @@ def format_why(records: list[dict]) -> list[str]:
         stamp = (time.strftime("%H:%M:%S", time.gmtime(t))
                  if isinstance(t, (int, float)) else "--:--:--")
         head = f"{stamp} {rec.get('symbol')} "
+        if rec.get("lane") is not None:
+            head += f"[lane {rec['lane']}] "
         verdict = rec.get("verdict") or {}
         if rec.get("status") == "vetoed":
             detail = f" ({rec['gate_detail']})" if rec.get("gate_detail") else ""

@@ -57,6 +57,7 @@ import numpy as np
 from jax import lax
 
 from ai_crypto_trader_tpu.backtest.signals import position_size
+from ai_crypto_trader_tpu.obs import fleetscope
 from ai_crypto_trader_tpu.obs.flightrec import GATES, VETO_ORDER
 from ai_crypto_trader_tpu.utils import devprof, meshprof
 
@@ -200,9 +201,32 @@ def _tenant_program(partitioner):
                 "tp": jnp.where(ok, ys["tp_pct"], st["tp"]),
                 "balance": bal,
             }
-            return new_state, ys
+            # per-lane fitness carry (the fleet observatory's input, kept
+            # current whether or not fleetscope is on so a toggle never
+            # loses PnL history): mark-to-market equity — stale symbols
+            # (invalid this tick) mark at their entry price — plus the
+            # monotone peak/max-drawdown fold.  Equity itself rides the
+            # OUT tree, not the carry: the program never reads the
+            # previous tick's equity, and a donated-but-unread input
+            # would be pruned by XLA and fail to alias (the donation
+            # verifier caught exactly that).
+            price_eff = jnp.where(feats["valid"] & (feats["price"] > 0.0),
+                                  feats["price"], new_state["entry"])
+            pos_val = jnp.where(new_state["open"],
+                                new_state["qty"] * price_eff, 0.0).sum()
+            equity = bal + pos_val
+            peak = jnp.maximum(st["peak_equity"], equity)
+            new_state.update({
+                "equity0": st["equity0"],
+                "peak_equity": peak,
+                "max_drawdown": jnp.maximum(st["max_drawdown"],
+                                            peak - equity),
+            })
+            return new_state, (ys, equity)
 
-        new_state, outs = jax.vmap(one)(pop["state"], pop["params"])
+        new_state, (outs, equity) = jax.vmap(one)(pop["state"],
+                                                  pop["params"])
+        outs = {**outs, "equity": equity}    # [N] mark-to-market per lane
         # params ride through verbatim so the donated pop tree aliases
         # onto the carry 1:1 (the donation verifier proves it)
         return {"carry": {"state": new_state, "params": pop["params"]},
@@ -210,6 +234,36 @@ def _tenant_program(partitioner):
 
     return partitioner.population_eval(fn, name="tenant_engine",
                                        donate_pop=True)
+
+
+@functools.lru_cache(maxsize=16)
+def _fleet_program(partitioner, top_k: int, s_real: int):
+    """The tenant program with the fleet observatory's aggregation traced
+    INTO it (obs/fleetscope.py, the drift-PSI precedent): gate histogram,
+    dispersion quantiles and the top-k rank table come out of the SAME
+    dispatch, in the same output pytree, through the same one host_read —
+    zero extra dispatches.  The partitioned inner program inlines here
+    (the population_eval contract: traceable inside a larger jit), so the
+    tenant axis still shards over the mesh and the aggregation runs on
+    the all-gathered lane state.  ``s_real`` slices the pow2-padded
+    symbol axis back to the engine's REAL universe before aggregating:
+    pad columns are structurally NO_DECISION and would otherwise dilute
+    the gate mix with phantom cells that vary with the pad width."""
+    inner = _tenant_program(partitioner)
+
+    def fn(pop, feats):
+        res = inner(pop, feats)
+        st = res["carry"]["state"]
+        res["fleet"] = fleetscope.device_aggregates(
+            gate=res["out"]["gate"][:, :s_real],
+            pnl=res["out"]["equity"] - st["equity0"],
+            balance=st["balance"],
+            max_drawdown=st["max_drawdown"],
+            active=res["carry"]["params"]["active"],
+            k=top_k)
+        return res
+
+    return jax.jit(fn, donate_argnums=(0,))
 
 
 class TenantEngine:
@@ -244,6 +298,12 @@ class TenantEngine:
         self.full_seeds = 0
         self.last_stats: dict = {}
         self.last_out: dict | None = None
+        # fleet observatory surfaces (obs/fleetscope.py): the newest
+        # decide's device aggregates, plus the venue-truth re-anchor
+        # accounting the FleetBalanceDrift alert reads
+        self.last_fleet: dict | None = None
+        self.balance_resyncs = 0
+        self._drift_pending = 0.0
         self.configure(n_tenants)
 
     # -- shape / state lifecycle ---------------------------------------------
@@ -269,10 +329,18 @@ class TenantEngine:
             "sl": np.zeros((N, S), np.float32),
             "tp": np.zeros((N, S), np.float32),
             "balance": np.full((N,), self.quote_balance, np.float32),
+            # per-lane fitness carry (obs/fleetscope.py): the lane's
+            # seeded equity (rolling PnL = current equity − this) and the
+            # monotone peak/max-drawdown fold; current equity itself
+            # rides the out tree (see _tenant_program)
+            "equity0": np.full((N,), self.quote_balance, np.float32),
+            "peak_equity": np.full((N,), self.quote_balance, np.float32),
+            "max_drawdown": np.zeros((N,), np.float32),
         }
         self._pop = None
         self._need_seed = True
         self._cold = True                  # expected compile for this shape
+        self._fleet_key = None             # (on, k) of the last dispatch
 
     def set_tenant(self, i: int, *, balance: float | None = None,
                    open_symbols=(), pending_symbols=(), **params) -> None:
@@ -284,6 +352,11 @@ class TenantEngine:
             self._params_np[k][i] = v
         if balance is not None:
             self._state_np["balance"][i] = balance
+            # a provisioned balance re-bases the lane's PnL accounting:
+            # rolling PnL measures THIS lane's life from here
+            self._state_np["equity0"][i] = balance
+            self._state_np["peak_equity"][i] = balance
+            self._state_np["max_drawdown"][i] = 0.0
         for sym in open_symbols:
             self._state_np["open"][i, self.sym_index[sym]] = True
         for sym in pending_symbols:
@@ -335,16 +408,28 @@ class TenantEngine:
         return True
 
     def sync_balance(self, tenant: int, venue_balance: float,
-                     rel_tol: float = 1e-5) -> bool:
+                     rel_tol: float = 1e-5, expected: bool = False) -> bool:
         """Venue truth for the quote balance: protective SL/TP orders fill
         venue-side on later candles (crediting quote the engine's entry
         model never sees), so the rim re-anchors each trading tenant's
         balance on its venue every tick.  Tolerance absorbs the f32 carry
-        vs f64 venue rounding — only a REAL divergence re-seeds."""
+        vs f64 venue rounding — only a REAL divergence re-seeds.
+
+        ``expected=True`` marks a re-anchor the rim can EXPLAIN (it just
+        learned a position closure via `sync_positions`, so a balance
+        jump of the position's size is venue truth doing its job); an
+        UNEXPLAINED divergence is the fleet observatory's
+        FleetBalanceDrift input — fee-model error, a rejected order the
+        engine still booked, or mirror corruption."""
         cur = float(self._state_np["balance"][tenant])
         ref = max(abs(cur), abs(float(venue_balance)), 1.0)
-        if abs(cur - float(venue_balance)) <= rel_tol * ref:
+        drift = abs(cur - float(venue_balance)) / ref
+        if drift <= rel_tol:
             return False
+        self.balance_resyncs += 1
+        if not expected:
+            # folded into the next decide's fleetscope observe, reset there
+            self._drift_pending = max(self._drift_pending, drift)
         self._state_np["balance"][tenant] = np.float32(venue_balance)
         self._need_seed = True
         return True
@@ -429,9 +514,21 @@ class TenantEngine:
         """ONE dispatch over every (tenant, symbol) + ONE host readback.
         Returns the trimmed [N, S] output views (gate/decision/confidence/
         size/qty/sl/tp/exec); the device carry (state + params) stays
-        resident and donated into the next decide."""
+        resident and donated into the next decide.  When the fleet
+        observatory is active (obs/fleetscope.py — ONE module-global
+        check) the same dispatch also emits the device-side fleet
+        aggregates and the same host_read carries them back."""
         t_step0 = time.perf_counter()
-        program = _tenant_program(self.partitioner)
+        fs = fleetscope.active()
+        fleet_key = (True, fs.top_k) if fs is not None else (False, 0)
+        if self._fleet_key is not None and fleet_key != self._fleet_key:
+            # toggling the observatory swaps in a different compiled
+            # program — a DECLARED recompile, never a sentinel page
+            self._cold = True
+        self._fleet_key = fleet_key
+        program = (_fleet_program(self.partitioner, fs.top_k,
+                                  len(self.symbols))
+                   if fs is not None else _tenant_program(self.partitioner))
         upload_bytes = 0
         seeded = self._pop is None or self._need_seed
         if seeded:
@@ -462,8 +559,10 @@ class TenantEngine:
                 self._cold = False
                 self._need_seed = False
                 t_hr = time.perf_counter()
-                host = host_read({"out": res["out"],
-                                  "state": res["carry"]["state"]})
+                tree = {"out": res["out"], "state": res["carry"]["state"]}
+                if fs is not None:
+                    tree["fleet"] = res["fleet"]
+                host = host_read(tree)
                 host_read_s = time.perf_counter() - t_hr
         except Exception:
             # a mid-step abort leaves the donated carry in an unknown
@@ -487,6 +586,17 @@ class TenantEngine:
             self._need_seed = True
         n = self.n_tenants
         self.last_out = {k: np.asarray(v)[:n] for k, v in host["out"].items()}
+        self.last_fleet = ({k: np.asarray(v) for k, v in
+                            host["fleet"].items()}
+                           if fs is not None else None)
+        drift, self._drift_pending = self._drift_pending, 0.0
+        if fs is not None:
+            # drift drains every decide whether or not a scope consumes
+            # it — enabling the observatory later must not replay a
+            # long-corrected divergence as a fresh FleetBalanceDrift
+            fs.observe_decide(self.last_fleet, tenants=n,
+                              balance_drift=drift,
+                              balance_resyncs=self.balance_resyncs)
         self.last_stats = {
             "dispatches": 1, "tenants": n, "tenant_pad": self.n_pad,
             "symbols": len(self.symbols), "symbol_pad": self.S,
@@ -529,3 +639,16 @@ class TenantEngine:
 
     def balances(self) -> np.ndarray:
         return self._state_np["balance"][:self.n_tenants].copy()
+
+    def rolling_pnl(self) -> np.ndarray:
+        """[N] mark-to-market PnL since each lane's seed (the fleet
+        observatory's ranking axis): newest decide's equity out minus the
+        seeded equity; zeros before the first decide."""
+        n = self.n_tenants
+        if not self.last_out or "equity" not in self.last_out:
+            return np.zeros(n, np.float32)
+        return (np.asarray(self.last_out["equity"][:n])
+                - self._state_np["equity0"][:n])
+
+    def max_drawdowns(self) -> np.ndarray:
+        return self._state_np["max_drawdown"][:self.n_tenants].copy()

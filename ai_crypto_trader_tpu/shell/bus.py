@@ -31,6 +31,7 @@ from collections import defaultdict
 from typing import Any, AsyncIterator
 
 from ai_crypto_trader_tpu.utils import tracing
+from ai_crypto_trader_tpu.utils.metrics import channel_family
 
 
 #: Channels where silently losing a message is NOT acceptable telemetry
@@ -85,6 +86,9 @@ class EventBus:
         # per-channel max observed fanout queue depth (the saturation
         # monitor's bus_queue_high_watermark input)
         self.depth_watermarks: dict[str, int] = defaultdict(int)
+        # rolled-up metric families' held depth (see publish/
+        # sync_family_depth_gauges): {family: (max depth, established at)}
+        self._fam_depth_hold: dict[str, tuple[float, float]] = {}
 
     @property
     def max_queue(self) -> int:
@@ -216,13 +220,58 @@ class EventBus:
                                      channel=channel, queue_depth=depth,
                                      soft_limit=self._max_queue)
         if self.metrics is not None:
+            # per-lane channels (`trading_signals.<lane>`) roll up to one
+            # `trading_signals.*` series per family: a 1020-lane fleet
+            # would otherwise eat the registry's 512-series cap and clip
+            # UNRELATED channels (utils/metrics.channel_family)
+            fam = channel_family(channel)
+            if fam != channel:
+                # last-write-wins across lanes would let an idle lane's
+                # depth-0 publish overwrite a backlogged lane's 900
+                # between scrapes, hiding backpressure from the
+                # bus_queue_depth alert — hold the family MAX here.
+                # sync_family_depth_gauges() (the saturation monitor's
+                # per-tick close-out) re-anchors it to the true current
+                # max; the TTL bounds the hold when NO saturation
+                # monitor runs (enable_saturation=False), so a drained
+                # transient backlog cannot latch the gauge forever
+                mono = time.monotonic()
+                held, t_held = self._fam_depth_hold.get(fam, (0, mono))
+                if mono - t_held > self.warn_interval_s:
+                    held, t_held = 0, mono     # hold expired: re-anchor
+                if depth >= held:
+                    # the timestamp tracks when the max was ESTABLISHED
+                    # (an idle lane's publish must not refresh a stale
+                    # hold it didn't set)
+                    held, t_held = depth, mono
+                self._fam_depth_hold[fam] = (held, t_held)
+                depth = held
             self.metrics.observe("bus_fanout_latency_seconds", fanout_s,
-                                 channel=channel)
-            self.metrics.set_gauge("bus_queue_depth", depth, channel=channel)
+                                 channel=fam)
+            self.metrics.set_gauge("bus_queue_depth", depth, channel=fam)
             if dropped:
                 self.metrics.inc("bus_dropped_messages_total", dropped,
-                                 channel=channel)
+                                 channel=fam)
         return delivered
+
+    def sync_family_depth_gauges(self) -> None:
+        """Re-anchor each rolled-up family's held `bus_queue_depth` gauge
+        on the TRUE current max over its member channels (the per-publish
+        path only max-holds — cheap but monotone until corrected).  One
+        O(channels) pass, called once per tick by
+        `SaturationMonitor.observe_bus`."""
+        if self.metrics is None or not self._fam_depth_hold:
+            return
+        true_max: dict[str, int] = {}
+        for channel, depth in self.queue_depths().items():
+            fam = channel_family(channel)
+            if fam in self._fam_depth_hold:
+                true_max[fam] = max(true_max.get(fam, 0), int(depth))
+        mono = time.monotonic()
+        for fam in self._fam_depth_hold:
+            depth = true_max.get(fam, 0)
+            self._fam_depth_hold[fam] = (depth, mono)
+            self.metrics.set_gauge("bus_queue_depth", depth, channel=fam)
 
     def queue_depths(self) -> dict[str, int]:
         """Max pending depth per subscription pattern (telemetry view)."""

@@ -106,15 +106,23 @@ class DashboardServer:
                                    "application/json")
                     elif path == "/decisions":
                         # decision-provenance query (obs/flightrec.py):
-                        # ?symbol=X&trace_id=Y&limit=N over the recorder's
-                        # ring — signal→order→fill→PnL per decision
+                        # ?symbol=X&trace_id=Y&lane=N&limit=M over the
+                        # recorder's ring — signal→order→fill→PnL per
+                        # decision; `lane` filters a vmapped tenant
+                        # lane's sampled provenance (obs/fleetscope.py)
                         try:
                             limit = max(int(q.get("limit", [50])[0]), 0)
                         except ValueError:
                             limit = 50
+                        try:
+                            lane = (int(q["lane"][0]) if "lane" in q
+                                    else None)
+                        except ValueError:
+                            lane = None
                         self._send(json.dumps(outer.decisions(
                             symbol=q.get("symbol", [None])[0],
                             trace_id=q.get("trace_id", [None])[0],
+                            lane=lane,
                             limit=limit), default=str).encode(),
                                    "application/json")
                     elif path == "/profile":
@@ -216,11 +224,13 @@ class DashboardServer:
         return tracer.traces(limit=limit) if tracer is not None else []
 
     def decisions(self, symbol: str | None = None,
-                  trace_id: str | None = None, limit: int = 50) -> list:
+                  trace_id: str | None = None, limit: int = 50,
+                  lane: int | None = None) -> list:
         fr = getattr(self.system, "flightrec", None)
         if fr is None:
             return []
-        return fr.query(symbol=symbol, trace_id=trace_id, limit=limit)
+        return fr.query(symbol=symbol, trace_id=trace_id, limit=limit,
+                        lane=lane)
 
     def profile(self, seconds: float) -> dict | None:
         """On-demand XPlane capture: `jax.profiler.trace` for ``seconds``
@@ -283,6 +293,14 @@ class DashboardServer:
             # duty cycles, bus utilization/watermarks, scatter occupancy,
             # host-readback share, event-loop lag
             out["capacity"] = saturation.status()
+        fleet = getattr(system, "fleetscope", None)
+        if fleet is not None:
+            # fleet observatory (obs/fleetscope.py): device-aggregated
+            # lane telemetry — gate mix, dispersion quantiles, top-k
+            # rank table, starvation/drift — O(gates + quantiles + K)
+            # JSON regardless of tenant count (`cli fleet --url` reads
+            # this block)
+            out["fleet"] = fleet.status()
         scorecard = getattr(system, "scorecard", None)
         if scorecard is not None:
             sc = scorecard.status()

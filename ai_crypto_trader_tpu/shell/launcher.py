@@ -74,6 +74,16 @@ class TradingSystem:
     # (pad fraction, per-device members, all-gather bytes) and the
     # per-device memory-imbalance fold sampled each tick.
     enable_meshprof: bool = False
+    # Fleet observatory (obs/fleetscope.py). Default OFF like tracing/
+    # devprof/meshprof (disabled hot path = one module-global check).
+    # When on: any vmapped TenantEngine in this process emits its
+    # device-aggregated fleet block (gate histogram, PnL/balance
+    # dispersion quantiles, top-k lane rank) through its own dispatch,
+    # the fleet_* gauges land on this system's registry, /state.json
+    # gains a `fleet` block, and the Fleet* alert rules arm.  The
+    # launcher's own one-tenant objects deployment produces no fleet
+    # data — the flag exists for vmapped deployments sharing the stack.
+    enable_fleetscope: bool = False
     # Crash-safe trading state (utils/journal.py): when set, the executor
     # write-ahead-journals every order intent/ack/closure here, and
     # `recover()` replays + reconciles it after a restart.
@@ -152,6 +162,12 @@ class TradingSystem:
         if self.enable_meshprof:
             self.meshprof = meshprof_mod.configure(
                 meshprof_mod.MeshProf(metrics=self.metrics))
+        self.fleetscope = None
+        if self.enable_fleetscope:
+            from ai_crypto_trader_tpu.obs import fleetscope as fleet_mod
+
+            self.fleetscope = fleet_mod.configure(
+                fleet_mod.FleetScope(metrics=self.metrics))
         # bus telemetry: fanout latency + queue depth metrics, and slow-
         # subscriber warnings through the structured log (trace-correlated)
         self.bus = EventBus(now_fn=self.now_fn, metrics=self.metrics,
@@ -716,6 +732,12 @@ class TradingSystem:
             # capacity observatory inputs: saturating stages (windowed,
             # min-sample gated), backpressured bus channels, loop lag
             state.update(self.saturation.alert_state())
+        if self.fleetscope is not None and self.fleetscope.decides:
+            # fleet observatory inputs: gate dominance, PnL dispersion,
+            # lane starvation and balance drift off the vmapped tenant
+            # engine's device aggregates (only once a fleet has decided —
+            # the launcher's own objects deployment produces none)
+            state.update(self.fleetscope.alert_state())
         # trading-quality observatory inputs (obs/): worst live model
         # calibration/accuracy and the max on-device feature PSI
         if self.scorecard is not None:
@@ -823,6 +845,11 @@ class TradingSystem:
         if (self.meshprof is not None
                 and meshprof_mod.active() is self.meshprof):
             meshprof_mod.disable()
+        if self.fleetscope is not None:
+            from ai_crypto_trader_tpu.obs import fleetscope as fleet_mod
+
+            if fleet_mod.active() is self.fleetscope:
+                fleet_mod.disable()
         if self.journal is not None:
             self.journal.close()           # flush the buffered tail
         if self.flightrec is not None:

@@ -54,6 +54,7 @@ the thing the SLO is written against.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import time
 from dataclasses import dataclass, replace
 
@@ -62,7 +63,9 @@ import numpy as np
 from ai_crypto_trader_tpu.config import TradingParams
 from ai_crypto_trader_tpu.data.ingest import OHLCV
 from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
-from ai_crypto_trader_tpu.ops.tenant_engine import TenantEngine
+from ai_crypto_trader_tpu.obs import fleetscope
+from ai_crypto_trader_tpu.obs.flightrec import GATES, FlightRecorder
+from ai_crypto_trader_tpu.ops.tenant_engine import NO_DECISION, TenantEngine
 from ai_crypto_trader_tpu.shell.analyzer import SignalAnalyzer
 from ai_crypto_trader_tpu.shell.bus import EventBus
 from ai_crypto_trader_tpu.shell.exchange import FakeExchange
@@ -111,6 +114,18 @@ class LoadConfig:
     # decision fan-out IS the load); permissive params open real positions
     # so the venue/SL-TP path is loaded too.
     trading: TradingParams | None = None
+    # Fleet observatory (obs/fleetscope.py), vmapped mode only: device-
+    # aggregated gate histogram / dispersion / rank table in the tenant
+    # engine's own dispatch, fleet_* gauges on the harness registry, and
+    # crc32-sampled lane provenance through a dedicated FlightRecorder.
+    # run_load()/ramp() activate the module-global scope for the run
+    # (unless one is already configured); OFF measures the bare engine —
+    # the bench capacity row's fleetscope_overhead_pct probe.
+    fleetscope: bool = True
+    # Persist the sampled lanes' decision provenance as checksummed JSONL
+    # (the flight-recorder journal format) — `cli why SYMBOL --lane N
+    # --file PATH` reads it back offline.
+    flightrec_path: str | None = None
 
 
 @dataclass
@@ -180,6 +195,14 @@ class SyntheticTenantTraffic:
         self.tenant_engine: TenantEngine | None = None
         self._updates_q = None
         self._vm_lanes: dict[int, _TenantLane] = {}
+        # sampled-lane decision provenance (vmapped mode): a dedicated
+        # recorder with metrics=None — the fleet's veto COUNTS come from
+        # the device histogram (one inc per gate per tick), so the
+        # sampled records must not double-count decision_vetoes_total
+        self.flightrec = (FlightRecorder(path=cfg.flightrec_path,
+                                         metrics=None, now_fn=self._now)
+                          if cfg.mode == "vmapped" else None)
+        self._pending_rids: dict[tuple[int, int], str] = {}
         self.last_fanout: list[tuple[int, int]] = []
         self.latencies_ms: list[float] = []
         self.published = self.analyzed = self.executed = 0
@@ -190,13 +213,15 @@ class SyntheticTenantTraffic:
         return self.clock["t"]
 
     # -- tenant provisioning --------------------------------------------------
-    def _lane(self, i: int, with_analyzer: bool = True) -> _TenantLane:
+    def _lane(self, i: int, with_analyzer: bool = True,
+              flightrec=None) -> _TenantLane:
         name = f"t{i}"
         venue = FakeExchange(self._series, quote_balance=10_000.0)
         venue.cursor = dict(self.market.cursor)      # lockstep prices
         executor = TradeExecutor(self.bus, venue, now_fn=self._now,
                                  lane=name, coid_prefix=f"ld{i}",
-                                 trading=self.cfg.trading or TradingParams())
+                                 trading=self.cfg.trading or TradingParams(),
+                                 flightrec=flightrec)
         analyzer = None
         if with_analyzer:
             analyzer = SignalAnalyzer(self.bus, now_fn=self._now,
@@ -239,6 +264,12 @@ class SyntheticTenantTraffic:
         self.saturation.set_tenant_lanes(
             self.cfg.tenants * self.cfg.symbols, self.cfg.mode)
 
+    def close(self) -> None:
+        """Flush/close the sampled-provenance journal (a batched veto
+        tail must land on disk before `cli why --file` reads it)."""
+        if self.flightrec is not None:
+            self.flightrec.close()
+
     def reset_measurement(self) -> None:
         """Start a fresh measurement window: latencies, throughput
         counters, saturation duty/quantile windows and the loop-lag
@@ -255,8 +286,15 @@ class SyntheticTenantTraffic:
         lane = self._vm_lanes.get(i)
         if lane is None:
             # executors exist per tenant only once the tenant actually
-            # trades — the venue-forced rim stays O(executing tenants)
-            lane = self._vm_lanes[i] = self._lane(i, with_analyzer=False)
+            # trades — the venue-forced rim stays O(executing tenants).
+            # A provenance-sampled lane's executor gets the recorder, so
+            # its executions/fills/closures chain onto the sampled
+            # decision records exactly like an object lane's would.
+            fs = fleetscope.active()
+            fr = (self.flightrec
+                  if fs is not None and fs.sampled(i) else None)
+            lane = self._vm_lanes[i] = self._lane(i, with_analyzer=False,
+                                                  flightrec=fr)
         return lane
 
     async def _vm_tick(self) -> set[int]:
@@ -293,8 +331,18 @@ class SyntheticTenantTraffic:
         if self.cfg.engine_lag_s:
             time.sleep(self.cfg.engine_lag_s)        # BLOCKING on purpose
         self.analyzed += eng.n_tenants * len(updates)
-        for gate, count in eng.veto_counts(out).items():
+        fs = fleetscope.active()
+        if fs is not None and eng.last_fleet is not None:
+            # device-aggregated gate histogram (obs/fleetscope.py): the
+            # counts come off the dispatch itself — no host scan over the
+            # [N, S] table, one counter inc per gate per tick
+            counts = fs.veto_counts(eng.last_fleet)
+        else:
+            counts = eng.veto_counts(out)
+        for gate, count in counts.items():
             self.metrics.inc("decision_vetoes_total", count, gate=gate)
+        if fs is not None and self.flightrec is not None:
+            self._record_sampled(fs, eng, feats, out)
         self.last_fanout = eng.executable(out)
         dirty: set[int] = set()
         for n, s in self.last_fanout:
@@ -318,9 +366,54 @@ class SyntheticTenantTraffic:
                 "structure_version": u.get("structure_version"),
                 "lane": lane.name,
             }
+            # a sampled lane's open decision record follows its signal
+            # (the analyzer convention): the lane executor's flightrec
+            # finalizes the SAME record through execution → fill → PnL
+            rid = self._pending_rids.pop((n, s), None)
+            if rid is not None:
+                signal["decision_id"] = rid
             await self.bus.publish(f"trading_signals.{lane.name}", signal)
             dirty.add(n)
         return dirty
+
+    def _record_sampled(self, fs, eng, feats: dict, out: dict) -> None:
+        """Full decision provenance for the crc32-sampled lanes: one
+        FlightRecorder record per (sampled lane, decided symbol) straight
+        from the device decision table — gate/verdict for vetoes
+        (terminal immediately), an OPEN record for executables whose id
+        rides the fan-out signal so the lane executor completes the
+        chain.  O(sampled lanes × symbols) host work, independent of N."""
+        fr = self.flightrec
+        # a rid never claimed by the fan-out (throttled symbol) stays an
+        # honest PENDING record in the ring; drop the stale index so it
+        # can never mis-attach to a LATER tick's signal
+        self._pending_rids.clear()
+        sig_name = {1: "BUY", -1: "SELL", 0: "NEUTRAL"}
+        for n in fs.sample_lanes(eng.n_tenants):
+            for s in range(len(self.symbols)):
+                gate = int(out["gate"][n, s])
+                if gate == NO_DECISION:
+                    continue
+                verdict = {
+                    "decision": sig_name.get(int(out["decision"][n, s]),
+                                             "HOLD"),
+                    "confidence": float(out["confidence"][n, s]),
+                }
+                features = {
+                    "price": float(feats["price"][s]),
+                    "signal": sig_name.get(int(feats["signal"][s]),
+                                           "NEUTRAL"),
+                    "signal_strength": float(feats["strength"][s]),
+                    "volatility": float(feats["volatility"][s]),
+                    "avg_volume": float(feats["avg_volume"][s]),
+                }
+                rid = fr.begin(self.symbols[s], features=features,
+                               verdict=verdict, lane=n)
+                if gate >= 0:
+                    fr.veto(rid, GATES[gate],
+                            detail=f"vmapped lane {n}")
+                else:
+                    self._pending_rids[(n, s)] = rid
 
     def _vm_reconcile(self) -> None:
         """Venue truth wins, per MATERIALIZED tenant: the engine's open
@@ -333,10 +426,15 @@ class SyntheticTenantTraffic:
         from).  O(trading tenants) host work; a correction re-seeds from
         the mirror on the next dispatch (a transfer, never a compile)."""
         for n, lane in self._vm_lanes.items():
-            self.tenant_engine.sync_positions(
+            closed = self.tenant_engine.sync_positions(
                 n, lane.executor.active_trades)
+            # a balance jump right after a learned closure is venue truth
+            # doing its job (sale proceeds the engine's entry model never
+            # sees) — `expected` exempts it from the FleetBalanceDrift
+            # accounting; an UNEXPLAINED divergence still counts
             self.tenant_engine.sync_balance(
-                n, lane.venue.get_balances().get("USDC", 0.0))
+                n, lane.venue.get_balances().get("USDC", 0.0),
+                expected=closed)
 
     # -- one tick -------------------------------------------------------------
     async def tick(self, timed: bool = True) -> float:
@@ -416,7 +514,10 @@ class SyntheticTenantTraffic:
     def report(self) -> dict:
         cfg, sat = self.cfg, self.saturation
         lat = np.asarray(self.latencies_ms or [0.0])
+        fs = fleetscope.active()
+        fleet = (fs.status() if fs is not None and fs.decides else None)
         return {
+            **({"fleet": fleet} if fleet else {}),
             "tenants": cfg.tenants, "symbols": cfg.symbols,
             "lanes": cfg.tenants * cfg.symbols,
             "mode": cfg.mode,
@@ -437,11 +538,28 @@ class SyntheticTenantTraffic:
         }
 
 
+def _fleet_scope(traffic: SyntheticTenantTraffic):
+    """Scoped fleet-observatory activation for a measured run: vmapped
+    mode with `cfg.fleetscope` gets a FleetScope on the harness registry
+    unless the caller already configured one (tests drive their own via
+    `fleetscope.use`); objects mode / opted-out runs measure bare."""
+    cfg = traffic.cfg
+    if (cfg.mode == "vmapped" and cfg.fleetscope
+            and fleetscope.active() is None):
+        return fleetscope.use(
+            fleetscope.FleetScope(metrics=traffic.metrics))
+    return contextlib.nullcontext(fleetscope.active())
+
+
 def run_load(cfg: LoadConfig,
              metrics: MetricsRegistry | None = None) -> dict:
     """Measure ONE load point (blocking entry; builds its own loop)."""
     traffic = SyntheticTenantTraffic(cfg, metrics=metrics)
-    return asyncio.run(traffic.run())
+    with _fleet_scope(traffic):
+        try:
+            return asyncio.run(traffic.run())
+        finally:
+            traffic.close()
 
 
 def default_tenant_steps(max_tenants: int) -> list[int]:
@@ -495,24 +613,30 @@ def ramp(base: LoadConfig, tenant_steps: list[int] | None = None,
         return rep
 
     reports, max_sustainable, breach = [], None, None
-    for tenants in steps:
-        rep = measure(tenants)
-        reports.append(rep)
-        if rep["breached"]:
-            breach = rep
-            break
-        max_sustainable = rep
-    if breach is not None and refine:
-        lo = max_sustainable["tenants"] if max_sustainable else 0
-        hi = breach["tenants"]
-        while hi - lo > 1:
-            rep = measure((lo + hi) // 2)
-            rep["refined"] = True
-            reports.append(rep)
-            if rep["breached"]:
-                hi, breach = rep["tenants"], rep
-            else:
-                lo, max_sustainable = rep["tenants"], rep
+    with _fleet_scope(traffic):
+        try:
+            for tenants in steps:
+                rep = measure(tenants)
+                reports.append(rep)
+                if rep["breached"]:
+                    breach = rep
+                    break
+                max_sustainable = rep
+            if breach is not None and refine:
+                lo = max_sustainable["tenants"] if max_sustainable else 0
+                hi = breach["tenants"]
+                while hi - lo > 1:
+                    rep = measure((lo + hi) // 2)
+                    rep["refined"] = True
+                    reports.append(rep)
+                    if rep["breached"]:
+                        hi, breach = rep["tenants"], rep
+                    else:
+                        lo, max_sustainable = rep["tenants"], rep
+        finally:
+            # an aborted step (engine error, Ctrl-C mid-bisect) must not
+            # lose the sampled-provenance journal's buffered tail
+            traffic.close()
 
     def point(rep):
         return {k: rep[k] for k in ("tenants", "symbols", "lanes",

@@ -159,6 +159,36 @@ def default_rules() -> list[AlertRule]:
         AlertRule("ModelAccuracyDegraded", "warning",
                   lambda s: s.get("model_accuracy_worst", 1.0) < 0.45,
                   "a model's live directional accuracy fell below 0.45"),
+        # --- fleet observatory (obs/fleetscope.py) ---
+        # all four read device-aggregated inputs off the vmapped tenant
+        # engine's own dispatch (FleetScope.alert_state); thresholds ride
+        # the state so the rule evaluates the scope's configuration, not
+        # a second hardcoded constant.  Dominance and starvation are
+        # windowed + min-sample gated at the source, so a cold fleet can
+        # never page.  monitoring/alert_rules.yml carries the PromQL
+        # twins over the fleet_* gauges.
+        AlertRule("FleetGateDominance", "warning",
+                  lambda s: (s.get("fleet_gate_dominance", 0.0)
+                             > s.get("fleet_gate_dominance_threshold",
+                                     0.95)),
+                  "one veto gate dominates the fleet's decision mix — a "
+                  "config push or poisoned feed is vetoing every lane "
+                  "the same way"),
+        AlertRule("FleetPnLDispersionHigh", "warning",
+                  lambda s: (s.get("fleet_pnl_spread", 0.0)
+                             > s.get("fleet_pnl_spread_budget", 500.0)),
+                  "fleet rolling-PnL dispersion (p95−p5) above budget — "
+                  "lanes are diverging far beyond their shared market"),
+        AlertRule("FleetLaneStarved", "warning",
+                  lambda s: s.get("fleet_starved_lanes", 0) > 0,
+                  "lanes produced no decision in every decide of the "
+                  "window while the rest of the fleet kept deciding"),
+        AlertRule("FleetBalanceDrift", "warning",
+                  lambda s: (s.get("fleet_balance_drift", 0.0)
+                             > s.get("fleet_balance_drift_budget", 0.01)),
+                  "engine-mirror balance diverged from venue truth "
+                  "beyond the re-anchor budget with no explaining "
+                  "closure (fee-model error or mirror corruption)"),
     ]
 
 

@@ -32,6 +32,20 @@ def escape_label_value(value) -> str:
             .replace("\n", "\\n"))
 
 
+def channel_family(channel: str) -> str:
+    """Metric-label rollup for per-lane bus channels: the dotted lane
+    convention (`trading_signals.<lane>`) makes channel COUNT scale with
+    the tenant fleet, and per-channel gauges labeled with raw lane names
+    would eat a family's 512-series cap at ~500 lanes — silently
+    clipping UNRELATED channels' series behind `_admit`.  Every dotted
+    channel rolls up to its `<head>.*` family (one series for the whole
+    fleet); undotted channels pass through unchanged.  Queue telemetry
+    keeps its per-lane fidelity in `EventBus.queue_depths()` — only the
+    metric LABEL is bounded."""
+    head, dot, _ = channel.partition(".")
+    return f"{head}.*" if dot else channel
+
+
 @dataclass
 class MetricsRegistry:
     namespace: str = "crypto_trader_tpu"

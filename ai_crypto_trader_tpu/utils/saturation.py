@@ -119,19 +119,45 @@ class SaturationMonitor:
         """Per-channel queue depth vs capacity.  ``bus`` is an EventBus:
         bounded channels saturate against ``max_queue``; "grow" channels
         are unbounded but report against the same soft limit (utilization
-        past 1.0 = a backlog the soft-limit warnings are already about)."""
+        past 1.0 = a backlog the soft-limit warnings are already about).
+
+        Per-lane channels (`trading_signals.<lane>`) fold into ONE
+        `trading_signals.*` family entry — depth/watermark take the max
+        over lanes (the worst lane is the backpressure signal), drops
+        sum.  Without the rollup a 1020-lane fleet exports 1020+ series
+        per bus gauge family, eats the registry's 512-series cap, and
+        silently clips unrelated channels (utils/metrics.channel_family;
+        the regression test pins a 1000-lane bus under the cap)."""
+        from ai_crypto_trader_tpu.utils.metrics import channel_family
+
         cap = max(int(getattr(bus, "max_queue", 0) or 0), 1)
+        sync = getattr(bus, "sync_family_depth_gauges", None)
+        if sync is not None:
+            # re-anchor the bus's max-held family depth gauges on the
+            # true current maxes (per-tick correction of the per-publish
+            # max-hold — a drained backlog must read as drained)
+            sync()
         depths = bus.queue_depths()
         watermarks = getattr(bus, "depth_watermarks", {})
-        snapshot = {}
+        agg: dict = {}
         for channel, depth in depths.items():
-            hw = max(watermarks.get(channel, 0),
-                     self.bus_watermarks.get(channel, 0), depth)
-            self.bus_watermarks[channel] = hw
-            snapshot[channel] = {
-                "depth": int(depth), "capacity": cap,
-                "utilization": depth / cap, "high_watermark": int(hw),
-                "dropped_total": int(bus.dropped_counts.get(channel, 0)),
+            fam = channel_family(channel)
+            a = agg.setdefault(fam, {"depth": 0, "hw": 0, "dropped": 0,
+                                     "lanes": 0})
+            a["depth"] = max(a["depth"], int(depth))
+            a["hw"] = max(a["hw"], int(watermarks.get(channel, 0)))
+            a["dropped"] += int(bus.dropped_counts.get(channel, 0))
+            a["lanes"] += 1
+        snapshot = {}
+        for fam, a in agg.items():
+            hw = max(a["hw"], self.bus_watermarks.get(fam, 0), a["depth"])
+            self.bus_watermarks[fam] = hw
+            snapshot[fam] = {
+                "depth": a["depth"], "capacity": cap,
+                "utilization": a["depth"] / cap, "high_watermark": int(hw),
+                "dropped_total": a["dropped"],
+                # lanes folded into this family (1 = a plain channel)
+                "channels": a["lanes"],
             }
         self.last_bus = snapshot
 

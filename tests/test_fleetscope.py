@@ -1,0 +1,732 @@
+"""Fleet observatory (obs/fleetscope.py + the tenant-engine integration).
+
+Covers:
+  * device-vs-host aggregate PARITY: the in-program gate histogram,
+    dispersion quantiles and top-k rank table recomputed in NumPy from
+    the host-read decision table must match bit-for-bit (same
+    nearest-rank formula, same masking);
+  * the one-dispatch/one-sync/zero-recompile CONTRACT with fleetscope ON
+    (meshprof sentinel + donation verifier — the fleet block rides the
+    SAME dispatch and the SAME host_read), and the observatory toggle as
+    a DECLARED cold recompile;
+  * ragged-tenant pad rows (and deactivated tenants) excluded from every
+    aggregate;
+  * the bounded-cardinality ACCEPTANCE: fleet_* series count at N=1000
+    equals the count at N=8 (O(gates + quantiles + K), never O(N)), with
+    zero metric_cardinality_dropped_total;
+  * the bus-metric cardinality regression (satellite): a 1000-lane bus
+    stays under the 512-series cap with the drop counter at zero;
+  * loadgen's decision_vetoes_total aggregation riding the DEVICE gate
+    histogram (no host [N, S] scan when the observatory is on);
+  * crc32-stable lane sampling + sampled decision provenance end-to-end:
+    `cli why SYMBOL --lane N` resolves a vmapped lane's gate/verdict
+    from the persisted JSONL, and executable decisions chain through the
+    real lane executor (execution → fill);
+  * alert coherence for every fleet_* series in BOTH rule engines
+    (utils/alerts.py in-process + monitoring/alert_rules.yml PromQL) and
+    the recording-rule / Grafana Fleet row references.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.config import TradingParams
+from ai_crypto_trader_tpu.obs import fleetscope
+from ai_crypto_trader_tpu.obs.fleetscope import (
+    FleetScope,
+    bin_names,
+    host_aggregates,
+    lane_sampled,
+)
+from ai_crypto_trader_tpu.obs.flightrec import GATES
+from ai_crypto_trader_tpu.ops import tenant_engine
+from ai_crypto_trader_tpu.ops.tenant_engine import TenantEngine
+from ai_crypto_trader_tpu.utils import devprof, meshprof
+from ai_crypto_trader_tpu.utils.alerts import AlertManager, default_rules
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+# DELIBERATELY 9 symbols (S pads to 16): jit trace caches are shared
+# across the session, and tracing the tenant program at test_tenant_
+# engine.py's (N, 8) shapes FROM THIS FILE (alphabetically first) would
+# rob that suite's first-trace assertions (meshprof layout cards are
+# recorded at trace time) of their trace.
+SYMS = [f"F{i:03d}USDC" for i in range(9)]
+
+
+def _feats(eng, price, signal, strength, vol, avol, valid=None):
+    S, n = eng.S, len(price)
+    pad = lambda a, dt: np.asarray(list(a) + [0] * (S - n), dt)  # noqa: E731
+    return {
+        "price": pad(price, np.float32),
+        "signal": pad(signal, np.int32),
+        "strength": pad(strength, np.float32),
+        "volatility": pad(vol, np.float32),
+        "avg_volume": pad(avol, np.float32),
+        "valid": pad(valid if valid is not None else [True] * n, bool),
+    }
+
+
+def _mixed_feats(eng):
+    """Features that exercise several gates AND an executable entry."""
+    return _feats(eng, [100.0, 50.0, 200.0, 80.0], [1, -1, 1, 0],
+                  [90.0, 70.0, 40.0, 90.0], [0.015] * 4, [60_000.0] * 4)
+
+
+class TestDeviceHostParity:
+    def test_aggregates_match_numpy_recompute(self):
+        """ACCEPTANCE: every device aggregate recomputed on host from the
+        SAME decision table + state mirror must agree — histogram and
+        counts exactly, quantiles/top-k to f32 tolerance."""
+        with fleetscope.use(FleetScope()):
+            eng = TenantEngine(SYMS, 6)      # pads to 8: 2 pad rows
+            # heterogeneous lanes so quantiles/rank are non-degenerate
+            eng.set_tenant(1, balance=5_000.0)
+            eng.set_tenant(2, balance=20_000.0,
+                           conf_threshold=0.1, min_strength=10.0)
+            eng.set_tenant(4, active=False)  # deactivated, not padded
+            feats = _mixed_feats(eng)
+            for _ in range(3):
+                out = eng.decide(feats)
+            fleet = eng.last_fleet
+            st = eng._state_np
+            # the device aggregation slices the pow2 symbol pad back to
+            # the real universe — the host recompute sees the same table
+            s_real = len(eng.symbols)
+            gate_full = np.full((eng.n_pad, s_real), -2, np.int8)
+            gate_full[:eng.n_tenants] = out["gate"][:, :s_real]
+            host = host_aggregates(
+                gate=gate_full,
+                pnl=(np.concatenate([out["equity"],
+                                     st["balance"][eng.n_tenants:]])
+                     - st["equity0"]),
+                balance=st["balance"],
+                max_drawdown=st["max_drawdown"],
+                active=eng._params_np["active"])
+            np.testing.assert_array_equal(fleet["gate_hist"],
+                                          host["gate_hist"])
+            assert int(fleet["decisions"]) == host["decisions"]
+            assert int(fleet["executable"]) == host["executable"]
+            assert int(fleet["starved"]) == host["starved"]
+            assert int(fleet["active"]) == host["active"] == 5
+            np.testing.assert_allclose(fleet["pnl_q"], host["pnl_q"],
+                                       rtol=1e-5, atol=1e-3)
+            np.testing.assert_allclose(fleet["balance_q"],
+                                       host["balance_q"],
+                                       rtol=1e-5, atol=1e-3)
+            np.testing.assert_allclose(fleet["max_drawdown_max"],
+                                       host["max_drawdown_max"],
+                                       rtol=1e-5, atol=1e-3)
+            # rank tables: the k active lanes agree as (lane → pnl) maps
+            # (argsort tie order may differ between lax.top_k and numpy)
+            k = int(fleet["active"])
+
+            def rank_map(lanes, pnls):
+                return {int(l): round(float(p), 3)
+                        for l, p in zip(lanes[:k], pnls[:k])}
+
+            assert rank_map(fleet["best_lane"], fleet["best_pnl"]) \
+                == rank_map(host["best_lane"], host["best_pnl"])
+            assert rank_map(fleet["worst_lane"], fleet["worst_pnl"]) \
+                == rank_map(host["worst_lane"], host["worst_pnl"])
+
+    def test_bin_names_extend_the_gate_vocabulary(self):
+        names = bin_names()
+        assert names[0] == "no_decision" and names[1] == "executable"
+        assert names[2:] == tuple(GATES)
+
+    def test_pad_and_deactivated_rows_excluded(self):
+        """Ragged tenant counts: the pow2 pad rows (active=False by
+        construction) and explicitly deactivated tenants contribute to NO
+        aggregate — histogram mass, quantiles, rank table, active."""
+        with fleetscope.use(FleetScope()):
+            eng = TenantEngine(SYMS, 5)      # pads to 8
+            eng.set_tenant(3, active=False)
+            eng.decide(_mixed_feats(eng))
+            fleet = eng.last_fleet
+            active = 4                        # 5 − 1 deactivated
+            assert int(fleet["active"]) == active
+            # every counted gate cell belongs to an active row AND a
+            # REAL symbol column: total histogram mass = active × S_real
+            # (the pow2 symbol pad's phantom no_decision cells excluded)
+            assert int(fleet["gate_hist"].sum()) \
+                == active * len(eng.symbols)
+            assert eng.S > len(eng.symbols)   # the pad actually exists
+            k = min(int(fleet["active"]), len(fleet["best_lane"]))
+            for lane in (*fleet["best_lane"][:k], *fleet["worst_lane"][:k]):
+                assert int(lane) < eng.n_tenants and int(lane) != 3
+
+    def test_rolling_pnl_and_drawdown_track_equity(self):
+        """A lane that enters a position carries the fee as negative
+        rolling PnL; a price drop deepens PnL AND the max-drawdown fold;
+        a recovery lifts PnL but drawdown stays (monotone peak fold)."""
+        with fleetscope.use(FleetScope()):
+            eng = TenantEngine(SYMS, 2)
+            feats = _mixed_feats(eng)
+            eng.decide(feats)                 # entry on P000 at 100
+            pnl_0 = eng.rolling_pnl()
+            assert (pnl_0 < 0).all()          # the entry fee
+            drop = dict(feats)
+            drop = _feats(eng, [80.0, 50.0, 200.0, 80.0], [1, -1, 1, 0],
+                          [90.0, 70.0, 40.0, 90.0], [0.015] * 4,
+                          [60_000.0] * 4)
+            eng.decide(drop)                  # mark-to-market at 80
+            pnl_drop = eng.rolling_pnl()
+            dd_drop = eng.max_drawdowns()
+            assert (pnl_drop < pnl_0).all()
+            assert (dd_drop > 0).all()
+            recover = _feats(eng, [120.0, 50.0, 200.0, 80.0],
+                             [1, -1, 1, 0], [90.0, 70.0, 40.0, 90.0],
+                             [0.015] * 4, [60_000.0] * 4)
+            eng.decide(recover)
+            assert (eng.rolling_pnl() > pnl_drop).all()
+            np.testing.assert_allclose(eng.max_drawdowns(), dd_drop,
+                                       rtol=1e-5)
+
+
+class TestContractWithFleetscope:
+    def test_one_dispatch_one_sync_zero_recompile(self, monkeypatch):
+        """The PR 12/14 contract, with the observatory ON: the fleet
+        block rides the SAME dispatch and the SAME host_read — syncs
+        count identically, the donation still aliases, and steady state
+        never re-traces."""
+        syncs = {"n": 0}
+        real_read = tenant_engine.host_read
+
+        def counting_read(tree):
+            syncs["n"] += 1
+            return real_read(tree)
+
+        monkeypatch.setattr(tenant_engine, "host_read", counting_read)
+        m = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=m)
+        with devprof.use(devprof.DevProf(metrics=m)) as dp, \
+                meshprof.use(mp), fleetscope.use(FleetScope(metrics=m)):
+            eng = TenantEngine(SYMS, 48)      # pads to 64
+            feats = _mixed_feats(eng)
+            eng.decide(feats)                 # compile + card (cold)
+            assert syncs["n"] == 1
+            assert eng.last_fleet is not None
+            card = dp.cards["tenant_engine"]
+            assert card.error is None and card.donation_ok is True
+            assert dp.donation_failures == []
+            eng.decide(feats)                 # steady state
+            assert syncs["n"] == 2
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+            assert mp.transfers.total() == 0
+            assert not eng._need_seed and eng.full_seeds == 1
+
+    def test_observatory_toggle_is_a_declared_recompile(self):
+        """Turning fleetscope on/off swaps compiled programs — declared
+        cold to the sentinel, so the toggle never pages
+        SteadyStateRecompile."""
+        m = MetricsRegistry()
+        mp = meshprof.MeshProf(metrics=m)
+        with meshprof.use(mp):
+            eng = TenantEngine(SYMS, 8)
+            feats = _mixed_feats(eng)
+            eng.decide(feats)
+            eng.decide(feats)
+            with fleetscope.use(FleetScope()):
+                eng.decide(feats)             # ON: new program, declared
+                assert eng.last_fleet is not None
+            eng.decide(feats)                 # OFF again: declared too
+            assert eng.last_fleet is None
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+
+    def test_unexplained_balance_resync_feeds_drift(self):
+        """`sync_balance` divergence WITHOUT an explaining closure lands
+        in the next decide's fleetscope fold (FleetBalanceDrift input);
+        an expected re-anchor (venue-side closure just learned) does
+        not."""
+        with fleetscope.use(FleetScope()) as fs:
+            eng = TenantEngine(SYMS, 2)
+            feats = _mixed_feats(eng)
+            eng.decide(feats)
+            assert eng.sync_balance(0, 9_000.0, expected=True)
+            eng.decide(feats)
+            assert fs.balance_drift_max() == 0.0
+            assert eng.sync_balance(1, 5_000.0)   # unexplained
+            eng.decide(feats)
+            assert fs.balance_drift_max() > 0.0
+            assert fs.alert_state()["fleet_balance_drift"] > 0.01
+
+
+class TestBoundedCardinality:
+    def _series_counts(self, m):
+        fams = {}
+        for store in (m.counters, m.gauges, m.histograms):
+            for key in store:
+                base = key.partition("{")[0]
+                fams[base] = fams.get(base, 0) + 1
+        return fams
+
+    def test_fleet_series_constant_in_tenant_count(self):
+        """ACCEPTANCE at N=1000: the fleet_* export is O(gates +
+        quantiles + K) series — the count at 1000 tenants equals the
+        count at 8, and nothing hits the registry's cardinality cap."""
+        counts = {}
+        for n in (8, 1000):
+            m = MetricsRegistry()
+            with fleetscope.use(FleetScope(metrics=m)):
+                eng = TenantEngine(SYMS, n)
+                eng.decide(_mixed_feats(eng))
+                eng.decide(_mixed_feats(eng))
+            fams = self._series_counts(m)
+            counts[n] = {k: v for k, v in fams.items() if "fleet_" in k}
+            assert counts[n], "no fleet series exported"
+            assert "crypto_trader_tpu_metric_cardinality_dropped_total" \
+                not in fams
+        assert counts[8] == counts[1000]
+        assert sum(counts[1000].values()) < 128   # gates + quantiles + 4K
+
+    def test_thousand_lane_bus_stays_under_cap(self):
+        """Satellite regression: 1000 `trading_signals.<lane>` channels
+        roll up to ONE `trading_signals.*` family series per bus gauge —
+        the registry's 512-series cap is never hit and the drop counter
+        stays zero."""
+        from ai_crypto_trader_tpu.shell.bus import EventBus
+        from ai_crypto_trader_tpu.utils.saturation import SaturationMonitor
+
+        m = MetricsRegistry()
+        bus = EventBus(metrics=m)
+        for i in range(1000):
+            bus.subscribe(f"trading_signals.t{i}")
+        bus.subscribe("market_updates")
+
+        async def go():
+            for i in range(1000):
+                await bus.publish(f"trading_signals.t{i}", {"i": i})
+            await bus.publish("market_updates", {"p": 1.0})
+
+        asyncio.run(go())
+        sat = SaturationMonitor(m, tick_budget_s=1.0)
+        sat.observe_bus(bus)
+        sat.end_tick(0.05)
+        sat.export()
+        fams = self._series_counts(m)
+        for fam, count in fams.items():
+            assert count < 512, (fam, count)
+        assert "crypto_trader_tpu_metric_cardinality_dropped_total" \
+            not in fams
+        # the per-lane fidelity survives where it belongs: the bus's own
+        # queue view; only the metric LABEL is bounded
+        assert len(bus.queue_depths()) == 1001
+        assert set(sat.last_bus) == {"trading_signals.*", "market_updates"}
+        assert sat.last_bus["trading_signals.*"]["channels"] == 1000
+
+    def test_family_depth_gauge_survives_idle_lane_publish(self):
+        """A backlogged lane's depth must not be overwritten by an idle
+        lane's next publish on the rolled-up family gauge (last-write-
+        wins would hide backpressure from the PromQL backlog alert);
+        the per-tick sync re-anchors a drained family back down."""
+        from ai_crypto_trader_tpu.shell.bus import EventBus
+
+        m = MetricsRegistry()
+        bus = EventBus(metrics=m)
+        q0 = bus.subscribe("trading_signals.t0")
+        bus.subscribe("trading_signals.t1")
+        key = ('crypto_trader_tpu_bus_queue_depth'
+               '{channel="trading_signals.*"}')
+
+        async def go():
+            for _ in range(5):
+                await bus.publish("trading_signals.t0", {})   # depth 5
+            await bus.publish("trading_signals.t1", {})       # depth 1
+
+        asyncio.run(go())
+        assert m.gauges[key] == 5                 # max-held, not 1
+        while not q0.empty():
+            q0.get_nowait()                       # t0 drains
+        bus.sync_family_depth_gauges()
+        assert m.gauges[key] == 1                 # true current max
+
+    def test_family_depth_hold_expires_without_saturation(self):
+        """With NO saturation monitor running (enable_saturation=False),
+        the max-hold must expire on its TTL instead of latching a
+        transient backlog's depth into the gauge forever."""
+        from ai_crypto_trader_tpu.shell.bus import EventBus
+
+        m = MetricsRegistry()
+        bus = EventBus(metrics=m, warn_interval_s=30.0)
+        q0 = bus.subscribe("trading_signals.t0")
+        bus.subscribe("trading_signals.t1")
+        key = ('crypto_trader_tpu_bus_queue_depth'
+               '{channel="trading_signals.*"}')
+
+        async def burst():
+            for _ in range(5):
+                await bus.publish("trading_signals.t0", {})
+
+        asyncio.run(burst())
+        while not q0.empty():
+            q0.get_nowait()
+        # age the hold past the TTL (time.monotonic based)
+        fam = "trading_signals.*"
+        held, t_held = bus._fam_depth_hold[fam]
+        bus._fam_depth_hold[fam] = (held, t_held - 31.0)
+        asyncio.run(bus.publish("trading_signals.t1", {}))
+        assert m.gauges[key] == 1                 # recovered, not 5
+
+    def test_host_twin_rank_tail_matches_device_inf_masking(self):
+        """host_aggregates' rank tail beyond the active count reads ∓inf
+        like the device's masked lax.top_k — never an inactive lane's
+        stale real PnL."""
+        pnl = np.array([5.0, -3.0, 99.0, 1.0])     # lane 2 deactivated
+        act = np.array([True, True, False, True])
+        host = host_aggregates(
+            gate=np.full((4, 2), -2, np.int8), pnl=pnl,
+            balance=np.full(4, 1e4), max_drawdown=np.zeros(4),
+            active=act, k=4)
+        assert host["best_pnl"][3] == -np.inf
+        assert host["worst_pnl"][3] == np.inf
+        assert 2 not in host["best_lane"][:3]
+        assert 2 not in host["worst_lane"][:3]
+
+    def test_export_clears_stale_shares_and_rank_rows(self):
+        """A gate that leaves the window reads share 0 (not its frozen
+        last value), and a shrunk fleet's tail rank rows read empty
+        (lane −1, pnl 0) instead of the old fleet's values."""
+        m = MetricsRegistry()
+        fs = FleetScope(metrics=m, window=4, min_decides=1, min_vetoes=1)
+        G = len(bin_names())
+
+        def fleet(gate_idx, n_act):
+            hist = np.zeros(G, np.int64)
+            hist[gate_idx] = 10
+            k = n_act
+            return {"gate_hist": hist, "decisions": 10, "executable": 0,
+                    "starved": 0, "active": n_act,
+                    "pnl_q": np.zeros(3), "balance_q": np.zeros(3),
+                    "max_drawdown_max": 0.0,
+                    "best_pnl": np.full(k, 7.0),
+                    "best_lane": np.arange(k),
+                    "worst_pnl": np.full(k, -7.0),
+                    "worst_lane": np.arange(k)}
+
+        fs.observe_decide(fleet(2, 6), tenants=6)
+        share_a = 'crypto_trader_tpu_fleet_gate_share{gate="%s"}' \
+                  % bin_names()[2]
+        assert m.gauges[share_a] > 0
+        rank5 = ('crypto_trader_tpu_fleet_lane_id'
+                 '{extreme="best",rank="5"}')
+        assert m.gauges[rank5] == 5
+        # window rolls over to a different gate, fleet shrinks to 2
+        for _ in range(4):
+            fs.observe_decide(fleet(3, 2), tenants=2)
+        assert m.gauges[share_a] == 0.0
+        assert m.gauges[rank5] == -1
+        assert m.gauges['crypto_trader_tpu_fleet_lane_pnl'
+                        '{extreme="best",rank="5"}'] == 0.0
+
+    def test_channel_family_rollup_rule(self):
+        from ai_crypto_trader_tpu.utils.metrics import channel_family
+
+        assert channel_family("trading_signals.t42") == "trading_signals.*"
+        assert channel_family("trading_signals") == "trading_signals"
+        assert channel_family("market_updates") == "market_updates"
+
+
+class TestLaneSampling:
+    def test_crc32_sample_is_stable_and_rate_bounded(self):
+        a = FleetScope(sample_rate=0.1)
+        b = FleetScope(sample_rate=0.1)
+        assert a.sample_lanes(2048) == b.sample_lanes(2048)
+        assert a.sample_lanes(2048) == [i for i in range(2048)
+                                        if lane_sampled(i, 0.1)]
+        frac = len(a.sample_lanes(2048)) / 2048
+        assert 0.05 < frac < 0.2          # ~10%, crc32-uniform-ish
+
+    def test_sampled_lane_membership_is_prefix_stable(self):
+        """Growing the fleet never changes which existing lanes are
+        sampled — `cli why --lane N` stays answerable across resizes."""
+        fs = FleetScope(sample_rate=0.2)
+        small = set(fs.sample_lanes(100))
+        fs2 = FleetScope(sample_rate=0.2)
+        large = set(fs2.sample_lanes(1000))
+        assert small == {i for i in large if i < 100}
+
+
+class TestLoadgenIntegration:
+    def _cfg(self, **kw):
+        from ai_crypto_trader_tpu.testing.loadgen import LoadConfig
+
+        base = dict(tenants=3, symbols=2, ticks=4, warmup_ticks=2,
+                    window=64, min_samples=2, seed=3, mode="vmapped")
+        base.update(kw)
+        return LoadConfig(**base)
+
+    def test_vetoes_ride_the_device_histogram(self, monkeypatch):
+        """Satellite: with fleetscope ON the loadgen rim never scans the
+        [N, S] table on host — decision_vetoes_total comes from the
+        device gate histogram (TenantEngine.veto_counts poisoned to
+        prove the path)."""
+        from ai_crypto_trader_tpu.testing.loadgen import run_load
+
+        def boom(self, out=None):
+            raise AssertionError("host [N,S] veto scan on the "
+                                 "fleetscope path")
+
+        monkeypatch.setattr(TenantEngine, "veto_counts", boom)
+        m = MetricsRegistry()
+        rep = run_load(self._cfg(), metrics=m)
+        assert rep["fleet"]["decides"] > 0
+        gates = {k for k in m.counters if "decision_vetoes_total" in k}
+        assert gates, "no veto counters exported"
+
+    def test_device_counts_equal_host_recompute(self):
+        """The device histogram's per-gate veto counts equal a NumPy
+        recompute from the engine's own decision table."""
+        from ai_crypto_trader_tpu.testing.loadgen import (
+            SyntheticTenantTraffic)
+
+        m = MetricsRegistry()
+        traffic = SyntheticTenantTraffic(self._cfg(), metrics=m)
+        with fleetscope.use(FleetScope(metrics=m)) as fs:
+            async def go():
+                for _ in range(4):
+                    await traffic.tick(timed=False)
+
+            asyncio.run(go())
+            eng = traffic.tenant_engine
+            assert fs.veto_counts(eng.last_fleet) == eng.veto_counts()
+
+    def test_sampled_provenance_end_to_end_with_execution(self, tmp_path):
+        """ACCEPTANCE: a sampled vmapped lane's decisions — vetoes AND a
+        real executable that flows through its lane executor — land as
+        FlightRecorder records queryable by lane, and `cli why --lane`
+        renders the gate/verdict from the persisted JSONL."""
+        from ai_crypto_trader_tpu.cli import main
+        from ai_crypto_trader_tpu.obs.flightrec import load_decisions
+        from ai_crypto_trader_tpu.testing.loadgen import run_load
+
+        path = str(tmp_path / "fleet_decisions.jsonl")
+        permissive = TradingParams(ai_confidence_threshold=0.2,
+                                   min_signal_strength=10.0)
+        m = MetricsRegistry()
+        with fleetscope.use(FleetScope(metrics=m, sample_rate=1.0)):
+            run_load(self._cfg(trading=permissive, flightrec_path=path),
+                     metrics=m)
+        records, stats = load_decisions(path)
+        assert not stats.get("corrupt_records")
+        by_lane = {r.get("lane") for r in records}
+        assert by_lane >= {0, 1, 2}
+        executed = [r for r in records if r.get("status") in
+                    ("executed", "closed")]
+        assert executed, "no sampled executable chained through its " \
+                         "lane executor"
+        assert executed[0]["exec"]["client_order_id"].startswith("ld")
+        assert all(r.get("verdict") for r in records)
+        # the operator surface resolves it (capsys-free: main prints)
+        sym = executed[0]["symbol"]
+        lane = executed[0]["lane"]
+        main(["why", sym, "--file", path, "--lane", str(lane),
+              "--last", "5"])
+
+    def test_off_path_measures_bare_engine(self):
+        """cfg.fleetscope=False: no scope is configured, no fleet block
+        in the report, vetoes fall back to the host scan — the bench
+        overhead probe's OFF arm."""
+        from ai_crypto_trader_tpu.testing.loadgen import run_load
+
+        m = MetricsRegistry()
+        rep = run_load(self._cfg(fleetscope=False), metrics=m)
+        assert "fleet" not in rep
+        assert not [k for k in m.gauges if "fleet_" in k]
+        assert fleetscope.active() is None
+
+
+class TestFleetAlerts:
+    def _scope_with_history(self, **kw):
+        fs = FleetScope(min_decides=2, min_vetoes=4, **kw)
+        return fs
+
+    def _fleet(self, hist, starved=0, decisions=None, pnl=(0.0, 0.0, 0.0),
+               balance=(1e4, 1e4, 1e4)):
+        hist = np.asarray(hist, np.int64)
+        return {"gate_hist": hist,
+                "decisions": (int(hist[1:].sum())
+                              if decisions is None else decisions),
+                "executable": int(hist[1]), "starved": starved,
+                "active": 8, "pnl_q": np.asarray(pnl, np.float64),
+                "balance_q": np.asarray(balance, np.float64),
+                "max_drawdown_max": 0.0,
+                "best_pnl": np.zeros(3), "best_lane": np.arange(3),
+                "worst_pnl": np.zeros(3), "worst_lane": np.arange(3)}
+
+    def test_gate_dominance_and_dispersion_fire_and_resolve(self):
+        fs = self._scope_with_history()
+        G = len(bin_names())
+        hist = np.zeros(G, np.int64)
+        hist[2] = 40                       # one gate, every veto
+        for _ in range(3):
+            fs.observe_decide(self._fleet(hist, pnl=(-400.0, 0.0, 400.0)),
+                              tenants=8)
+        state = fs.alert_state()
+        assert state["fleet_gate_dominance"] == 1.0
+        assert state["fleet_dominant_gate"] == bin_names()[2]
+        assert state["fleet_pnl_spread"] == 800.0
+        mgr = AlertManager(now_fn=lambda: 0.0)
+        fired = {a["name"] for a in mgr.evaluate(state)}
+        assert {"FleetGateDominance", "FleetPnLDispersionHigh"} <= fired
+        # a mixed window resolves dominance
+        mixed = np.zeros(G, np.int64)
+        mixed[2:6] = 10
+        for _ in range(64):
+            fs.observe_decide(self._fleet(mixed), tenants=8)
+        mgr.evaluate(fs.alert_state())
+        assert "FleetGateDominance" not in mgr.active
+        assert "FleetPnLDispersionHigh" not in mgr.active
+
+    def test_starvation_windowed_min_and_outage_guard(self):
+        fs = self._scope_with_history()
+        G = len(bin_names())
+        hist = np.zeros(G, np.int64)
+        hist[1] = 8
+        fs.observe_decide(self._fleet(hist, starved=2), tenants=8)
+        assert fs.starved_lanes() == 0     # min-sample gated
+        fs.observe_decide(self._fleet(hist, starved=3), tenants=8)
+        assert fs.starved_lanes() == 2     # windowed MIN
+        mgr = AlertManager(now_fn=lambda: 0.0)
+        assert "FleetLaneStarved" in {a["name"] for a in
+                                      mgr.evaluate(fs.alert_state())}
+        # a fleet-wide outage tick (zero decisions) must not count every
+        # lane starved
+        dead = np.zeros(G, np.int64)
+        fs2 = self._scope_with_history()
+        for _ in range(4):
+            fs2.observe_decide(self._fleet(dead, starved=8, decisions=0),
+                               tenants=8)
+        assert fs2.starved_lanes() == 0
+
+    def test_min_veto_gate_keeps_cold_fleet_silent(self):
+        fs = self._scope_with_history()
+        G = len(bin_names())
+        hist = np.zeros(G, np.int64)
+        hist[2] = 1                        # window total 2 < min_vetoes 4
+        fs.observe_decide(self._fleet(hist), tenants=8)
+        fs.observe_decide(self._fleet(hist), tenants=8)
+        assert fs.alert_state()["fleet_gate_dominance"] == 0.0
+
+
+class TestCoherence:
+    def emitted_series(self):
+        from test_observability import TestStackConfigCoherence
+
+        return TestStackConfigCoherence().emitted_series()
+
+    def test_fleet_series_emitted_and_promql_twins_resolve(self):
+        """The PR 1 coherence suite extended to the fleet series: the
+        four Fleet* alerts exist in monitoring/alert_rules.yml, every
+        fleet_* series they and the recording/Grafana rules reference is
+        one the code emits, and the in-process twins carry the same
+        names."""
+        import re
+
+        import yaml
+
+        emitted = self.emitted_series()
+        new_series = {"fleet_tenants", "fleet_active_lanes",
+                      "fleet_executable", "fleet_starved_lanes",
+                      "fleet_gate_dominance", "fleet_pnl_spread",
+                      "fleet_balance_drift_max", "fleet_gate_share",
+                      "fleet_pnl_quantile", "fleet_balance_quantile",
+                      "fleet_lane_pnl", "fleet_lane_id",
+                      "fleet_decides_total", "fleet_decisions_total",
+                      "fleet_max_drawdown"}
+        missing = new_series - emitted
+        assert not missing, f"fleet series not emitted: {missing}"
+
+        fleet_alerts = {"FleetGateDominance", "FleetPnLDispersionHigh",
+                        "FleetLaneStarved", "FleetBalanceDrift"}
+        rules = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/alert_rules.yml")))
+        alert_names = {r["alert"] for g in rules["groups"]
+                       for r in g["rules"] if "alert" in r}
+        assert fleet_alerts <= alert_names
+        for g in rules["groups"]:
+            for r in g["rules"]:
+                if r.get("alert") in fleet_alerts:
+                    for mm in re.finditer(
+                            r"crypto_trader_tpu_([a-z0-9_]+)", r["expr"]):
+                        assert mm.group(1) in emitted, mm.group(1)
+        assert fleet_alerts <= {r.name for r in default_rules()}
+        rec = yaml.safe_load(
+            open(os.path.join(REPO, "monitoring/recording_rules.yml")))
+        fleet_groups = [g for g in rec["groups"]
+                        if g["name"] == "crypto_trader_tpu_fleet"]
+        assert fleet_groups and fleet_groups[0]["rules"]
+        for r in fleet_groups[0]["rules"]:
+            for mm in re.finditer(
+                    r"crypto_trader_tpu_([a-z0-9_]+?)(?![a-z0-9_:])",
+                    r["expr"]):
+                assert mm.group(1) in emitted, (r["record"], mm.group(1))
+
+    def test_grafana_fleet_row_queries_emitted_series(self):
+        import json as json_mod
+        import re
+
+        dash = json_mod.load(open(os.path.join(
+            REPO, "monitoring/grafana/provisioning/dashboards/"
+                  "system_overview.json")))
+        titles = [p["title"] for p in dash["panels"]]
+        assert any("Fleet" in t for t in titles)
+        emitted = self.emitted_series()
+        fleet_panels = [p for p in dash["panels"]
+                        if "fleet" in str(p.get("targets", "")).lower()]
+        assert len(fleet_panels) >= 3
+        for p in fleet_panels:
+            for t in p["targets"]:
+                for mm in re.finditer(
+                        r"crypto_trader_tpu_([a-z0-9_]+?)"
+                        r"(?:_bucket|_sum|_count)?[\{\[\)\s,]",
+                        t["expr"] + " "):
+                    assert mm.group(1) in emitted, (p["title"],
+                                                    mm.group(1))
+
+    def test_alert_state_reaches_launcher_rules(self):
+        """A launcher with enable_fleetscope folds a deciding fleet's
+        alert inputs into its rule evaluation (both-engines contract at
+        the integration seam)."""
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        series = from_dict(generate_ohlcv(n=700, seed=5),
+                           symbol="BTCUSDC")
+        ex = FakeExchange({"BTCUSDC": series})
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: 1000.0,
+                               enable_fleetscope=True)
+        try:
+            assert fleetscope.active() is system.fleetscope
+            # a vmapped engine deciding IN this process feeds the scope
+            eng = TenantEngine(SYMS, 4)
+            G = len(bin_names())
+            hist = np.zeros(G, np.int64)
+            hist[2] = 80
+            for _ in range(12):
+                system.fleetscope.observe_decide(
+                    {"gate_hist": hist, "decisions": 80, "executable": 0,
+                     "starved": 1, "active": 4,
+                     "pnl_q": np.zeros(3), "balance_q": np.zeros(3),
+                     "max_drawdown_max": 0.0,
+                     "best_pnl": np.zeros(1), "best_lane": np.zeros(1),
+                     "worst_pnl": np.zeros(1),
+                     "worst_lane": np.zeros(1)}, tenants=4)
+            state = system._alert_state()
+            assert state["fleet_gate_dominance"] == 1.0
+            assert state["fleet_starved_lanes"] == 1
+            fired = {a["name"] for a in
+                     system.alerts.evaluate(state)}
+            assert {"FleetGateDominance", "FleetLaneStarved"} <= fired
+            del eng
+        finally:
+            system.shutdown()
+        assert fleetscope.active() is None
