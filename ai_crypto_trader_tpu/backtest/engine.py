@@ -46,6 +46,7 @@ from jax import lax
 
 from ai_crypto_trader_tpu.backtest import signals as sig
 from ai_crypto_trader_tpu.backtest.strategy import StrategyParams
+from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.utils import devprof, meshprof, tracing
 
 
@@ -466,5 +467,6 @@ _SWEEP_SHAPES_SEEN: set = set()
 
 
 def _watched(card: str, cold: bool, call):
-    with meshprof.watch(card, cold=cold):
+    with tickpath.coldstart(card, cold=cold), \
+            meshprof.watch(card, cold=cold):
         return call()

@@ -644,6 +644,109 @@ def cmd_fleet(args):
     _render_fleet(rep.get("fleet") or {})
 
 
+def _render_latency(tickpath_block: dict, coldstart_block: dict,
+                    build_block: dict | None = None) -> None:
+    """Operator rendering of the decision critical-path observatory
+    (obs/tickpath.py): the per-phase waterfall table, bottleneck + overlap
+    headroom headline, the event→decision SLO line, and the per-program
+    cold-start ledger."""
+    if not tickpath_block:
+        print("no tickpath block — is the decision critical-path "
+              "observatory enabled? (it is on by default; "
+              "TradingSystem(enable_tickpath=False) turns it off)")
+        return
+    phases = tickpath_block.get("phases") or {}
+    bottleneck = tickpath_block.get("bottleneck")
+    print("decision critical path (per-phase waterfall, ms):")
+    print(f"  {'phase':<16}{'count':>7}{'p50':>10}{'p99':>10}{'last':>10}")
+    for name, row in phases.items():
+        if not row.get("count"):
+            continue
+        mark = "  ◀ bottleneck" if name == bottleneck else ""
+        print(f"  {name:<16}{row['count']:>7}{row['p50_ms']:>10.2f}"
+              f"{row['p99_ms']:>10.2f}{row['last_ms']:>10.2f}{mark}")
+    if not any(row.get("count") for row in phases.values()):
+        print("  (no phases observed yet)")
+    overlap = tickpath_block.get("overlap_headroom_ms") or {}
+    if overlap.get("p50") is not None:
+        print(f"\noverlap headroom (dispatch→ready host-idle wait "
+              f"pipelining can reclaim): p50 {overlap['p50']:.2f} ms, "
+              f"p99 {overlap.get('p99', 0.0):.2f} ms")
+    age = tickpath_block.get("event_age_ms") or {}
+    if age.get("count"):
+        print(f"event→decision age: p50 {age.get('p50', 0.0):.0f} ms, "
+              f"p99 {age.get('p99', 0.0):.0f} ms over {age['count']} "
+              f"decisions (budget {age.get('budget_ms', 0.0):.0f} ms)")
+    skew = tickpath_block.get("clock_skew_total", 0)
+    if skew:
+        print(f"clock-skew clamps (venue event ahead of host clock): {skew}")
+    programs = (coldstart_block or {}).get("programs") or {}
+    if programs:
+        print("\ncold-start ledger (first-compile cost per program):")
+        print(f"  {'program':<24}{'wall_ms':>10}{'compile_ms':>12}"
+              f"{'compiles':>10}")
+        for name, row in sorted(programs.items(),
+                                key=lambda kv: -kv[1]["wall_ms"]):
+            print(f"  {name:<24}{row['wall_ms']:>10.1f}"
+                  f"{row['compile_ms']:>12.1f}{row['compiles']:>10}")
+        print(f"  total: wall {coldstart_block.get('total_wall_ms', 0.0):,.1f}"
+              f" ms (compile "
+              f"{coldstart_block.get('total_compile_ms', 0.0):,.1f} ms)")
+    if build_block:
+        print(f"\nbuild: jax {build_block.get('jax_version')} on "
+              f"{build_block.get('backend')} "
+              f"({build_block.get('device_kind')}), process start "
+              f"{build_block.get('process_start')}")
+
+
+def cmd_latency(args):
+    """Decision critical-path operator view (obs/tickpath.py): WHERE each
+    tick's time goes (phase waterfall), the overlap headroom pipelining
+    could reclaim, the event→decision age SLO reading, and the cold-start
+    ledger (first-compile cost per hot program).  With `--url`, reads a
+    LIVE system's /state.json tickpath/coldstart blocks (no jax import);
+    without it, drives a short local paper burst so the view is
+    demonstrable on any dev host."""
+    if args.url:
+        state = _fetch_state(args.url)
+        _render_latency(state.get("tickpath") or {},
+                        state.get("coldstart") or {},
+                        state.get("build"))
+        return
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.exchange import make_exchange
+    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+    d = generate_ohlcv(n=args.ticks + 600, seed=args.seed)
+    series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                       symbol=args.symbol)
+    # virtual clock aligned to the synthetic candle open-times (i*60_000
+    # epoch-ms), so the demo's event→decision ages read as a real feed's
+    # would instead of clamping to zero or blowing past the budget
+    clock = {"t": 600 * 60.0}
+    ex = make_exchange("fake", series={args.symbol: series},
+                       quote_balance=10_000.0)
+    ex.advance(args.symbol, steps=600)
+    system = TradingSystem(ex, [args.symbol], now_fn=lambda: clock["t"])
+
+    async def go():
+        for _ in range(args.ticks):
+            ex.advance(args.symbol)
+            clock["t"] += 60.0
+            await system.tick()
+
+    try:
+        asyncio.run(go())
+        print(f"(local demo: {args.ticks} paper ticks on {args.symbol}; "
+              f"point --url at a running `trade --serve` for live state)\n")
+        _render_latency(system.tickpath.status(),
+                        system.tickpath.coldstart_status(),
+                        system.build_info)
+    finally:
+        system.shutdown()
+
+
 def cmd_status(args):
     """Operator status without a REPL (ISSUE 12 satellite): queries a live
     dashboard server's `/state.json` and prints a compact summary — the
@@ -679,6 +782,15 @@ def cmd_status(args):
     if dev:
         out["slo_burn_rates"] = dev.get("burn_rates")
         out["donation_failures"] = dev.get("donation_failures")
+    # process provenance (shell/launcher.py build_info): which jax /
+    # backend / device produced every number above — the first question
+    # when two operators compare readings from different hosts
+    if "build" in state:
+        out["build"] = state["build"]
+    tp = state.get("tickpath")
+    if tp:
+        out["tickpath_bottleneck"] = tp.get("bottleneck")
+        out["event_age_p99_ms"] = (tp.get("event_age_ms") or {}).get("p99")
     print(json.dumps(out, indent=2, default=str))
 
 
@@ -891,6 +1003,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ticks", type=int, default=6)
     sp.add_argument("--seed", type=int, default=0)
     sp.set_defaults(fn=cmd_fleet)
+    sp = sub.add_parser("latency", help="decision critical-path view: "
+                                        "tick-phase waterfall, overlap "
+                                        "headroom, cold-start ledger "
+                                        "(obs/tickpath.py)")
+    sp.add_argument("--url", default=None,
+                    help="read a live system's /state.json tickpath/"
+                         "coldstart blocks instead of running a local "
+                         "demo burst")
+    sp.add_argument("--symbol", default="BTCUSDC")
+    sp.add_argument("--ticks", type=int, default=12,
+                    help="local demo burst length (no --url)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.set_defaults(fn=cmd_latency)
     sp = sub.add_parser("status", help="operator summary from a live "
                                        "dashboard server (/state.json)")
     sp.add_argument("--url", default=None,
@@ -910,7 +1035,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _JAX_COMMANDS = {"backtest", "train", "evolve", "mc", "trade", "dashboard",
-                 "scan", "profile", "load", "mesh", "fleet"}
+                 "scan", "profile", "load", "mesh", "fleet", "latency"}
 
 
 def main(argv=None):

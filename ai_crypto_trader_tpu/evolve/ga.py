@@ -53,6 +53,7 @@ from ai_crypto_trader_tpu.parallel.partitioner import (
     Partitioner,
     SingleDevicePartitioner,
 )
+from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.utils import devprof, meshprof
 
 # Shared by every run_ga call that doesn't name a partitioner, so the
@@ -284,7 +285,8 @@ def run_ga(key, fitness_fn: Callable, cfg: GAParams,
     # meshprof watch (utils/meshprof.py): compile attribution + transfer
     # guard from dispatch through the one sanctioned host_read — the
     # zero-recompile/one-sync contract as a live production invariant
-    with meshprof.watch("ga_scan", cold=cold):
+    with tickpath.coldstart("ga_scan", cold=cold), \
+            meshprof.watch("ga_scan", cold=cold):
         out = program(genomes, key)
         if prof is not None:
             devprof.verify_donation("ga_scan", donated)

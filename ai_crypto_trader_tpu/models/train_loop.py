@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.utils import devprof, meshprof
 
 _PRECISIONS = {
@@ -192,7 +193,8 @@ class EpochTrainer:
                 cold = shape_key not in self._watched_shapes
                 self._watched_shapes.add(shape_key)
             t0 = time.perf_counter()
-            with meshprof.watch(self.card, cold=cold):
+            with tickpath.coldstart(self.card, cold=cold), \
+                    meshprof.watch(self.card, cold=cold):
                 out = self._epoch(*args, batch_size=batch_size)
         if dp is not None:
             nb = max(X.shape[0] // min(batch_size, X.shape[0]), 1)

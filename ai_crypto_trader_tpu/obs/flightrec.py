@@ -135,6 +135,7 @@ class FlightRecorder:
             "predictions": {},
             "verdict": None,
             "explanation": None,
+            "event_age_ms": None,
             "gate": None,
             "gate_detail": None,
             "status": "open",
@@ -147,15 +148,19 @@ class FlightRecorder:
               predictions: dict | None = None,
               verdict: dict | None = None,
               explanation: dict | None = None,
-              lane: int | None = None) -> str:
+              lane: int | None = None,
+              event_age_ms: float | None = None) -> str:
         """Open a decision record; returns its id (the analyzer stamps it
         onto the published signal as ``decision_id`` so the executor can
-        finalize the same record)."""
+        finalize the same record).  ``event_age_ms`` is the venue-E →
+        decision age the tickpath observatory clamped/folded
+        (obs/tickpath.py) — None when that observatory is off."""
         rec = self._blank(symbol, trace_fallback=True, lane=lane)
         rec["features"] = features or {}
         rec["predictions"] = predictions or {}
         rec["verdict"] = verdict
         rec["explanation"] = explanation
+        rec["event_age_ms"] = event_age_ms
         with self._lock:
             self._append(rec)
         self.recorded += 1

@@ -57,7 +57,7 @@ import numpy as np
 from jax import lax
 
 from ai_crypto_trader_tpu.backtest.signals import position_size
-from ai_crypto_trader_tpu.obs import fleetscope
+from ai_crypto_trader_tpu.obs import fleetscope, tickpath
 from ai_crypto_trader_tpu.obs.flightrec import GATES, VETO_ORDER
 from ai_crypto_trader_tpu.utils import devprof, meshprof
 
@@ -549,15 +549,29 @@ class TenantEngine:
         # buffers free without aliasing — must not page the verifier
         donated = (jax.tree.leaves(self._pop)
                    if carding and self.n_pad % n_dev == 0 else None)
+        # tickpath seams (obs/tickpath.py): the dispatch /
+        # device_compute split rides one sentinel-leaf readiness wait —
+        # not a transfer, not a second host_read (the tick-engine
+        # discipline); the cold-start ledger window wraps the cold
+        # dispatch's first compile.
+        tp = tickpath.active()
         try:
-            with meshprof.watch("tenant_engine", cold=self._cold):
+            with tickpath.coldstart("tenant_engine", cold=self._cold), \
+                    meshprof.watch("tenant_engine", cold=self._cold):
+                t_d0 = time.perf_counter()
                 res = program(self._pop, feats_dev)
+                t_d1 = time.perf_counter()
                 if donated is not None:
                     devprof.verify_donation("tenant_engine", donated)
                 self._pop = res["carry"]
                 self.dispatch_count += 1
                 self._cold = False
                 self._need_seed = False
+                if tp is not None:
+                    t_w0 = time.perf_counter()
+                    jax.block_until_ready(
+                        jax.tree_util.tree_leaves(res["out"])[0])
+                    t_ready = time.perf_counter()
                 t_hr = time.perf_counter()
                 tree = {"out": res["out"], "state": res["carry"]["state"]}
                 if fs is not None:
@@ -606,6 +620,19 @@ class TenantEngine:
             "host_read_s": host_read_s,
             "step_s": time.perf_counter() - t_step0,
         }
+        if tp is not None:
+            dispatch_s = t_d1 - t_d0
+            device_compute_s = t_ready - t_d1
+            overlap_headroom_s = t_ready - t_w0
+            self.last_stats.update({
+                "dispatch_s": dispatch_s,
+                "device_compute_s": device_compute_s,
+                "overlap_headroom_s": overlap_headroom_s,
+            })
+            tp.observe_phase("dispatch", dispatch_s)
+            tp.observe_phase("device_compute", device_compute_s)
+            tp.observe_phase("host_read", host_read_s)
+            tp.observe_overlap(overlap_headroom_s)
         return self.last_out
 
     # -- views ---------------------------------------------------------------

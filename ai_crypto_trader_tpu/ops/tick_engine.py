@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.utils import devprof, meshprof
 from ai_crypto_trader_tpu.backtest import compute_signal_features, reference_signal
 from ai_crypto_trader_tpu.obs.drift import DRIFT_FEATURES, N_BINS, PSI_EPS
@@ -231,6 +232,11 @@ class TickEngine:
         self.last_valid = np.zeros((S, F), bool)
         self.last_stats: dict = {}
         self.last_out: dict | None = None   # newest host output pytree
+        # newest venue event time (ms) per symbol: candle open times from
+        # the ingest paths, upgraded to the exchange's event-time E by the
+        # stream (note_event_ms) — the event_age_ms source the monitor
+        # stamps onto published updates (obs/tickpath.py)
+        self.last_event_ms: dict[str, float] = {}
 
     # -- ingest ---------------------------------------------------------------
     def _seed_slot(self, s: int, f: int, ts: np.ndarray, arr: np.ndarray):
@@ -244,6 +250,13 @@ class TickEngine:
         # queued incremental writes for this slot are superseded
         self._pending = {k: v for k, v in self._pending.items()
                          if not (k[0] == s and k[1] == f)}
+
+    def note_event_ms(self, symbol: str, event_ms: float) -> None:
+        """Record a fresher venue event time for ``symbol`` (monotone max:
+        candle open times are a lower bound, the stream's exchange E the
+        true value)."""
+        if event_ms > self.last_event_ms.get(symbol, 0.0):
+            self.last_event_ms[symbol] = float(event_ms)
 
     # -- drift reference ------------------------------------------------------
     def set_drift_reference(self, symbol: str, interval: str,
@@ -271,6 +284,7 @@ class TickEngine:
         f = self.iv_index.get(interval)
         if s is None or f is None:
             return False
+        self.note_event_ms(symbol, float(row[0]))
         T = self.window
         if self._count[s, f] < T:
             return False                       # warming: needs a full seed
@@ -304,6 +318,8 @@ class TickEngine:
         queue only the new/changed rows for the next step()."""
         s = self.sym_index[symbol]
         f = self.iv_index[interval]
+        if klines:
+            self.note_event_ms(symbol, float(klines[-1][0]))
         T = self.window
         rows = klines[-T:]
         if len(rows) < T:
@@ -391,16 +407,37 @@ class TickEngine:
         # design), and the sentinel's window count is global across
         # instances — within one engine the array shapes are fixed, so any
         # later compile is genuinely unexpected.
+        # tickpath phase seams (obs/tickpath.py; disabled = one module
+        # check): the scatter-build / dispatch / device_compute /
+        # host_read decomposition rides the existing perf_counter stamps
+        # and ONE sentinel-leaf readiness wait — a wait, not a transfer
+        # (the meshprof guard stays armed) and not a second host_read
+        # (the one-sync contract test keeps counting 1).  The wait is
+        # time host_read would have blocked anyway, re-attributed from
+        # the transfer to the compute it actually was.
+        tp = tickpath.active()
         try:
-            with meshprof.watch("tick_engine", cold=self.dispatch_count == 0):
+            with tickpath.coldstart("tick_engine",
+                                    cold=self.dispatch_count == 0), \
+                    meshprof.watch("tick_engine",
+                                   cold=self.dispatch_count == 0):
+                t_d0 = time.perf_counter()
                 self._ring, out = _tick_program(self._ring, self._base,
                                                 rows, s_ix, f_ix, pos,
                                                 valid, self._drift_ref)
+                t_d1 = time.perf_counter()
                 if donated_ring is not None:
                     devprof.verify_donation("tick_engine", donated_ring)
                 self.dispatch_count += 1
                 self._need_seed = False
                 self.last_valid = valid
+                if tp is not None:
+                    # host-idle window between dispatch-return and
+                    # readback-start: the overlap headroom item-4
+                    # pipelining can fill with host work
+                    t_w0 = time.perf_counter()
+                    jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+                    t_ready = time.perf_counter()
                 t_hr = time.perf_counter()
                 host = host_read(out)
                 host_read_s = time.perf_counter() - t_hr
@@ -441,4 +478,20 @@ class TickEngine:
             "scatter_capacity": int(W), "host_read_s": host_read_s,
             "step_s": time.perf_counter() - t_step0,
         }
+        if tp is not None:
+            scatter_build_s = t_d0 - t_step0
+            dispatch_s = t_d1 - t_d0
+            device_compute_s = t_ready - t_d1
+            overlap_headroom_s = t_ready - t_w0
+            self.last_stats.update({
+                "scatter_build_s": scatter_build_s,
+                "dispatch_s": dispatch_s,
+                "device_compute_s": device_compute_s,
+                "overlap_headroom_s": overlap_headroom_s,
+            })
+            tp.observe_phase("scatter_build", scatter_build_s)
+            tp.observe_phase("dispatch", dispatch_s)
+            tp.observe_phase("device_compute", device_compute_s)
+            tp.observe_phase("host_read", host_read_s)
+            tp.observe_overlap(overlap_headroom_s)
         return host

@@ -12,6 +12,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.shell.bus import EventBus
 from ai_crypto_trader_tpu.shell.llm import LLMTrader
 from ai_crypto_trader_tpu.utils import tracing
@@ -113,10 +114,21 @@ class SignalAnalyzer:
                 fr.throttled(symbol)
             return None
         self._last_analysis[symbol] = now
+        # event→decision age (obs/tickpath.py): venue event time E (the
+        # monitor stamps `event_ms` onto the update) → this decision.
+        # The scope clamps a negative age (host clock behind the venue)
+        # to 0 and counts tickpath_clock_skew_total; None when the
+        # observatory is off (the field then stays unset on the record).
+        event_age_ms = None
+        ev_ms = update.get("event_ms")
+        if ev_ms:
+            event_age_ms = tickpath.observe_event_age(
+                now * 1000.0 - float(ev_ms))
         if fr is not None:
             rec_id = fr.begin(symbol,
                               features=self._decision_features(update),
-                              predictions=self._prediction_snapshot(symbol))
+                              predictions=self._prediction_snapshot(symbol),
+                              event_age_ms=event_age_ms)
 
         ctx = self._build_context(update)
         analysis = await self.trader.analyze_trade_opportunity(ctx)

@@ -301,6 +301,19 @@ class DashboardServer:
             # JSON regardless of tenant count (`cli fleet --url` reads
             # this block)
             out["fleet"] = fleet.status()
+        tickpath = getattr(system, "tickpath", None)
+        if tickpath is not None:
+            # decision critical-path observatory (obs/tickpath.py): per-tick
+            # phase waterfall, overlap headroom, event→decision age, and the
+            # per-program cold-start ledger (`cli latency --url` reads these
+            # two blocks)
+            out["tickpath"] = tickpath.status()
+            out["coldstart"] = tickpath.coldstart_status()
+        build = getattr(system, "build_info", None)
+        if build is not None:
+            # process provenance: start time, jax version, backend, device
+            # kind — pins *what* produced every number above (`cli status`)
+            out["build"] = dict(build)
         scorecard = getattr(system, "scorecard", None)
         if scorecard is not None:
             sc = scorecard.status()

@@ -96,6 +96,16 @@ class TradingSystem:
     # scorecard and PnL attribution ride the same flag.
     enable_flightrec: bool = True
     flightrec_path: str | None = None
+    # Decision critical-path observatory (obs/tickpath.py). DEFAULT-ON
+    # like the flight recorder: the per-tick phase waterfall (frame_wait →
+    # parse → scatter_build → dispatch → device_compute → host_read →
+    # publish → analyzer → executor), the named bottleneck phase, overlap
+    # headroom, the event→decision age SLO behind
+    # DecisionLatencyBudgetBreach, and the cold-start compile ledger —
+    # the measurement substrate for the ROADMAP item-4 pipelining work.
+    # Measured fused-tick overhead is budgeted ≤5% (stamped by the bench
+    # stream_latency row); the disabled path is one module-global check.
+    enable_tickpath: bool = True
     # Stage supervision (utils/supervision.py): a non-ExchangeUnavailable
     # exception inside monitor/analyzer/executor is isolated with
     # exponential backoff; N consecutive failures quarantine the stage
@@ -168,6 +178,27 @@ class TradingSystem:
 
             self.fleetscope = fleet_mod.configure(
                 fleet_mod.FleetScope(metrics=self.metrics))
+        self.tickpath = None
+        if self.enable_tickpath:
+            from ai_crypto_trader_tpu.obs import tickpath as tickpath_mod
+
+            self.tickpath = tickpath_mod.configure(
+                tickpath_mod.TickPathScope(metrics=self.metrics))
+        # build provenance (/state.json `build`, `cli status`): which
+        # runtime produced the numbers an operator is reading.  jax is
+        # queried lazily and failure-tolerantly — the launcher itself
+        # must construct on a host where device init is deferred.
+        self.build_info = {"process_start": self.now_fn(),
+                           "jax_version": None, "backend": None,
+                           "device_kind": None}
+        try:
+            import jax
+
+            self.build_info["jax_version"] = jax.__version__
+            self.build_info["backend"] = jax.default_backend()
+            self.build_info["device_kind"] = jax.devices()[0].device_kind
+        except Exception:                  # noqa: BLE001 — provenance is
+            pass                           # best-effort, never fatal
         # bus telemetry: fanout latency + queue depth metrics, and slow-
         # subscriber warnings through the structured log (trace-correlated)
         self.bus = EventBus(now_fn=self.now_fn, metrics=self.metrics,
@@ -371,6 +402,11 @@ class TradingSystem:
             if self.saturation is not None:
                 self.saturation.observe_stage(name,
                                               time.perf_counter() - t0)
+            if self.tickpath is not None and name in ("analyzer",
+                                                      "executor"):
+                # the waterfall's downstream phases: analyzer/executor
+                # drains ride the same stage timing saturation charges
+                self.tickpath.observe_phase(name, time.perf_counter() - t0)
         if br.record_success(self.now_fn()):
             self.log.info("stage recovered from crash loop", stage=name)
             await self.bus.publish("alerts", {
@@ -640,6 +676,12 @@ class TradingSystem:
             # (reusing devprof's sample when it ran this tick — one
             # jax.live_arrays() walk, not two) + byte-split refresh
             self.meshprof.export(memory=mem_sample)
+        if self.tickpath is not None:
+            # decision critical-path export: per-phase p50/p99, the named
+            # bottleneck, overlap headroom, event-age SLO and cold-start
+            # totals — on BOTH tick paths, so the waterfall stays live
+            # through outages too
+            self.tickpath.export()
         self.metrics.set_gauge("last_market_update_timestamp",
                                self._last_market_update)
         self.metrics.set_gauge("max_positions",
@@ -732,6 +774,11 @@ class TradingSystem:
             # capacity observatory inputs: saturating stages (windowed,
             # min-sample gated), backpressured bus channels, loop lag
             state.update(self.saturation.alert_state())
+        if self.tickpath is not None:
+            # decision critical-path inputs: event→decision p99 vs budget
+            # (DecisionLatencyBudgetBreach) with the bottleneck phase the
+            # alert payload names
+            state.update(self.tickpath.alert_state())
         if self.fleetscope is not None and self.fleetscope.decides:
             # fleet observatory inputs: gate dominance, PnL dispersion,
             # lane starvation and balance drift off the vmapped tenant
@@ -850,6 +897,11 @@ class TradingSystem:
 
             if fleet_mod.active() is self.fleetscope:
                 fleet_mod.disable()
+        if self.tickpath is not None:
+            from ai_crypto_trader_tpu.obs import tickpath as tickpath_mod
+
+            if tickpath_mod.active() is self.tickpath:
+                tickpath_mod.disable()
         if self.journal is not None:
             self.journal.close()           # flush the buffered tail
         if self.flightrec is not None:

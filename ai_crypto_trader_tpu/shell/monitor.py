@@ -35,6 +35,7 @@ from ai_crypto_trader_tpu.ops.combinations import (
     combination_signal,
     combined_indicators,
 )
+from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.ops.tick_engine import TickEngine
 from ai_crypto_trader_tpu.ops.volume_profile import volume_profile
 from ai_crypto_trader_tpu.shell.bus import EventBus
@@ -321,6 +322,7 @@ class MarketMonitor:
         # still compute and publish this poll, and the exception re-raises
         # after the batch so the launcher's skip-and-alert path still fires.
         fetch_error: Exception | None = None
+        t_parse0 = time.perf_counter()
         for symbol in due:
             # unlike the per-symbol path's primary-only fetch span, this one
             # covers ALL the symbol's frames + ring ingest (hence "frames",
@@ -351,6 +353,9 @@ class MarketMonitor:
                     fetch_error = e
                     fetched[(symbol, iv0)] = None   # this symbol: no publish
                     break
+        # parse/backfill phase (obs/tickpath.py): the whole fetch + ingest
+        # diffing window for the batch — one fold per poll, one module check
+        tickpath.observe_phase("parse", time.perf_counter() - t_parse0)
         ready = [s for s in due
                  if len(fetched.get((s, iv0)) or []) >= self.kline_limit]
         if not ready:
@@ -369,6 +374,7 @@ class MarketMonitor:
         self._expose_drift(eng, due)
         blend_iv = self._blend_iv()
         published = 0
+        t_pub0 = time.perf_counter()
         for symbol in due:
             kl = fetched.get((symbol, iv0))
             if not kl:
@@ -407,10 +413,20 @@ class MarketMonitor:
                     update[f"macd_{iv}"] = float(out["macd"][s, f])
                 update["symbol"] = symbol
                 update["timestamp"] = now
+                # venue event time (ms) for the event→decision age SLO:
+                # the engine's newest candle/stream event time — the
+                # analyzer stamps event_age_ms onto the flight-recorder
+                # record from this field (obs/tickpath.py)
+                ev_ms = eng.last_event_ms.get(symbol)
+                if ev_ms is not None:
+                    update["event_ms"] = ev_ms
                 self.bus.set(f"market_data_{symbol}", update)
                 await self.bus.publish("market_updates", update)
                 self._last_pub[symbol] = now
                 published += 1
+        # publish/fan-out phase: per-symbol feature extraction + bus set
+        # + market_updates publish for the whole batch
+        tickpath.observe_phase("publish", time.perf_counter() - t_pub0)
         if fetch_error is not None:
             raise fetch_error
         return published
@@ -472,6 +488,10 @@ class MarketMonitor:
             update.update(self._structure_view(combo_last))
         self.bus.set(f"historical_data_{symbol}_{self.intervals[0]}",
                      klines[-self.kline_limit:])
+        # venue event time: newest candle open across every fetched frame
+        # — the same monotone-max rule the fused engine's ingest applies
+        # (note_event_ms), so the parity tests pin both paths' payloads
+        ev_ms = float(klines[-1][0]) if klines else 0.0
         # The 0.6/0.4 trend blend pairs the primary frame with 5m
         # specifically (`market_monitor_service.py:273` strength_1m*0.6
         # + strength_5m*0.4); other frames contribute their per-interval
@@ -484,6 +504,7 @@ class MarketMonitor:
             res = res[-self.kline_limit:]
             self.bus.set(f"historical_data_{symbol}_{iv}", res)
             self._note_warmup(symbol, iv, len(res))
+            ev_ms = max(ev_ms, float(res[-1][0]))
             sec = self._features_from_klines(res)
             if sec is not None:
                 if iv == blend_iv:
@@ -495,6 +516,8 @@ class MarketMonitor:
                 update[f"macd_{iv}"] = sec["macd"]
         update["symbol"] = symbol
         update["timestamp"] = now
+        if ev_ms > 0.0:
+            update["event_ms"] = ev_ms
         self.bus.set(f"market_data_{symbol}", update)
         await self.bus.publish("market_updates", update)
         self._last_pub[symbol] = now

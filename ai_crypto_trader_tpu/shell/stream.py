@@ -50,6 +50,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Callable
 
+from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.utils import tracing
 
 BINANCE_WS = "wss://stream.binance.com:9443/ws/!miniTicker@arr"
@@ -489,6 +490,10 @@ class MarketStream:
             event_ms = t.get("E")
             self._set_ticker(symbol, price, quote_vol, now,
                              int(event_ms) if event_ms else None)
+            if event_ms and tickpath.active() is not None:
+                eng = getattr(self.monitor, "_engine", None)
+                if eng is not None:
+                    eng.note_event_ms(symbol, float(event_ms))
             if self._mark_dirty(symbol, now):
                 marked.append(symbol)
         return marked
@@ -518,6 +523,18 @@ class MarketStream:
         event_ms = d.get("E")
         self._set_ticker(symbol, float(k["c"]), quote_vol, now,
                          int(event_ms) if event_ms else None)
+        if event_ms:
+            # frame_wait phase (obs/tickpath.py): venue event time E →
+            # host receive, the feed-transit leg of the decision path.
+            # A host clock behind the venue reads negative — the scope
+            # clamps to 0 and counts tickpath_clock_skew_total.
+            tickpath.observe_phase("frame_wait", now - int(event_ms) / 1000.0)
+            eng = (getattr(self.monitor, "_engine", None)
+                   if tickpath.active() is not None else None)
+            if eng is not None:
+                # upgrade the engine's candle-open event time to the
+                # exchange's true E for the event→decision age SLO
+                eng.note_event_ms(symbol, float(event_ms))
         if not in_universe or interval not in self.monitor.intervals:
             self.frames_ignored += 1             # ticker only; no book lane
             return []

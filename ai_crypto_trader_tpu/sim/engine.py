@@ -42,6 +42,7 @@ import numpy as np
 
 from ai_crypto_trader_tpu.sim import exchange as sx
 from ai_crypto_trader_tpu.sim import paths, scenarios
+from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.utils import devprof, meshprof
 
 # (scenarios, steps, log_capacity) shapes already dispatched once — the
@@ -353,7 +354,8 @@ def sweep(key, scenario="mixed", num_scenarios: int = 4096,
         cold = shape_key not in _SWEEP_SHAPES_SEEN
         _SWEEP_SHAPES_SEEN.add(shape_key)
     t0 = time.perf_counter()
-    with meshprof.watch("sim_sweep", cold=cold):
+    with tickpath.coldstart("sim_sweep", cold=cold), \
+            meshprof.watch("sim_sweep", cold=cold):
         out = _sweep_jit(key, sched_dev, strat, fp, pp, quote0,
                          log_capacity=log_capacity)
         if donated is not None:

@@ -82,6 +82,7 @@ from ai_crypto_trader_tpu.sim.engine import (
     _strategy_step,
     default_strategy,
 )
+from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.utils import devprof, meshprof
 
 DEFAULT_LEVELS = 32
@@ -573,7 +574,8 @@ def lob_sweep(key, scenario="mixed", num_scenarios: int = 1024,
         cold = shape_key not in _LOB_SHAPES_SEEN
         _LOB_SHAPES_SEEN.add(shape_key)
     t0 = time.perf_counter()
-    with meshprof.watch("lob_sweep", cold=cold):
+    with tickpath.coldstart("lob_sweep", cold=cold), \
+            meshprof.watch("lob_sweep", cold=cold):
         out = program(pop, key, flow, strat, fee, quote0)
         if donated is not None:
             devprof.verify_donation("lob_sweep", donated)

@@ -159,6 +159,21 @@ def default_rules() -> list[AlertRule]:
         AlertRule("ModelAccuracyDegraded", "warning",
                   lambda s: s.get("model_accuracy_worst", 1.0) < 0.45,
                   "a model's live directional accuracy fell below 0.45"),
+        # --- decision critical-path observatory (obs/tickpath.py) ---
+        # event→decision age is windowed AND min-sample gated at the
+        # source (TickPathScope.alert_state reports p99 = 0 below
+        # min_samples), so one cold tick or a restart can never page;
+        # the budget rides the state so the rule evaluates the scope's
+        # configuration.  The scope also names the bottleneck phase
+        # (`tickpath_bottleneck_phase`) so the payload tells the operator
+        # WHERE the budget went, not just that it is gone; the PromQL
+        # twin rides latency_p99_seconds{slo="event_to_decision"}.
+        AlertRule("DecisionLatencyBudgetBreach", "warning",
+                  lambda s: (s.get("event_age_p99_ms", 0.0)
+                             > s.get("event_age_budget_ms", 2000.0)),
+                  "p99 venue-event→decision age breached the latency "
+                  "budget — check tickpath_bottleneck_phase for the "
+                  "phase that is eating it"),
         # --- fleet observatory (obs/fleetscope.py) ---
         # all four read device-aggregated inputs off the vmapped tenant
         # engine's own dispatch (FleetScope.alert_state); thresholds ride

@@ -176,7 +176,8 @@ def append_history(rows: list, path: str | None = None,
               "BENCH_LOAD_SYMBOLS", "BENCH_LOAD_TICKS",
               "BENCH_LOAD_SLO_MS",
               "BENCH_GA_T", "BENCH_GA_POP", "BENCH_GA_GENS",
-              "BENCH_LOB_SCENARIOS", "BENCH_LOB_STEPS", "BENCH_LOB_LEVELS")
+              "BENCH_LOB_SCENARIOS", "BENCH_LOB_STEPS", "BENCH_LOB_LEVELS",
+              "BENCH_COLDSTART_TICKS")
              if os.environ.get(k)}
     with open(path, "a", encoding="utf-8") as f:
         for row in rows:
@@ -318,6 +319,23 @@ def _flag_value(name: str, default):
         if i + 1 < len(sys.argv):
             return sys.argv[i + 1]
     return default
+
+
+class _RowDeselected(Exception):
+    """A --rows filter excluded this row; skip silently, not 'unavailable'."""
+
+
+def rows_filter() -> set | None:
+    """Selective-row filter (`--rows tick,stream` / env BENCH_ROWS): the
+    set of row names to run, or None for the full suite.  Known names are
+    the secondary-bench keys plus "headline" (the replay sweep + its
+    partitioner/pallas riders).  The orchestrator exports the flag as
+    BENCH_ROWS so the worker subprocess sees the same selection; scale
+    stamping is untouched — a selectively-run row gates against the same
+    history key as a full-suite run of the same measurement."""
+    spec = os.environ.get("BENCH_ROWS") or _flag_value("--rows", "") or ""
+    rows = {r.strip() for r in spec.split(",") if r.strip()}
+    return rows or None
 
 
 def trend_table(rows: list, report: list, last_n: int = 5) -> list[str]:
@@ -474,7 +492,11 @@ def run_bench_worker(label: str, budget_s: float, *, cpu: bool) -> bool:
     if cpu:
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize must not re-dial
-    log(f"{label} worker: budget {budget_s:.0f}s")
+    rows = rows_filter()
+    if rows:
+        env["BENCH_ROWS"] = ",".join(sorted(rows))
+    log(f"{label} worker: budget {budget_s:.0f}s"
+        + (f", rows {sorted(rows)}" if rows else ""))
     p = subprocess.Popen(_worker_cmd(), stdout=subprocess.PIPE, text=True,
                          env=env)
     seen = {"headline": None, "last": None}
@@ -506,6 +528,11 @@ def run_bench_worker(label: str, budget_s: float, *, cpu: bool) -> bool:
     t.join(timeout=10)
     if seen["headline"] and seen["last"] != seen["headline"]:
         print(seen["headline"], flush=True)
+    if rows and "headline" not in rows:
+        # selective run without the headline sweep: success = the worker
+        # finished cleanly (the driver's headline-last contract only
+        # binds full runs; a selective run is an operator's scoped ask)
+        return p.returncode == 0
     return seen["headline"] is not None
 
 
@@ -580,7 +607,8 @@ def orchestrate():
             headline_out = run_bench_worker(
                 "TPU", max(60.0, remaining() - 30), cpu=False) or headline_out
 
-    if not headline_out:
+    rows = rows_filter()
+    if not headline_out and not (rows and "headline" not in rows):
         try:
             emergency_headline()
         except Exception as e:           # noqa: BLE001 — last line of defense
@@ -1098,11 +1126,21 @@ def bench_stream():
     Happy-path contract asserted inline: after the backfill seed, the
     timed window performs ZERO REST kline calls (rest_kline_calls_steady
     rides the row).  p50 is the gated headline (ms, lower-better); p99
-    rides along."""
+    rides along.
+
+    A second timed pass runs the SAME supervisor under an active
+    TickPathScope (obs/tickpath.py) and stamps the row with the phase
+    waterfall (parse / scatter_build / dispatch / device_compute /
+    host_read / publish p50s), the overlap headroom pipelining could
+    reclaim, and the observatory's own overhead (tickpath_overhead_pct,
+    budget ≤ 5%) — the measure-then-pipeline numbers live with the
+    latency they decompose."""
     import asyncio
 
     from ai_crypto_trader_tpu.data.ingest import OHLCV
     from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.obs import tickpath as tickpath_mod
+    from ai_crypto_trader_tpu.obs.tickpath import TickPathScope
     from ai_crypto_trader_tpu.shell.bus import EventBus
     from ai_crypto_trader_tpu.shell.exchange import FakeExchange
     from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
@@ -1114,8 +1152,9 @@ def bench_stream():
     ticks = int(os.environ.get("BENCH_STREAM_TICKS", "40"))
     T = 256
     frames = ("1m", "3m", "5m", "15m")
-    n_hist = T * 15 + ticks + 64              # every frame reaches a full
+    n_hist = T * 15 + 2 * ticks + 64          # every frame reaches a full
     #                                           window → zero-REST reachable
+    #                                           across BOTH timed passes
     d = generate_ohlcv(n=n_hist, seed=17)
     series = {f"W{i:03d}USDC": OHLCV(
         timestamp=np.arange(n_hist, dtype=np.int64) * 60_000,
@@ -1123,7 +1162,7 @@ def bench_stream():
         low=d["low"] * (1 + 0.02 * i), close=d["close"] * (1 + 0.02 * i),
         volume=d["volume"], symbol=f"W{i:03d}USDC") for i in range(S)}
     ex = FakeExchange(series)
-    ex.advance(steps=n_hist - ticks - 8)
+    ex.advance(steps=n_hist - 2 * ticks - 8)
     syms = sorted(series)
 
     counting = CountingKlines(ex)
@@ -1138,30 +1177,146 @@ def bench_stream():
             sup.offer(f)
         await sup.step()
         seed_calls = counting.kline_calls
-        lats = []
-        for _ in range(ticks):
+        scope = TickPathScope()
+        lats_off, lats_on = [], []
+        # interleaved on/off ticks (bench_flightrec precedent): drift,
+        # GC, and warmup bias hit both populations equally, so the
+        # overhead stamp measures the observatory — not the ordering
+        for i in range(2 * ticks):
             ex.advance(steps=1)
             batch = kline_frames_for(ex, syms, frames,
                                      event_ms=int(time.time() * 1000))
+            on = i % 2 == 1
             t0 = time.perf_counter()        # the event hits the transport
-            for f in batch:
-                sup.offer(f)
-            await sup.step()
-            lats.append((time.perf_counter() - t0) * 1e3)
-        return lats, counting.kline_calls - seed_calls
+            if on:
+                with tickpath_mod.use(scope):
+                    for f in batch:
+                        sup.offer(f)
+                    await sup.step()
+            else:
+                for f in batch:
+                    sup.offer(f)
+                await sup.step()
+            (lats_on if on else lats_off).append(
+                (time.perf_counter() - t0) * 1e3)
+        return lats_off, lats_on, scope, counting.kline_calls - seed_calls
 
     t0 = time.perf_counter()
-    lats, rest_calls = asyncio.run(run())
+    lats, lats_on, scope, rest_calls = asyncio.run(run())
     log(f"stream: seed+compile {time.perf_counter()-t0:.1f}s total "
-        f"(S={S} × {len(frames)} frames × T={T}, {ticks} timed ticks)")
+        f"(S={S} × {len(frames)} frames × T={T}, 2×{ticks} timed ticks)")
     p50 = float(np.percentile(lats, 50))
     p99 = float(np.percentile(lats, 99))
+    p50_on = float(np.percentile(lats_on, 50))
+    overhead_pct = max((p50_on - p50) / max(p50, 1e-9) * 100.0, 0.0)
+    status = scope.status()
+    phases = status["phases"]
+    headroom = status["overlap_headroom_ms"]
     log(f"stream: event→signal p50 {p50:.2f} ms / p99 {p99:.2f} ms, "
         f"REST kline calls during timed window: {rest_calls}")
+    log(f"stream: tickpath pass p50 {p50_on:.2f} ms "
+        f"(overhead {overhead_pct:.1f}%), bottleneck "
+        f"{status['bottleneck']}, overlap headroom p50 "
+        f"{headroom['p50']:.3f} ms")
     emit("stream_latency", p50, "ms", None, engine="stream",
          symbols=S, ticks=ticks, p99_ms=round(p99, 3),
          frames_per_tick=S * len(frames),
-         rest_kline_calls_steady=int(rest_calls))
+         rest_kline_calls_steady=int(rest_calls),
+         overlap_headroom_ms=round(headroom["p50"], 3),
+         tickpath_overhead_pct=round(overhead_pct, 2),
+         tickpath_bottleneck=status["bottleneck"],
+         **{f"phase_{ph}_ms": round(phases[ph]["p50_ms"], 3)
+            for ph in ("parse", "scatter_build", "dispatch",
+                       "device_compute", "host_read", "publish")
+            if phases[ph]["count"]})
+
+
+def run_coldstart_child():
+    """--coldstart-child: the timed half of the cold_start_ms row.  A
+    FRESH interpreter (the parent stamps BENCH_T0 into the env
+    immediately before exec) builds the full paper stack and ticks until
+    the first fused decision is published, so interpreter boot, imports,
+    jax init, and the first-compile of the fused tick program ALL land
+    inside the measured wall — the number an operator restarting a live
+    trader actually waits.  Prints ONE JSON line for the parent."""
+    import asyncio
+
+    t0 = float(os.environ["BENCH_T0"])
+    sym = "BTCUSDC"
+    max_ticks = int(os.environ.get("BENCH_COLDSTART_TICKS", "5"))
+
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.exchange import make_exchange
+    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+    d = generate_ohlcv(n=700, seed=7)
+    series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                       symbol=sym)
+    # virtual clock aligned to the synthetic candle open-times (i*60_000
+    # epoch-ms) — same convention as `cli latency`'s local demo
+    clock = {"t": 600 * 60.0}
+    ex = make_exchange("fake", series={sym: series}, quote_balance=10_000.0)
+    ex.advance(sym, steps=600)
+    system = TradingSystem(ex, [sym], now_fn=lambda: clock["t"])
+
+    async def go():
+        for i in range(max_ticks):
+            ex.advance(sym)
+            clock["t"] += 60.0
+            await system.tick()
+            if system.bus.get(f"latest_signal_{sym}") is not None:
+                return i + 1
+        return max_ticks
+
+    try:
+        ticks = asyncio.run(go())
+        cold_ms = (time.time() - t0) * 1e3
+        tp = getattr(system, "tickpath", None)
+        ledger = tp.coldstart_status() if tp is not None else {}
+        print(json.dumps({
+            "cold_start_ms": round(cold_ms, 1),
+            "ticks_to_first_decision": ticks,
+            "decision_published": bool(
+                system.bus.get(f"latest_signal_{sym}")),
+            "coldstart": ledger,
+        }))
+    finally:
+        system.shutdown()
+
+
+def bench_coldstart():
+    """cold_start_ms row: restart downtime budget — a FRESH subprocess
+    from interpreter exec to the first fused-tick decision published
+    (ISSUE 16).  The child's per-program first-compile ledger
+    (obs/tickpath.py cold-start accounting) rides the row, so a
+    regression names WHICH program got slower to warm instead of just
+    flagging the total.  Lower-better via the "ms" unit → auto-gated
+    like every latency row."""
+    env = dict(os.environ)
+    env["BENCH_T0"] = str(time.time())   # stamped at the last moment:
+    #                                      exec latency is part of the cost
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--coldstart-child"],
+        env=env, capture_output=True, text=True,
+        timeout=max(120.0, min(600.0, remaining())))
+    lines = [ln for ln in p.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    if p.returncode != 0 or not lines:
+        raise RuntimeError(f"coldstart child rc={p.returncode}: "
+                           f"{(p.stderr or p.stdout)[-300:]!r}")
+    row = json.loads(lines[-1])
+    ledger = row.get("coldstart") or {}
+    progs = ledger.get("programs") or {}
+    log(f"coldstart: {row['cold_start_ms']:.0f} ms to first decision "
+        f"({row['ticks_to_first_decision']} tick(s), compile "
+        f"{ledger.get('total_compile_ms', 0.0):.0f} ms across "
+        f"{len(progs)} program(s))")
+    emit("cold_start_ms", row["cold_start_ms"], "ms", None, engine="shell",
+         ticks_to_first_decision=row["ticks_to_first_decision"],
+         compile_ms=round(float(ledger.get("total_compile_ms", 0.0)), 1),
+         programs={k: round(float(v.get("compile_ms", 0.0)), 1)
+                   for k, v in progs.items()})
 
 
 def bench_capacity():
@@ -1476,6 +1631,11 @@ def run_worker():
     DEVICE_KIND = str(getattr(devices[0], "device_kind", platform))
     on_cpu = platform == "cpu"
 
+    rows = rows_filter()
+
+    def want(name: str) -> bool:
+        return rows is None or name in rows
+
     T = int(os.environ.get("BENCH_T", "525600"))   # 1 year of 1-minute candles
     # population width: 4096 saturates the chip; 256 keeps the CPU fallback
     # inside the driver budget on a 1-core box (VERDICT r4 next#1)
@@ -1486,58 +1646,76 @@ def run_worker():
     if os.environ.get("BENCH_UNROLL"):
         unrolls = (int(os.environ["BENCH_UNROLL"]),)
 
-    d = generate_ohlcv(n=T, seed=3)
-    arrays = {k: jnp.asarray(v) for k, v in d.items() if k != "regime"}
+    # Shared data prep only when a selected row consumes it: the headline
+    # sweep and the GA row walk `arrays`/`inp`; the RL row needs `ind`.  A
+    # selective `--rows stream,coldstart` run skips the 525k-candle
+    # indicator compile entirely — that skip is the flag's whole point.
+    arrays = ind = inp = None
+    if want("headline") or want("ga") or want("rl"):
+        d = generate_ohlcv(n=T, seed=3)
+        arrays = {k: jnp.asarray(v) for k, v in d.items() if k != "regime"}
 
-    # Two staged jit programs (never eager ops on the axon backend — each
-    # eager op is a separate compile; and never one mega-fused graph — XLA
-    # compile time grows superlinearly in the ~70 long associative scans).
-    t0 = time.perf_counter()
-    ind = ops.compute_indicators(arrays)
-    fetch(ind["rsi"][-1])
-    log(f"indicators (incl. compile): {time.perf_counter()-t0:.1f}s")
-    t0 = time.perf_counter()
-    inp = prepare_inputs(ind)
-    fetch(inp.strength[-1])
-    log(f"signal features (incl. compile): {time.perf_counter()-t0:.1f}s")
-
-    params = sample_params(jax.random.PRNGKey(0), B)
-
-    best_dt, best_unroll = None, None
-    for unroll in unrolls:
+        # Two staged jit programs (never eager ops on the axon backend — each
+        # eager op is a separate compile; and never one mega-fused graph — XLA
+        # compile time grows superlinearly in the ~70 long associative scans).
         t0 = time.perf_counter()
-        stats = sweep(inp, params, unroll=unroll)
-        fetch(stats.final_balance)
-        log(f"sweep compile+first run (unroll={unroll}): "
-            f"{time.perf_counter()-t0:.1f}s")
+        ind = ops.compute_indicators(arrays)
+        fetch(ind["rsi"][-1])
+        log(f"indicators (incl. compile): {time.perf_counter()-t0:.1f}s")
+    if want("headline") or want("ga"):
         t0 = time.perf_counter()
-        stats = sweep(inp, params, unroll=unroll)
-        fetch(stats.final_balance)
-        dt = time.perf_counter() - t0
-        log(f"steady-state sweep (unroll={unroll}): {dt:.3f}s → "
-            f"{T*B/dt:,.0f} candles/s/chip (pop {B} × {T} candles)")
-        if best_dt is None or dt < best_dt:
-            best_dt, best_unroll = dt, unroll
-        if not budget_left(reserve=240):
-            log("worker budget low; stopping unroll sweep early")
-            break
+        inp = prepare_inputs(ind)
+        fetch(inp.strength[-1])
+        log(f"signal features (incl. compile): {time.perf_counter()-t0:.1f}s")
 
-    candles_per_sec = T * B / best_dt
+    candles_per_sec = None
+    ref_cps = None
     engine = "scan"
-    log(f"best: unroll={best_unroll}, {candles_per_sec:,.0f} candles/s/chip")
-
-    ref_cps = reference_cpu_candles_per_sec(inp)
-    log(f"reference CPU loop: {ref_cps:,.0f} candles/s")
 
     def emit_headline():
         emit(HEADLINE_METRIC, candles_per_sec, "candles/s/chip",
              round(candles_per_sec / ref_cps, 1), engine=engine,
              devices=jax.device_count())
 
-    # EARLY headline: a worker killed later (driver budget, flaky relay)
-    # still leaves a parseable row in the captured output; the orchestrator
-    # reorders it last.  It is re-emitted at the end with the final engine.
-    emit_headline()
+    if want("headline"):
+        params = sample_params(jax.random.PRNGKey(0), B)
+
+        best_dt, best_unroll = None, None
+        for unroll in unrolls:
+            t0 = time.perf_counter()
+            stats = sweep(inp, params, unroll=unroll)
+            fetch(stats.final_balance)
+            log(f"sweep compile+first run (unroll={unroll}): "
+                f"{time.perf_counter()-t0:.1f}s")
+            t0 = time.perf_counter()
+            stats = sweep(inp, params, unroll=unroll)
+            fetch(stats.final_balance)
+            dt = time.perf_counter() - t0
+            log(f"steady-state sweep (unroll={unroll}): {dt:.3f}s → "
+                f"{T*B/dt:,.0f} candles/s/chip (pop {B} × {T} candles)")
+            if best_dt is None or dt < best_dt:
+                best_dt, best_unroll = dt, unroll
+            if not budget_left(reserve=240):
+                log("worker budget low; stopping unroll sweep early")
+                break
+
+        candles_per_sec = T * B / best_dt
+        log(f"best: unroll={best_unroll}, "
+            f"{candles_per_sec:,.0f} candles/s/chip")
+
+        ref_cps = reference_cpu_candles_per_sec(inp)
+        log(f"reference CPU loop: {ref_cps:,.0f} candles/s")
+
+        # EARLY headline: a worker killed later (driver budget, flaky
+        # relay) still leaves a parseable row in the captured output; the
+        # orchestrator reorders it last.  It is re-emitted at the end with
+        # the final engine.
+        emit_headline()
+    elif want("ga"):
+        # the GA row's vs_baseline needs the reference loop rate even when
+        # the headline sweep itself was deselected
+        ref_cps = reference_cpu_candles_per_sec(inp)
+        log(f"reference CPU loop: {ref_cps:,.0f} candles/s")
 
     # population-sweep row through the Partitioner seam (ISSUE 11): the
     # same sweep routed via get_partitioner() — single-device fallback on
@@ -1545,6 +1723,8 @@ def run_worker():
     # results all-gathered on multi-chip.  Device-count-stamped so the
     # trajectory stays legible when the same config runs on a pod slice.
     try:
+        if not want("headline"):
+            raise _RowDeselected
         from ai_crypto_trader_tpu.parallel import get_partitioner
         from ai_crypto_trader_tpu.utils import meshprof as meshprof_mod
 
@@ -1579,6 +1759,8 @@ def run_worker():
         emit("population_sweep_candles_per_sec", T * B / dt_p, "candles/s",
              None, engine="partitioner", devices=part.device_count,
              population=B, **locality)
+    except _RowDeselected:
+        pass                             # --rows filtered the headline out
     except Exception as e:               # noqa: BLE001 — bench must not die
         log(f"population_sweep row unavailable ({type(e).__name__}: {e})")
 
@@ -1588,7 +1770,8 @@ def run_worker():
     # the kernel may only win if it ALSO passes the full-shape on-chip
     # parity cross-check against the scan engine (VERDICT r3 weak#2: a fast
     # wrong answer must not become the headline).
-    if not on_cpu and os.environ.get("BENCH_PALLAS", "1") == "1":
+    if want("headline") and not on_cpu \
+            and os.environ.get("BENCH_PALLAS", "1") == "1":
         try:
             from ai_crypto_trader_tpu.ops.pallas_backtest import sweep_pallas
 
@@ -1631,6 +1814,7 @@ def run_worker():
     secondary = [
         ("tick", bench_tick),
         ("stream", bench_stream),
+        ("coldstart", bench_coldstart),
         ("capacity", bench_capacity),
         ("flightrec", bench_flightrec),
         ("ga", ga_row),
@@ -1642,6 +1826,8 @@ def run_worker():
         ("recovery", bench_recovery),
     ]
     for name, fn in secondary:
+        if not want(name):
+            continue
         if not budget_left(reserve=90):
             log(f"{name} bench skipped: worker budget nearly spent "
                 f"({elapsed():.0f}s of {worker_budget():.0f}s)")
@@ -1652,11 +1838,14 @@ def run_worker():
             log(f"{name} bench unavailable ({type(e).__name__}: {e})")
 
     # headline LAST — the driver parses the final JSON line
-    emit_headline()
+    if candles_per_sec is not None:
+        emit_headline()
 
 
 if __name__ == "__main__":
-    if "--worker" in sys.argv:
+    if "--coldstart-child" in sys.argv:
+        run_coldstart_child()
+    elif "--worker" in sys.argv:
         run_worker()
     elif "--emergency" in sys.argv:
         run_emergency()

@@ -291,6 +291,61 @@ class TestGateLogic:
         assert self.bench.load_history(str(tmp_path / "missing.jsonl")) == []
 
 
+class TestRowsFilter:
+    """ISSUE 16 satellite: `--rows tick,stream` / env BENCH_ROWS selects
+    which bench rows run, so a cold_start_ms or stream re-measure never
+    pays the 525k-candle headline prep.  Parsing stays jax-free, and a
+    selectively-run row gates against the SAME history key as a
+    full-suite run (scale stamping is untouched by the filter)."""
+
+    def setup_method(self):
+        self.bench = _bench_module()
+
+    def test_parses_env_then_flag(self, monkeypatch):
+        monkeypatch.delenv("BENCH_ROWS", raising=False)
+        assert self.bench.rows_filter() is None         # full suite
+        monkeypatch.setenv("BENCH_ROWS", "coldstart, stream,")
+        assert self.bench.rows_filter() == {"coldstart", "stream"}
+        monkeypatch.delenv("BENCH_ROWS")
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--rows",
+                                          "tick,headline"])
+        assert self.bench.rows_filter() == {"tick", "headline"}
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--rows"])
+        assert self.bench.rows_filter() is None         # dangling flag
+
+    def test_selective_row_gates_against_full_run_history(self):
+        """cold_start_ms measured via `--rows coldstart` shares the gate
+        key with the full-suite row — and its "ms" unit gates
+        lower-is-better automatically."""
+        rows = [
+            {"run_id": "full", "metric": "cold_start_ms",
+             "value": 30_000.0, "unit": "ms", "device_kind": "cpu"},
+            {"run_id": "sel", "metric": "cold_start_ms",
+             "value": 40_000.0, "unit": "ms", "device_kind": "cpu"},
+        ]
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert not ok and report[0]["status"] == "REGRESSION"
+        ok, _ = self.bench.gate_history(rows, tolerance=0.50)
+        assert ok
+
+    def test_worker_cmd_and_secondary_names_cover_selection(self):
+        """Every name the docstring advertises resolves to a real row:
+        the secondary table in run_worker plus "headline"."""
+        import ast
+        import inspect
+
+        src = inspect.getsource(self.bench.run_worker)
+        tree = ast.parse("if 1:\n" + src if src.startswith(" ") else src)
+        names = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and any(getattr(t, "id", None) == "secondary"
+                            for t in node.targets)):
+                names = {elt.elts[0].value for elt in node.value.elts}
+        assert {"tick", "stream", "coldstart", "capacity", "flightrec",
+                "ga", "rl"} <= names
+
+
 class TestHistoryRecording:
     def setup_method(self):
         self.bench = _bench_module()

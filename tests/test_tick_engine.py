@@ -250,6 +250,65 @@ class TestPollContract:
 
         asyncio.run(go())
 
+    def test_tickpath_waterfall_rides_contract(self, monkeypatch):
+        """ISSUE 16: with the decision critical-path observatory ACTIVE,
+        the one-dispatch/one-sync contract holds verbatim — the waterfall
+        is stitched from seams the poll already crosses, so it adds ZERO
+        dispatches and ZERO host syncs — and the recorded engine phases
+        sum to (at most) the measured poll wall: the observatory
+        decomposes the latency, it never invents time."""
+        from ai_crypto_trader_tpu.obs import tickpath
+        from ai_crypto_trader_tpu.obs.tickpath import TickPathScope
+
+        async def go():
+            symbols = ("BTCUSDC", "ETHUSDC")
+            ex = _exchange(symbols)
+            clock = {"t": 0.0}
+            mon = MarketMonitor(EventBus(), ex, symbols=list(symbols),
+                                now_fn=lambda: clock["t"],
+                                kline_limit=LIMIT, fused=True)
+            syncs = {"n": 0}
+            real_read = tick_engine.host_read
+
+            def counting_read(tree):
+                syncs["n"] += 1
+                return real_read(tree)
+
+            monkeypatch.setattr(tick_engine, "host_read", counting_read)
+            scope = TickPathScope()
+            with tickpath.use(scope):
+                assert await mon.poll(force=True) == 2   # seed + compile
+                ex.advance(steps=1)
+                clock["t"] += 60.0
+                import time as _time
+                t0 = _time.perf_counter()
+                assert await mon.poll() == 2             # steady state
+                wall_ms = (_time.perf_counter() - t0) * 1e3
+            eng = mon._engine
+            assert syncs["n"] == 2            # ONE sync per poll — the
+            #                                   observatory added none
+            assert eng.dispatch_count == 2    # and no extra dispatches
+            st = scope.status()
+            engine_phases = ("scatter_build", "dispatch",
+                             "device_compute", "host_read")
+            for ph in engine_phases:
+                assert st["phases"][ph]["count"] == 2, (ph, st)
+            # the steady poll's engine slices are disjoint sub-spans of
+            # the same wall clock (5% timer slack)
+            sum_ms = sum(st["phases"][ph]["last_ms"]
+                         for ph in engine_phases)
+            assert sum_ms <= wall_ms * 1.05, (sum_ms, wall_ms)
+            # the seed's cold window landed in the ledger (compiles may
+            # read 0 when an earlier test already populated the process
+            # jit cache — the WINDOW is the contract), and overlap
+            # headroom observed on both polls
+            entry = scope.cold_programs["tick_engine"]
+            assert entry["wall_ms"] > 0.0 and entry["compile_ms"] >= 0.0
+            assert scope.overlap.count == 2
+            assert st["bottleneck"] in tickpath.PHASES
+
+        asyncio.run(go())
+
     def test_ring_delta_matches_fresh_seed(self):
         """Drive the engine through incremental updates, then compare its
         outputs to a FRESH engine seeded directly on the same klines —

@@ -1,0 +1,280 @@
+"""Decision critical-path observatory (obs/tickpath.py): phase waterfall
+windows + the named bottleneck (injected-delay drill), clock-skew
+clamping, the event→decision age SLO and its alert input, the cold-start
+ledger, the metric export literals, and the module-global on/off seam.
+
+The drill class is the ISSUE 16 acceptance: inject a delay into EACH
+pipeline stage in turn and the observatory must name exactly that stage
+as the bottleneck — the waterfall is only useful if it localizes.
+"""
+
+import asyncio
+
+import pytest
+
+from ai_crypto_trader_tpu.obs import tickpath
+from ai_crypto_trader_tpu.obs.tickpath import (PHASES, TickPathScope)
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_scope():
+    """Each test starts (and the suite ends) with the observatory off."""
+    tickpath.disable()
+    yield
+    tickpath.disable()
+
+
+class TestWaterfall:
+    def test_status_covers_every_phase(self):
+        """The status block always carries the FULL bounded phase set —
+        a never-observed phase reads as zeros, not a missing key (a hole
+        in the waterfall table would hide an uninstrumented seam)."""
+        tp = TickPathScope()
+        tp.observe_phase("dispatch", 0.004)
+        st = tp.status()
+        assert tuple(st["phases"]) == PHASES
+        assert st["phases"]["dispatch"]["count"] == 1
+        assert st["phases"]["dispatch"]["last_ms"] == pytest.approx(4.0)
+        assert st["phases"]["parse"] == {"count": 0, "p50_ms": 0.0,
+                                         "p99_ms": 0.0, "last_ms": 0.0}
+
+    def test_bottleneck_is_largest_p99(self):
+        tp = TickPathScope()
+        assert tp.bottleneck() is None            # nothing observed yet
+        for _ in range(10):
+            tp.observe_phase("parse", 0.002)
+            tp.observe_phase("host_read", 0.008)
+            tp.observe_phase("dispatch", 0.003)
+        assert tp.bottleneck() == "host_read"
+
+    @pytest.mark.parametrize("phase", PHASES)
+    def test_injected_delay_drill_names_each_stage(self, phase):
+        """ISSUE 16 acceptance drill: delay stage X → the observatory
+        must pin X as the named bottleneck, for every X."""
+        tp = TickPathScope()
+        tp.inject_delay(phase, 0.250)
+        for _ in range(6):
+            for name in PHASES:
+                tp.observe_phase(name, 0.001)
+        assert tp.bottleneck() == phase
+        assert tp.alert_state()["tickpath_bottleneck_phase"] == phase
+
+    def test_unknown_phase_never_competes(self):
+        """A typo'd seam can record, but the bounded PHASES vocabulary
+        decides the bottleneck — no label minting."""
+        tp = TickPathScope()
+        tp.observe_phase("dispatch", 0.002)
+        tp.observe_phase("dispach_typo", 9.0)
+        assert tp.bottleneck() == "dispatch"
+
+
+class TestClockSkewGuard:
+    def test_negative_phase_clamps_and_counts(self):
+        tp = TickPathScope()
+        tp.observe_phase("frame_wait", -0.5)
+        assert tp.clock_skew_total == 1
+        assert tp.status()["phases"]["frame_wait"]["last_ms"] == 0.0
+
+    def test_skewed_ticker_ages_clamp_to_zero(self):
+        """A venue whose clock runs AHEAD of the host stamps event times
+        in our future → negative ages.  They must clamp to 0 and count
+        as skew instead of poisoning the SLO quantiles."""
+        tp = TickPathScope(min_samples=4)
+        host_now_ms = 1_000_000.0
+        for _ in range(8):                     # ticker 250 ms in the future
+            event_ms = host_now_ms + 250.0
+            clamped = tp.observe_event_age(host_now_ms - event_ms)
+            assert clamped == 0.0
+            host_now_ms += 60_000.0
+        st = tp.status()["event_age_ms"]
+        assert st["count"] == 8 and st["p99"] == 0.0
+        assert tp.clock_skew_total == 8
+        assert tp.alert_state()["tickpath_clock_skew_total"] == 8
+        # the quantiles stayed clean: a later honest age dominates
+        for _ in range(8):
+            tp.observe_event_age(120.0)
+        assert tp.status()["event_age_ms"]["p50"] >= 0.0
+
+    def test_skew_counter_exports(self):
+        m = MetricsRegistry()
+        tp = TickPathScope(metrics=m)
+        tp.observe_event_age(-1.0)
+        assert m.counters[
+            "crypto_trader_tpu_tickpath_clock_skew_total"] == 1.0
+
+
+class TestEventAgeSLO:
+    def test_alert_quiet_below_min_samples(self):
+        """One compile-heavy cold tick is 100% of a tiny window — the
+        breach input must read 0 until the window holds min_samples."""
+        from ai_crypto_trader_tpu.utils.alerts import AlertManager
+
+        tp = TickPathScope(min_samples=8)
+        for _ in range(7):
+            tp.observe_event_age(30_000.0)     # way over budget
+        state = tp.alert_state()
+        assert state["event_age_p99_ms"] == 0.0
+        mgr = AlertManager(now_fn=lambda: 0.0)
+        assert not [a for a in mgr.evaluate(state)
+                    if a["name"] == "DecisionLatencyBudgetBreach"]
+        tp.observe_event_age(30_000.0)         # window filled
+        fired = mgr.evaluate(tp.alert_state())
+        assert [a for a in fired
+                if a["name"] == "DecisionLatencyBudgetBreach"]
+
+    def test_budget_rides_the_state(self):
+        tp = TickPathScope(event_age_budget_ms=50.0, min_samples=1)
+        tp.observe_event_age(80.0)
+        s = tp.alert_state()
+        assert s["event_age_budget_ms"] == 50.0
+        assert s["event_age_p99_ms"] > s["event_age_budget_ms"]
+
+
+class TestColdStartLedger:
+    def test_first_window_wins(self):
+        tp = TickPathScope()
+        tp.record_cold_start("tick_engine", wall_s=2.0, compile_s=1.5,
+                             compiles=3)
+        tp.record_cold_start("tick_engine", wall_s=9.0, compile_s=9.0,
+                             compiles=9)       # late duplicate: ignored
+        st = tp.coldstart_status()
+        assert st["programs"]["tick_engine"]["wall_ms"] == 2000.0
+        assert st["programs"]["tick_engine"]["compiles"] == 3
+        assert st["total_wall_ms"] == 2000.0
+        assert st["total_compile_ms"] == 1500.0
+
+    def test_warm_and_ledgered_dispatches_get_noop(self):
+        tp = TickPathScope()
+        assert tp.coldstart("x", cold=False) is tickpath._NOOP_CTX
+        tp.record_cold_start("x", wall_s=1.0, compile_s=0.5, compiles=1)
+        assert tp.coldstart("x") is tickpath._NOOP_CTX
+
+    def test_cold_window_attributes_a_real_compile(self):
+        """The context manager samples the process-wide JitCompileMonitor
+        around a genuinely cold jit dispatch and lands compile time in
+        the ledger."""
+        import jax
+        import jax.numpy as jnp
+
+        tp = TickPathScope()
+        with tp.coldstart("ledger_probe"):
+            # a shape/closure combination nothing else compiles
+            jax.block_until_ready(
+                jax.jit(lambda x: jnp.tanh(x) * 3.17)(jnp.ones((7, 3))))
+        entry = tp.coldstart_status()["programs"]["ledger_probe"]
+        assert entry["wall_ms"] > 0.0
+        assert entry["compiles"] >= 1
+        assert 0.0 < entry["compile_ms"] <= entry["wall_ms"] * 1.5
+
+
+class TestExport:
+    def test_export_literals_and_bottleneck_indicator(self):
+        m = MetricsRegistry()
+        tp = TickPathScope(metrics=m)
+        for _ in range(4):
+            tp.observe_phase("dispatch", 0.010)
+            tp.observe_phase("parse", 0.001)
+        tp.observe_overlap(0.002)
+        tp.observe_event_age(42.0)
+        tp.record_cold_start("tick_engine", wall_s=3.0, compile_s=2.0,
+                             compiles=1)
+        tp.export()
+        g = m.gauges
+        for phase in PHASES:                   # full bounded label set
+            for q in ("p50", "p99"):
+                assert (f'crypto_trader_tpu_tickpath_phase_seconds'
+                        f'{{phase="{phase}",q="{q}"}}') in g
+        assert g['crypto_trader_tpu_tickpath_bottleneck'
+                 '{phase="dispatch"}'] == 1.0
+        assert g['crypto_trader_tpu_tickpath_bottleneck'
+                 '{phase="parse"}'] == 0.0
+        assert g["crypto_trader_tpu_tickpath_overlap_headroom_seconds"] \
+            == pytest.approx(0.002)
+        assert g['crypto_trader_tpu_latency_p99_seconds'
+                 '{slo="event_to_decision"}'] == pytest.approx(0.042)
+        assert g["crypto_trader_tpu_coldstart_total_seconds"] \
+            == pytest.approx(3.0)
+        assert g['crypto_trader_tpu_coldstart_wall_seconds'
+                 '{program="tick_engine"}'] == pytest.approx(3.0)
+        # the event-age histogram feeds the slo_latency family the
+        # devprof recording rules already aggregate
+        assert any(k.startswith('crypto_trader_tpu_slo_latency_seconds'
+                                '{slo="event_to_decision"}')
+                   for k in m.histograms)
+
+
+class TestModuleSeam:
+    def test_disabled_helpers_are_noops(self):
+        assert tickpath.active() is None
+        tickpath.observe_phase("dispatch", 1.0)       # no crash, no state
+        tickpath.observe_overlap(1.0)
+        assert tickpath.observe_event_age(5.0) is None
+        assert tickpath.coldstart("x") is tickpath._NOOP_CTX
+
+    def test_use_restores_previous_scope(self):
+        outer = tickpath.configure(TickPathScope())
+        inner = TickPathScope()
+        with tickpath.use(inner):
+            assert tickpath.active() is inner
+            tickpath.observe_phase("publish", 0.003)
+        assert tickpath.active() is outer
+        assert inner.status()["phases"]["publish"]["count"] == 1
+        assert outer.status()["phases"]["publish"]["count"] == 0
+
+    def test_launcher_installs_and_shutdown_clears(self):
+        """Default-ON wiring: TradingSystem installs the observatory as
+        the process-wide scope, feeds it from the tick loop, and its
+        shutdown clears the global (no cross-test leakage)."""
+        import sys as _sys
+
+        _sys.path.insert(0, "tests")
+        from test_shell import _series
+
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        ex = FakeExchange({"BTCUSDC": _series()})
+        ex.advance(steps=500)                  # full 1m window → the fused
+        #                                        engine really dispatches
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: 0.0)
+        try:
+            assert tickpath.active() is system.tickpath
+
+            async def go():
+                await system.tick()
+
+            asyncio.run(go())
+            st = system.tickpath.status()
+            assert sum(p["count"] for p in st["phases"].values()) > 0
+            assert "tick_engine" in \
+                system.tickpath.coldstart_status()["programs"]
+            # the rule-engine inputs ride the launcher's alert state
+            s = system._alert_state()
+            for key in ("event_age_p99_ms", "event_age_budget_ms",
+                        "tickpath_bottleneck_phase"):
+                assert key in s, key
+            # provenance block for /state.json `build`
+            assert {"process_start", "jax_version",
+                    "backend"} <= set(system.build_info)
+        finally:
+            system.shutdown()
+        assert tickpath.active() is None
+
+    def test_opt_out_flag(self):
+        import sys as _sys
+
+        _sys.path.insert(0, "tests")
+        from test_shell import _series
+
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        ex = FakeExchange({"BTCUSDC": _series()})
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: 0.0,
+                               enable_tickpath=False)
+        try:
+            assert system.tickpath is None
+            assert tickpath.active() is None
+        finally:
+            system.shutdown()
