@@ -565,9 +565,12 @@ def cmd_mesh(args):
              if padded and pad / padded > 0.25 else ""))
 
 
-def _render_fleet(block: dict) -> None:
+def _render_fleet(block: dict, containment: dict | None = None) -> None:
     """Operator rendering of a fleet-observatory status block
-    (obs/fleetscope.py): headline, gate mix, dispersion, rank table."""
+    (obs/fleetscope.py): headline, quarantine, gate mix, dispersion,
+    rank table.  ``containment`` (local runs with engine access) adds
+    the per-lane quarantine table; a remote /state.json block carries
+    the bounded counts only (the cardinality discipline)."""
     if not block:
         print("no fleet block — is the fleet observatory enabled and a "
               "vmapped tenant engine deciding?")
@@ -583,6 +586,19 @@ def _render_fleet(block: dict) -> None:
     print(f"starved lanes (windowed min): {block.get('starved_lanes', 0)}; "
           f"balance drift max: {block.get('balance_drift_max', 0.0)}; "
           f"sampled lanes ({n_sampled}): {sampled}{more}")
+    n_quar = int(block.get("quarantined_lanes", 0) or 0)
+    heals = int(block.get("heals_total", 0) or 0)
+    rows = (containment or {}).get("quarantined") or []
+    print(f"quarantine: {n_quar} lane(s) quarantined, "
+          f"{heals} heal(s) completed"
+          + (f", {containment.get('degraded_ticks', 0)} degraded tick(s)"
+             if containment else ""))
+    if rows:
+        print(f"  {'lane':>6} {'gate':<18}{'cooldown left':>14}")
+        for r in rows:
+            print(f"  {r.get('lane', ''):>6} "
+                  f"{r.get('gate', 'lane_quarantined'):<18}"
+                  f"{r.get('cooldown', 0):>14}")
     mix = block.get("gate_mix") or {}
     total = sum(mix.values()) or 1
     if mix:
@@ -641,7 +657,7 @@ def cmd_fleet(args):
     print(f"(local demo fleet: {args.tenants} tenants × {args.symbols} "
           f"symbols, {args.ticks} measured ticks, p99 "
           f"{rep['p99_ms']:.1f} ms)\n")
-    _render_fleet(rep.get("fleet") or {})
+    _render_fleet(rep.get("fleet") or {}, rep.get("containment"))
 
 
 def _render_latency(tickpath_block: dict, coldstart_block: dict,

@@ -86,22 +86,36 @@ def bin_names() -> tuple:
 
 
 def device_aggregates(*, gate, pnl, balance, max_drawdown, active,
-                      k: int | None = None) -> dict:
+                      quarantined=None, k: int | None = None) -> dict:
     """The traced fleet reduction — called INSIDE the tenant engine's
     compiled decide program (the drift-PSI pattern: this module owns the
     math, the engine owns the dispatch).
 
     ``gate`` is the [N, S] i8 gate-id table; ``pnl`` / ``balance`` /
-    ``max_drawdown`` / ``active`` are [N] over the padded tenant axis.
-    Padded and deactivated tenants (``active=False``) are excluded from
-    every aggregate.  Returns a pytree of O(gates + quantiles + K)
-    scalars/small vectors that rides the engine's single host_read."""
+    ``max_drawdown`` / ``active`` / ``quarantined`` are [N] over the
+    padded tenant axis.  Padded and deactivated tenants
+    (``active=False``) are excluded from every aggregate.  Quarantined
+    lanes stay in the gate histogram (their `lane_quarantined` verdicts
+    ARE the fleet's containment signal) but are masked out of the value
+    aggregates — their poisoned PnL/balance must not smear NaN over the
+    healthy fleet's dispersion and rank table (blast radius = the
+    faulted lane, in telemetry too).  Returns a pytree of
+    O(gates + quantiles + K) scalars/small vectors that rides the
+    engine's single host_read."""
     import jax.numpy as jnp
     from jax import lax
 
     n_gates = len(_gate_vocab())
     act = active.astype(bool)
     n_act = act.astype(jnp.int32).sum()
+    if quarantined is None:
+        healthy = act
+        n_quar = jnp.int32(0)
+    else:
+        q = quarantined.astype(bool)
+        healthy = act & ~q
+        n_quar = (act & q).astype(jnp.int32).sum()
+    n_healthy = healthy.astype(jnp.int32).sum()
     # histogram over gate ids −2 … n_gates−1, active tenants only
     ids = jnp.arange(-2, n_gates, dtype=gate.dtype)
     hist = ((gate[None, :, :] == ids[:, None, None])
@@ -113,30 +127,32 @@ def device_aggregates(*, gate, pnl, balance, max_drawdown, active,
         .astype(jnp.int32).sum()
 
     def quantiles(vals):
-        # nearest-rank over the active rows: inactive rows sort to +inf,
-        # indices derive from the ACTIVE count (a traced scalar) — the
+        # nearest-rank over the healthy rows: masked rows sort to +inf,
+        # indices derive from the HEALTHY count (a traced scalar) — the
         # numpy twin in host_aggregates uses the identical formula
-        v = jnp.sort(jnp.where(act, vals, jnp.inf))
+        v = jnp.sort(jnp.where(healthy, vals, jnp.inf))
         idx = jnp.clip(
             jnp.round(jnp.asarray(_QUANT_FRACS)
-                      * jnp.maximum(n_act - 1, 0)).astype(jnp.int32),
+                      * jnp.maximum(n_healthy - 1, 0)).astype(jnp.int32),
             0, v.shape[0] - 1)
-        return jnp.where(n_act > 0, v[idx], jnp.nan)
+        return jnp.where(n_healthy > 0, v[idx], jnp.nan)
 
     k_eff = min(int(k if k is not None else TOP_K), int(pnl.shape[0]))
-    best_pnl, best_lane = lax.top_k(jnp.where(act, pnl, -jnp.inf), k_eff)
-    worst_neg, worst_lane = lax.top_k(jnp.where(act, -pnl, -jnp.inf),
+    best_pnl, best_lane = lax.top_k(jnp.where(healthy, pnl, -jnp.inf),
+                                    k_eff)
+    worst_neg, worst_lane = lax.top_k(jnp.where(healthy, -pnl, -jnp.inf),
                                       k_eff)
-    dd = jnp.where(act, max_drawdown, -jnp.inf)
+    dd = jnp.where(healthy, max_drawdown, -jnp.inf)
     return {
         "gate_hist": hist,
         "decisions": decisions.astype(jnp.int32),
         "executable": executable.astype(jnp.int32),
         "starved": starved,
         "active": n_act,
+        "quarantined": n_quar,
         "pnl_q": quantiles(pnl),
         "balance_q": quantiles(balance),
-        "max_drawdown_max": jnp.where(n_act > 0, dd.max(), jnp.nan),
+        "max_drawdown_max": jnp.where(n_healthy > 0, dd.max(), jnp.nan),
         "best_pnl": best_pnl,
         "best_lane": best_lane.astype(jnp.int32),
         "worst_pnl": -worst_neg,
@@ -145,34 +161,44 @@ def device_aggregates(*, gate, pnl, balance, max_drawdown, active,
 
 
 def host_aggregates(*, gate, pnl, balance, max_drawdown, active,
-                    k: int | None = None) -> dict:
+                    quarantined=None, k: int | None = None) -> dict:
     """NumPy twin of :func:`device_aggregates` — the parity oracle the
     tests recompute from the host-read decision table.  Bit-identical
-    semantics (same nearest-rank formula, same masking), independent
+    semantics (same nearest-rank formula, same masking — quarantined
+    lanes counted in the histogram, excluded from values), independent
     implementation."""
     gate = np.asarray(gate)
     act = np.asarray(active, bool)
     n_gates = len(_gate_vocab())
     n_act = int(act.sum())
+    if quarantined is None:
+        healthy = act
+        n_quar = 0
+    else:
+        q = np.asarray(quarantined, bool)
+        healthy = act & ~q
+        n_quar = int((act & q).sum())
+    n_healthy = int(healthy.sum())
     ids = np.arange(-2, n_gates)
     hist = np.array([int(((gate == g) & act[:, None]).sum()) for g in ids],
                     np.int32)
     starved = int((act & (gate == -2).all(axis=1)).sum())
 
     def quantiles(vals):
-        v = np.sort(np.where(act, np.asarray(vals, np.float64), np.inf))
+        v = np.sort(np.where(healthy, np.asarray(vals, np.float64),
+                             np.inf))
         idx = np.clip(np.round(np.asarray(_QUANT_FRACS)
-                               * max(n_act - 1, 0)).astype(np.int64),
+                               * max(n_healthy - 1, 0)).astype(np.int64),
                       0, v.shape[0] - 1)
-        return (v[idx] if n_act > 0
+        return (v[idx] if n_healthy > 0
                 else np.full(len(_QUANT_FRACS), np.nan))
 
     k_eff = min(int(k if k is not None else TOP_K), int(len(pnl)))
     pnl = np.asarray(pnl, np.float64)
     # ±inf masking mirrors the device exactly: tail ranks beyond the
-    # active count read ∓inf, never an inactive lane's stale real PnL
-    best_vals = np.where(act, pnl, -np.inf)
-    worst_vals = np.where(act, pnl, np.inf)
+    # healthy count read ∓inf, never a masked lane's stale real PnL
+    best_vals = np.where(healthy, pnl, -np.inf)
+    worst_vals = np.where(healthy, pnl, np.inf)
     best = np.argsort(-best_vals, kind="stable")[:k_eff]
     worst = np.argsort(worst_vals, kind="stable")[:k_eff]
     return {
@@ -181,10 +207,11 @@ def host_aggregates(*, gate, pnl, balance, max_drawdown, active,
         "executable": int(hist[1]),
         "starved": starved,
         "active": n_act,
+        "quarantined": n_quar,
         "pnl_q": quantiles(pnl),
         "balance_q": quantiles(balance),
-        "max_drawdown_max": (float(np.max(np.asarray(max_drawdown)[act]))
-                             if n_act else float("nan")),
+        "max_drawdown_max": (float(np.max(np.asarray(max_drawdown)[healthy]))
+                             if n_healthy else float("nan")),
         "best_pnl": best_vals[best],
         "best_lane": best.astype(np.int32),
         "worst_pnl": worst_vals[worst],
@@ -261,11 +288,16 @@ class FleetScope:
 
     def observe_decide(self, fleet: dict, *, tenants: int,
                        balance_drift: float = 0.0,
-                       balance_resyncs: int = 0) -> None:
+                       balance_resyncs: int = 0,
+                       quarantined: int | None = None,
+                       heals: int = 0) -> None:
         """Fold one decide's device aggregates into the rolling windows
         and export the gauges.  ``balance_drift`` is the worst relative
         engine-mirror vs venue-truth divergence the rim re-anchored
-        since the previous decide (0.0 = mirrors agreed)."""
+        since the previous decide (0.0 = mirrors agreed);
+        ``quarantined`` / ``heals`` are the engine's host-mirror
+        containment counters (quarantined defaults to the device count
+        when the caller doesn't override)."""
         hist = np.asarray(fleet["gate_hist"], np.int64)
         self.decides += 1
         self.tenants = int(tenants)
@@ -302,6 +334,10 @@ class FleetScope:
                       for l, p in zip(np.asarray(fleet["worst_lane"])[:k],
                                       np.asarray(fleet["worst_pnl"])[:k])],
             "balance_resyncs": int(balance_resyncs),
+            "quarantined_lanes": int(quarantined
+                                     if quarantined is not None
+                                     else fleet.get("quarantined", 0)),
+            "heals_total": int(heals),
         }
         self.export()
 
@@ -363,6 +399,9 @@ class FleetScope:
         m.set_gauge("fleet_active_lanes", last["active_lanes"])
         m.set_gauge("fleet_executable", last["executable"])
         m.set_gauge("fleet_starved_lanes", self.starved_lanes())
+        m.set_gauge("fleet_quarantined_lanes",
+                    last.get("quarantined_lanes", 0))
+        m.set_gauge("fleet_heals_total", last.get("heals_total", 0))
         dom_gate, dom = self.gate_dominance()
         m.set_gauge("fleet_gate_dominance", dom)
         m.set_gauge("fleet_pnl_spread", self.pnl_spread())
@@ -418,6 +457,9 @@ class FleetScope:
             "fleet_starved_lanes": self.starved_lanes(),
             "fleet_balance_drift": self.balance_drift_max(),
             "fleet_balance_drift_budget": self.balance_drift_budget,
+            "fleet_quarantined_lanes": int(
+                self.last.get("quarantined_lanes", 0)),
+            "fleet_heals_total": int(self.last.get("heals_total", 0)),
         }
 
     def status(self) -> dict:

@@ -52,6 +52,12 @@ GATES = (
     "risk_min_size",          # sized below min_trade_amount
     "entry_rejected",         # venue rejected the entry order
     "quarantine",             # executor stage quarantined mid-flight
+    # appended (not inserted): gate ids are positional indices into this
+    # tuple and live in journaled records — reordering would rewrite
+    # history's meaning on replay
+    "lane_quarantined",       # vmapped lane poisoned (NaN/Inf state or
+    #                           params) — masked out of sizing/entry
+    #                           until the host healer re-seeds it
 )
 
 # Executor gate evaluation ORDER — the priority in which
@@ -61,6 +67,12 @@ GATES = (
 # the recorded gate can never depend on which path decided; the
 # gate-for-gate parity sweep in tests/test_tenant_engine.py pins it.
 VETO_ORDER = (
+    # containment outranks every market gate: a quarantined lane's state
+    # is not trustworthy enough to EVALUATE the other predicates, so its
+    # decisions resolve here first (ops/tenant_engine.py traces this as
+    # the lane-wide quarantine bit; object lanes never set it — a single
+    # Python executor has no lane neighbors to be contained from)
+    "lane_quarantined",
     "nan_gate",
     "confidence_floor",
     "strength_floor",

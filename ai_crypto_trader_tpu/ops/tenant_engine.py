@@ -96,8 +96,16 @@ from ai_crypto_trader_tpu.ops.tick_engine import (  # noqa: E402
 )
 
 
+#: decides a poisoned lane stays quarantined before the host healer may
+#: re-seed it from venue truth (per-lane param — array content, so a
+#: different cooldown never recompiles)
+DEFAULT_QUARANTINE_COOLDOWN = 8
+
+
 def tenant_params(n: int, trading=None, *, confidence_scale: float = 0.9,
-                  fee_rate: float = 0.001) -> dict:
+                  fee_rate: float = 0.001,
+                  quarantine_cooldown: int = DEFAULT_QUARANTINE_COOLDOWN,
+                  ) -> dict:
     """Struct-of-arrays tenant params ([N] numpy leaves) seeded from one
     `TradingParams` (every tenant identical — the load harness default);
     heterogeneous fleets overwrite individual rows.  ``confidence_scale``
@@ -120,19 +128,62 @@ def tenant_params(n: int, trading=None, *, confidence_scale: float = 0.9,
         "sl_override": full(np.nan),
         "tp_override": full(np.nan),
         "active": np.ones((n,), bool),
+        # fault containment (lane_quarantined gate): decides a poisoned
+        # lane sits quarantined before the healer may re-seed it
+        "cooldown_ticks": full(quarantine_cooldown, np.int32),
     }
 
 
 @functools.lru_cache(maxsize=8)
-def _tenant_program(partitioner):
+def _tenant_program(partitioner, containment: bool = True):
     """One cached decision program per Partitioner: the tenant axis splits
     over the mesh data axis (population_eval), features replicate, and
     every output all-gathers.  jit shape-keys on (N_pad, S) internally, so
-    one builder serves every engine size."""
+    one builder serves every engine size.
+
+    ``containment`` traces the per-lane poison detector: NaN/Inf anywhere
+    in a lane's slice of the donated state or its strategy params sets the
+    lane's quarantine bit (sticky — array content in the carry, never a
+    recompile) and every decision on that lane resolves to the
+    ``lane_quarantined`` gate, so a poisoned lane is masked out of
+    sizing/entry while its neighbors' scan carries stay bit-identical
+    (vmap gives lane independence; the gate keeps NaN sizes off the
+    host rim).  Per-SYMBOL feature poison stays the nan_gate's job —
+    features are fleet-shared, so they can never single out a lane.
+    ``containment=False`` compiles the predicates out entirely (the
+    bench's containment_overhead_pct probe)."""
 
     def fn(pop, feats):
         def one(st, pr):
             n_open0 = st["open"].astype(jnp.int32).sum()
+            # -- lane poison detector (fault containment) ---------------
+            # sl/tp_override are EXCLUDED: NaN there is the documented
+            # "no override" sentinel, not poison
+            isf = jnp.isfinite
+            lane_ok = (isf(st["balance"]) & isf(st["equity0"])
+                       & isf(st["peak_equity"]) & isf(st["max_drawdown"])
+                       & isf(st["entry"]).all() & isf(st["qty"]).all()
+                       & isf(st["sl"]).all() & isf(st["tp"]).all()
+                       & isf(pr["conf_threshold"]) & isf(pr["min_strength"])
+                       & isf(pr["min_trade"]) & isf(pr["conf_scale"])
+                       & isf(pr["fee_rate"]))
+            if containment:
+                poisoned = ~lane_ok
+                newly = poisoned & ~st["quarantined"]
+                quarantined = st["quarantined"] | poisoned
+                # the cooldown arms on the quarantine EDGE and counts
+                # decides from there (the poison itself persists in state
+                # until the healer re-seeds, so re-detection must not
+                # re-arm it); the healer waits for 0 before re-seeding
+                cooldown = jnp.where(
+                    newly, pr["cooldown_ticks"],
+                    jnp.maximum(st["cooldown"]
+                                - quarantined.astype(jnp.int32), 0))
+                q_pred = quarantined
+            else:
+                quarantined = st["quarantined"]
+                cooldown = st["cooldown"]
+                q_pred = jnp.bool_(False)
 
             def step(carry, xs):
                 n_open, bal = carry
@@ -152,6 +203,7 @@ def _tenant_program(partitioner):
                 fin = jnp.isfinite
                 # veto_reason's predicates, one per VETO_ORDER entry
                 preds = (
+                    q_pred,                             # lane_quarantined
                     (~(fin(price) & (price > 0.0))) | ~fin(conf)
                     | ~fin(strength) | ~fin(vol) | ~fin(avol),  # nan_gate
                     conf < pr["conf_threshold"],        # confidence_floor
@@ -221,6 +273,8 @@ def _tenant_program(partitioner):
                 "peak_equity": peak,
                 "max_drawdown": jnp.maximum(st["max_drawdown"],
                                             peak - equity),
+                "quarantined": quarantined,
+                "cooldown": cooldown,
             })
             return new_state, (ys, equity)
 
@@ -237,7 +291,8 @@ def _tenant_program(partitioner):
 
 
 @functools.lru_cache(maxsize=16)
-def _fleet_program(partitioner, top_k: int, s_real: int):
+def _fleet_program(partitioner, top_k: int, s_real: int,
+                   containment: bool = True):
     """The tenant program with the fleet observatory's aggregation traced
     INTO it (obs/fleetscope.py, the drift-PSI precedent): gate histogram,
     dispersion quantiles and the top-k rank table come out of the SAME
@@ -249,7 +304,7 @@ def _fleet_program(partitioner, top_k: int, s_real: int):
     symbol axis back to the engine's REAL universe before aggregating:
     pad columns are structurally NO_DECISION and would otherwise dilute
     the gate mix with phantom cells that vary with the pad width."""
-    inner = _tenant_program(partitioner)
+    inner = _tenant_program(partitioner, containment)
 
     def fn(pop, feats):
         res = inner(pop, feats)
@@ -260,6 +315,12 @@ def _fleet_program(partitioner, top_k: int, s_real: int):
             balance=st["balance"],
             max_drawdown=st["max_drawdown"],
             active=res["carry"]["params"]["active"],
+            # a poisoned lane's NaN PnL must not take the FLEET's
+            # dispersion quantiles/rank table down with it: quarantined
+            # lanes still land in the gate histogram (their
+            # lane_quarantined bin is the telemetry) but are masked out
+            # of every value aggregate — blast radius = the lane
+            quarantined=st["quarantined"],
             k=top_k)
         return res
 
@@ -281,7 +342,8 @@ class TenantEngine:
     def __init__(self, symbols, n_tenants: int, trading=None, *,
                  partitioner=None, quote_balance: float = 10_000.0,
                  confidence_scale: float = 0.9, fee_rate: float = 0.001,
-                 pad_pow2: bool = True):
+                 pad_pow2: bool = True, containment: bool = True,
+                 quarantine_cooldown: int = DEFAULT_QUARANTINE_COOLDOWN):
         from ai_crypto_trader_tpu.parallel import SingleDevicePartitioner
 
         self.symbols = list(symbols)
@@ -293,6 +355,8 @@ class TenantEngine:
         self.confidence_scale = float(confidence_scale)
         self.fee_rate = float(fee_rate)
         self.pad_pow2 = bool(pad_pow2)
+        self.containment = bool(containment)
+        self.quarantine_cooldown = int(quarantine_cooldown)
         self.trading = trading
         self.dispatch_count = 0
         self.full_seeds = 0
@@ -304,6 +368,11 @@ class TenantEngine:
         self.last_fleet: dict | None = None
         self.balance_resyncs = 0
         self._drift_pending = 0.0
+        # fault-containment accounting (lane_quarantined): lifetime
+        # counters like balance_resyncs — a reconfigure resets lane
+        # STATE, not the operator's history of the process
+        self.quarantine_trips = 0
+        self.heals_total = 0
         self.configure(n_tenants)
 
     # -- shape / state lifecycle ---------------------------------------------
@@ -319,7 +388,8 @@ class TenantEngine:
         N, S = self.n_pad, self.S
         self._params_np = tenant_params(
             N, self.trading, confidence_scale=self.confidence_scale,
-            fee_rate=self.fee_rate)
+            fee_rate=self.fee_rate,
+            quarantine_cooldown=self.quarantine_cooldown)
         self._params_np["active"][self.n_tenants:] = False
         self._state_np = {
             "open": np.zeros((N, S), bool),
@@ -336,6 +406,11 @@ class TenantEngine:
             "equity0": np.full((N,), self.quote_balance, np.float32),
             "peak_equity": np.full((N,), self.quote_balance, np.float32),
             "max_drawdown": np.zeros((N,), np.float32),
+            # fault containment: the quarantine bit + heal cooldown ride
+            # the donated carry as array CONTENT — a lane tripping (or
+            # healing) never changes the compiled shape
+            "quarantined": np.zeros((N,), bool),
+            "cooldown": np.zeros((N,), np.int32),
         }
         self._pop = None
         self._need_seed = True
@@ -520,15 +595,18 @@ class TenantEngine:
         aggregates and the same host_read carries them back."""
         t_step0 = time.perf_counter()
         fs = fleetscope.active()
-        fleet_key = (True, fs.top_k) if fs is not None else (False, 0)
+        fleet_key = ((True, fs.top_k, self.containment) if fs is not None
+                     else (False, 0, self.containment))
         if self._fleet_key is not None and fleet_key != self._fleet_key:
-            # toggling the observatory swaps in a different compiled
-            # program — a DECLARED recompile, never a sentinel page
+            # toggling the observatory (or containment) swaps in a
+            # different compiled program — a DECLARED recompile, never a
+            # sentinel page
             self._cold = True
         self._fleet_key = fleet_key
         program = (_fleet_program(self.partitioner, fs.top_k,
-                                  len(self.symbols))
-                   if fs is not None else _tenant_program(self.partitioner))
+                                  len(self.symbols), self.containment)
+                   if fs is not None
+                   else _tenant_program(self.partitioner, self.containment))
         upload_bytes = 0
         seeded = self._pop is None or self._need_seed
         if seeded:
@@ -586,7 +664,12 @@ class TenantEngine:
             raise
         # np.array COPIES: device_get may hand back read-only views, and
         # the mirror must stay mutable for venue-truth corrections
+        prev_q = self._state_np["quarantined"]
         self._state_np = {k: np.array(v) for k, v in host["state"].items()}
+        # quarantine TRIP edges (host accounting for the healer + alert):
+        # lanes whose bit rose in this dispatch
+        self.quarantine_trips += int(
+            (self._state_np["quarantined"] & ~prev_q).sum())
         if n_dev > 1 and self.n_pad % n_dev != 0:
             # ragged pop on a mesh: population_eval pads 100→104 and
             # SLICES the all-gathered outputs back, so the carry's
@@ -610,7 +693,10 @@ class TenantEngine:
             # long-corrected divergence as a fresh FleetBalanceDrift
             fs.observe_decide(self.last_fleet, tenants=n,
                               balance_drift=drift,
-                              balance_resyncs=self.balance_resyncs)
+                              balance_resyncs=self.balance_resyncs,
+                              quarantined=int(
+                                  self._state_np["quarantined"][:n].sum()),
+                              heals=self.heals_total)
         self.last_stats = {
             "dispatches": 1, "tenants": n, "tenant_pad": self.n_pad,
             "symbols": len(self.symbols), "symbol_pad": self.S,
@@ -679,3 +765,147 @@ class TenantEngine:
 
     def max_drawdowns(self) -> np.ndarray:
         return self._state_np["max_drawdown"][:self.n_tenants].copy()
+
+    # -- fault containment: quarantine views + the host healer ---------------
+    def quarantined_lanes(self) -> list[dict]:
+        """Per-lane quarantine ledger off the host mirror (refreshed by
+        the last decide): lane id, the gate it will resolve to, decides
+        of cooldown remaining before the healer may act.  O(quarantined
+        lanes), empty for a healthy fleet — `cli fleet`'s quarantine
+        column and the soak's assertions both read THIS."""
+        st = self._state_np
+        out = []
+        for i in np.nonzero(st["quarantined"][:self.n_tenants])[0]:
+            out.append({"lane": int(i), "gate": "lane_quarantined",
+                        "cooldown": int(st["cooldown"][i])})
+        return out
+
+    def heal_ready(self) -> list[int]:
+        """Lanes whose quarantine cooldown has expired — the set the rim
+        should re-seed from venue truth via :meth:`heal_lane`."""
+        st = self._state_np
+        mask = st["quarantined"][:self.n_tenants] \
+            & (st["cooldown"][:self.n_tenants] <= 0)
+        return [int(i) for i in np.nonzero(mask)[0]]
+
+    def heal_lane(self, i: int, *, balance: float,
+                  positions: dict | None = None) -> None:
+        """Re-seed one quarantined lane from VENUE TRUTH: the poisoned
+        state rows are discarded wholesale and rebuilt from the venue's
+        quote balance plus the executor's position book (``positions``
+        maps symbol -> (entry_price, quantity) for trades the venue
+        still holds).  The healed lane's PnL accounting re-bases here —
+        exactly a fresh `set_tenant` seed, which is what the heal-parity
+        test pins.  Array content only: a heal re-seeds the next
+        dispatch via transfer, never a recompile."""
+        st = self._state_np
+        st["open"][i] = False
+        st["pending"][i] = False
+        st["entry"][i] = 0.0
+        st["qty"][i] = 0.0
+        st["sl"][i] = 0.0
+        st["tp"][i] = 0.0
+        pos_value = 0.0
+        for sym, (entry, qty) in (positions or {}).items():
+            s = self.sym_index.get(sym)
+            if s is None:
+                continue
+            st["open"][i, s] = True
+            st["entry"][i, s] = np.float32(entry)
+            st["qty"][i, s] = np.float32(qty)
+            pos_value += float(entry) * float(qty)
+        st["balance"][i] = np.float32(balance)
+        equity = np.float32(float(balance) + pos_value)
+        st["equity0"][i] = equity
+        st["peak_equity"][i] = equity
+        st["max_drawdown"][i] = 0.0
+        st["quarantined"][i] = False
+        st["cooldown"][i] = 0
+        # a poisoned PARAM row would re-trip on the next dispatch: any
+        # non-finite strategy param rolls back to the fleet default
+        fresh = tenant_params(
+            1, self.trading, confidence_scale=self.confidence_scale,
+            fee_rate=self.fee_rate,
+            quarantine_cooldown=self.quarantine_cooldown)
+        for k, v in self._params_np.items():
+            if (np.issubdtype(v.dtype, np.floating)
+                    and k not in ("sl_override", "tp_override")
+                    and not np.isfinite(v[i])):
+                v[i] = fresh[k][0]
+        self.heals_total += 1
+        self._need_seed = True
+
+    # -- durable fleet state: snapshot + restore -----------------------------
+    def snapshot(self) -> dict:
+        """The [N] lane-state mirror (already refreshed by the last
+        decide's one host_read — snapshotting costs ZERO extra syncs) as
+        a WAL-able payload: every array packed with its own checksum
+        (utils/journal.pack_array), plus the identity the restore path
+        validates against."""
+        from ai_crypto_trader_tpu.utils.journal import pack_array
+
+        return {
+            "version": 1,
+            "n_tenants": self.n_tenants,
+            "symbols": list(self.symbols),
+            "dispatches": self.dispatch_count,
+            "counters": {"balance_resyncs": self.balance_resyncs,
+                         "quarantine_trips": self.quarantine_trips,
+                         "heals_total": self.heals_total},
+            "state": {k: pack_array(v) for k, v in self._state_np.items()},
+            "params": {k: pack_array(v) for k, v in self._params_np.items()},
+        }
+
+    def restore(self, payload: dict) -> dict:
+        """Rebuild the lane mirrors from a :meth:`snapshot` payload (the
+        PR 5 `recover()` matrix extended to vmapped mode).  Validates the
+        symbol universe, re-shapes the tenant axis if it drifted, and
+        unpacks every checksummed array; the next dispatch re-seeds from
+        the restored mirror (a transfer — and a declared-cold compile
+        only if the axis width actually changed).  The caller then
+        reconciles lane-by-lane against venue truth (`sync_positions` /
+        `sync_balance` / the executor's per-lane `ld<i>-` journal
+        namespaces) — restore is the state floor, the venue is the
+        authority.  Returns restore stats for the recovery report."""
+        from ai_crypto_trader_tpu.utils.journal import unpack_array
+
+        if payload.get("version") != 1:
+            raise ValueError(f"unknown fleet snapshot version: "
+                             f"{payload.get('version')!r}")
+        if list(payload.get("symbols") or []) != self.symbols:
+            raise ValueError("fleet snapshot symbol universe does not "
+                             "match this engine")
+        n = int(payload["n_tenants"])
+        if n != self.n_tenants:
+            self.configure(n)
+        state = {k: unpack_array(v) for k, v in payload["state"].items()}
+        params = {k: unpack_array(v) for k, v in payload["params"].items()}
+        for name, mirror, restored in (("state", self._state_np, state),
+                                       ("params", self._params_np, params)):
+            missing = set(mirror) - set(restored)
+            if missing:
+                raise ValueError(f"fleet snapshot {name} misses "
+                                 f"{sorted(missing)}")
+            for k, v in restored.items():
+                if k in mirror and v.shape != mirror[k].shape:
+                    raise ValueError(
+                        f"fleet snapshot {name}[{k}] shape {v.shape} != "
+                        f"engine {mirror[k].shape}")
+        # known leaves restore verbatim; leaves a NEWER snapshot carries
+        # that this engine doesn't know are dropped, not injected
+        self._state_np.update({k: v for k, v in state.items()
+                               if k in self._state_np})
+        self._params_np.update({k: v for k, v in params.items()
+                                if k in self._params_np})
+        counters = payload.get("counters") or {}
+        self.balance_resyncs = int(counters.get("balance_resyncs", 0))
+        self.quarantine_trips = int(counters.get("quarantine_trips", 0))
+        self.heals_total = int(counters.get("heals_total", 0))
+        self._need_seed = True
+        return {
+            "lanes": n,
+            "open_positions": self.open_positions(),
+            "quarantined": int(
+                self._state_np["quarantined"][:n].sum()),
+            "snapshot_dispatches": int(payload.get("dispatches", 0)),
+        }

@@ -88,6 +88,13 @@ class TradingSystem:
     # write-ahead-journals every order intent/ack/closure here, and
     # `recover()` replays + reconciles it after a restart.
     journal_path: str | None = None
+    # Durable FLEET state (utils/journal.py SnapshotJournal): when set
+    # and a vmapped TenantEngine is attached (attach_tenant_engine),
+    # `fleet_checkpoint()` writes periodic checksummed snapshots of the
+    # [N] lane-state mirror here and `recover()` restores the newest
+    # intact one before per-lane venue reconciliation — the journal_path
+    # story extended from one object lane to the whole batch axis.
+    fleet_journal_path: str | None = None
     # Decision provenance & model quality (obs/): the flight recorder is
     # DEFAULT-ON — one compact record per (symbol, tick) decision in a
     # bounded ring (dashboard /decisions, `cli why`); `flightrec_path`
@@ -251,6 +258,13 @@ class TradingSystem:
 
             self.journal = WriteAheadJournal(self.journal_path,
                                              now_fn=self.now_fn)
+        self.tenant_engine = None          # via attach_tenant_engine
+        self.fleet_journal = None
+        if self.fleet_journal_path:
+            from ai_crypto_trader_tpu.utils.journal import SnapshotJournal
+
+            self.fleet_journal = SnapshotJournal(self.fleet_journal_path,
+                                                 now_fn=self.now_fn)
         self.executor = TradeExecutor(self.bus, self.exchange,
                                       trading=self.config.trading,
                                       trailing=self.config.risk.trailing_stop,
@@ -295,6 +309,24 @@ class TradingSystem:
             quarantine_s=self.stage_quarantine_s)
         self.heartbeats.expect("stream")
 
+    def attach_tenant_engine(self, engine) -> None:
+        """Register a vmapped ops/tenant_engine.TenantEngine with this
+        system's durability rim: `fleet_checkpoint()` snapshots its [N]
+        lane-state mirror into the fleet journal and `recover()` restores
+        the newest intact snapshot before per-lane reconciliation."""
+        self.tenant_engine = engine
+
+    def fleet_checkpoint(self) -> int | None:
+        """Durably snapshot the attached tenant engine's lane mirror as
+        one checksummed WAL record (bounded by the snapshot journal's
+        compaction).  ZERO extra device syncs: the mirror is already
+        host-side after each decide's one host_read.  Returns the
+        snapshot record's sequence number, or None when no engine/journal
+        is wired."""
+        if self.tenant_engine is None or self.fleet_journal is None:
+            return None
+        return self.fleet_journal.write(self.tenant_engine.snapshot())
+
     async def recover(self, journal_path: str | None = None) -> dict:
         """Restart recovery: replay the write-ahead journal into the
         executor's books, reconcile against exchange ground truth
@@ -311,6 +343,21 @@ class TradingSystem:
         if journal is None:
             raise ValueError("recover() needs a journal_path (ctor or arg)")
         report = await self.executor.recover_from_journal(journal)
+        if self.tenant_engine is not None and self.fleet_journal is not None:
+            # fleet restore rides the same recovery pass: newest intact
+            # snapshot (torn tails fall back to the previous one) rebuilds
+            # the [N] lane mirrors; venue truth then re-anchors lane by
+            # lane through the sync_positions/sync_balance seams exactly
+            # as it does every steady-state tick
+            from ai_crypto_trader_tpu.utils.journal import load_snapshot
+
+            payload, snap_stats = load_snapshot(self.fleet_journal.path)
+            if payload is not None:
+                fleet = self.tenant_engine.restore(payload)
+                fleet["snapshot_torn_tail"] = snap_stats["torn_tail"]
+                report["fleet"] = fleet
+                self.log.info("restored fleet state from snapshot",
+                              journal=self.fleet_journal.path, **fleet)
         # replayed closures were logged by the previous process — only NEW
         # closures from here on produce structured trade-closed lines
         self._logged_closures = len(self.executor.closed_trades)
@@ -904,6 +951,8 @@ class TradingSystem:
                 tickpath_mod.disable()
         if self.journal is not None:
             self.journal.close()           # flush the buffered tail
+        if self.fleet_journal is not None:
+            self.fleet_journal.close()
         if self.flightrec is not None:
             self.flightrec.close()         # flush the decision JSONL tail
         if self.stream is not None:

@@ -32,6 +32,30 @@ from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
 #: fault kinds ChaosExchange understands, and the calls they apply to
 READ_FAULTS = ("error", "latency", "stale", "partial", "malformed")
 ORDER_FAULTS = ("error", "crash_after_order")
+#: reads that can serve NaN/Inf payloads (the lane-poisoning input the
+#: tenant engine's quarantine detector exists for)
+POISON_FAULTS = ("poison",)
+
+#: ExchangeInterface methods deliberately NOT routed through _fault.
+#: Empty ON PURPOSE: every interface method today is fault-eligible, and
+#: the drift test (tests/test_chaos.py) fails when a newly added adapter
+#: method is neither wired through _fault nor explicitly listed here —
+#: the __getattr__ passthrough can no longer silently exempt new surface.
+FAULT_EXEMPT: frozenset = frozenset()
+
+
+def lane_of_coid(client_order_id: str | None) -> int | None:
+    """Lane index from a client-order-id in the load harness's per-lane
+    namespace (``ld<i>-<tag>-<symbol>-<seq>``) — the key per-lane fault
+    targeting routes on.  None for foreign namespaces (``wj-`` object
+    lanes, venue-generated ids)."""
+    if not client_order_id or not client_order_id.startswith("ld"):
+        return None
+    head = client_order_id.split("-", 1)[0]
+    try:
+        return int(head[2:])
+    except ValueError:
+        return None
 
 
 class SimulatedCrash(BaseException):
@@ -54,10 +78,17 @@ class FaultSchedule:
     """
 
     def __init__(self, seed: int = 0, rates: dict | None = None,
-                 script: dict | None = None):
+                 script: dict | None = None,
+                 outages: tuple = ()):
         self.rng = random.Random(seed)
         self.rates = dict(rates or {})
         self.script = dict(script or {})
+        # venue outage windows: (start_call, end_call) half-open ranges of
+        # the shared call counter during which EVERY error-eligible call
+        # fails — a lane handed an outage-bearing schedule sees its venue
+        # down for a deterministic stretch while the rest of the fleet
+        # keeps trading
+        self.outages = tuple(tuple(w) for w in outages)
         self.calls = 0
         self.injected: list = []          # (call_index, method, fault) log
 
@@ -65,6 +96,8 @@ class FaultSchedule:
         idx = self.calls
         self.calls += 1
         fault = self.script.get(idx)
+        if fault is None and any(a <= idx < b for a, b in self.outages):
+            fault = "error"
         if fault is None:
             # one draw per call regardless of eligibility → the fault
             # sequence is stable when eligibility sets differ per method
@@ -92,12 +125,23 @@ class ChaosExchange(ExchangeInterface):
 
     def __init__(self, inner: ExchangeInterface, schedule: FaultSchedule,
                  sleep: Callable[[float], None] = lambda s: None,
-                 latency_s: float = 2.0):
+                 latency_s: float = 2.0, lane: int | None = None,
+                 lane_schedules: dict | None = None):
         self.inner = inner
         self.schedule = schedule
         self._sleep = sleep
         self.latency_s = latency_s
         self._kline_cache: dict = {}
+        # per-lane fault targeting (the vmapped fleet's blast-radius
+        # harness): ``lane`` tags a per-lane venue wrapper, and
+        # ``lane_schedules`` maps lane -> its own FaultSchedule.  A tagged
+        # wrapper with a lane schedule routes EVERY call through it;
+        # additionally, order mutations carrying an ``ld<i>-`` client id
+        # route to that lane's schedule even on a shared wrapper — faults
+        # follow the client-order-id namespace, so "lane 3's venue is
+        # broken" is expressible without touching lanes 0-2.
+        self.lane = lane
+        self.lane_schedules = dict(lane_schedules or {})
 
     def __getattr__(self, name):
         if name == "inner":
@@ -105,8 +149,15 @@ class ChaosExchange(ExchangeInterface):
         return getattr(self.inner, name)
 
     # --- fault plumbing ----------------------------------------------------
+    def _sched(self, client_order_id: str | None = None) -> FaultSchedule:
+        lane = (lane_of_coid(client_order_id)
+                if client_order_id is not None else self.lane)
+        if lane is None:
+            lane = self.lane
+        return self.lane_schedules.get(lane, self.schedule)
+
     def _fault(self, method: str, eligible: tuple = READ_FAULTS):
-        fault = self.schedule.next_fault(method, eligible)
+        fault = self._sched().next_fault(method, eligible)
         if fault == "latency":
             self._sleep(self.latency_s)   # spike, then the call succeeds
             return None
@@ -116,8 +167,17 @@ class ChaosExchange(ExchangeInterface):
 
     # --- reads -------------------------------------------------------------
     def get_ticker(self, symbol):
-        self._fault("get_ticker", ("error", "latency"))
-        return self.inner.get_ticker(symbol)
+        fault = self._fault("get_ticker",
+                            ("error", "latency") + POISON_FAULTS)
+        out = self.inner.get_ticker(symbol)
+        if fault == "poison" and isinstance(out, dict):
+            # NaN price: the payload poison a lane's mirror ingests if the
+            # rim trusts the venue read blindly — the quarantine gate's prey
+            out = dict(out)
+            for k in ("price", "lastPrice", "last"):
+                if k in out:
+                    out[k] = float("nan")
+        return out
 
     def get_order_book(self, symbol, limit=20):
         self._fault("get_order_book", ("error", "latency"))
@@ -143,8 +203,12 @@ class ChaosExchange(ExchangeInterface):
         return rows
 
     def get_balances(self):
-        self._fault("get_balances", ("error", "latency"))
-        return self.inner.get_balances()
+        fault = self._fault("get_balances",
+                            ("error", "latency") + POISON_FAULTS)
+        out = self.inner.get_balances()
+        if fault == "poison" and isinstance(out, dict):
+            out = {k: float("nan") for k in out} or {"USDC": float("nan")}
+        return out
 
     def order_is_open(self, symbol, order_id):
         self._fault("order_is_open", ("error",))
@@ -168,12 +232,16 @@ class ChaosExchange(ExchangeInterface):
         return self.inner.list_open_orders(symbol)
 
     def list_symbols(self, quote=None):
+        # previously a bare passthrough — the exact drift the FAULT_EXEMPT
+        # registry + drift test now make impossible to reintroduce
+        self._fault("list_symbols", ("error", "latency"))
         return self.inner.list_symbols(quote)
 
     # --- mutations ---------------------------------------------------------
     def place_order(self, symbol, side, order_type, quantity, price=None,
                     stop_price=None, client_order_id=None):
-        fault = self.schedule.next_fault("place_order", ORDER_FAULTS)
+        fault = self._sched(client_order_id).next_fault("place_order",
+                                                        ORDER_FAULTS)
         if fault == "error":
             # clean failure: the request never reached the venue
             raise ConnectionError("chaos: order lost before the venue")
@@ -188,7 +256,7 @@ class ChaosExchange(ExchangeInterface):
         return out
 
     def cancel_order(self, symbol, order_id):
-        fault = self.schedule.next_fault("cancel_order", ("error",))
+        fault = self._sched().next_fault("cancel_order", ("error",))
         if fault == "error":
             raise ConnectionError("chaos: injected cancel failure")
         return self.inner.cancel_order(symbol, order_id)
@@ -396,6 +464,30 @@ class CountingKlines:
         if name == "inner":
             raise AttributeError(name)
         return getattr(self.inner, name)
+
+
+def poison_lane_state(engine, lane: int, field: str = "balance",
+                      value: float = float("nan")) -> None:
+    """Inject NaN/Inf into ONE lane's slice of the tenant engine's donated
+    state mirror (the per-lane poison the in-program quarantine detector
+    exists for — a corrupted venue read the rim wrote through, a bad
+    hot-patch, bit rot).  Array content: the next decide re-seeds and the
+    detector trips that lane's `lane_quarantined` gate while every other
+    lane stays bit-identical."""
+    import numpy as np
+
+    arr = engine._state_np[field]
+    arr[lane] = value if arr.ndim == 1 else np.full(arr.shape[1:], value)
+    engine._need_seed = True
+
+
+def poison_lane_params(engine, lane: int, field: str = "conf_threshold",
+                       value: float = float("nan")) -> None:
+    """Inject NaN/Inf into one lane's strategy-param row — the config-push
+    poison path (a bad per-tenant override).  Same containment contract as
+    :func:`poison_lane_state`."""
+    engine._params_np[field][lane] = value
+    engine._need_seed = True
 
 
 def torn_tail(path: str, keep_bytes: int = 17) -> None:

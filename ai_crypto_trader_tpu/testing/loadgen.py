@@ -78,8 +78,10 @@ from ai_crypto_trader_tpu.shell.stream import (
 )
 from ai_crypto_trader_tpu.testing.chaos import CountingKlines, kline_frames_for
 from ai_crypto_trader_tpu.utils.health import EventLoopLagProbe
+from ai_crypto_trader_tpu.utils.journal import SnapshotJournal
 from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
 from ai_crypto_trader_tpu.utils.saturation import SaturationMonitor
+from ai_crypto_trader_tpu.utils.supervision import StageBreaker
 
 
 @dataclass
@@ -126,6 +128,19 @@ class LoadConfig:
     # (the flight-recorder journal format) — `cli why SYMBOL --lane N
     # --file PATH` reads it back offline.
     flightrec_path: str | None = None
+    # Fault containment (vmapped mode): trace the per-lane NaN/Inf
+    # quarantine predicates into the decide program (OFF measures the
+    # bare program — the bench capacity row's containment_overhead_pct
+    # probe), and run the host healer that re-seeds cooled-down
+    # quarantined lanes from venue truth each tick.
+    containment: bool = True
+    heal: bool = True
+    # Durable fleet state: periodic checksummed snapshots of the [N]
+    # lane-state mirror in the WAL snapshot format (utils/journal.py
+    # SnapshotJournal — bounded by compaction).  The kill-and-restart
+    # soak restores from this + the per-lane ld<i>- journal namespaces.
+    fleet_journal_path: str | None = None
+    fleet_snapshot_every: int = 4     # decided ticks between snapshots
 
 
 @dataclass
@@ -207,6 +222,20 @@ class SyntheticTenantTraffic:
         self.latencies_ms: list[float] = []
         self.published = self.analyzed = self.executed = 0
         self._seed_rest_calls = 0
+        # durable fleet state + dispatch-level degradation (vmapped):
+        # snapshots of the [N] mirror ride the WAL snapshot format; a
+        # failed fused dispatch trips the breaker → retry from the last
+        # good mirror → degrade the sampled lanes to the object parity
+        # path (the PR 9 degrade-then-hand-back ladder at fleet scope)
+        self.fleet_journal = (SnapshotJournal(cfg.fleet_journal_path,
+                                              now_fn=self._now)
+                              if cfg.mode == "vmapped"
+                              and cfg.fleet_journal_path else None)
+        self._snap_due = 0
+        self.engine_breaker = StageBreaker(
+            "tenant_engine", max_failures=2,
+            base_backoff_s=cfg.tick_step_s, quarantine_s=4 * cfg.tick_step_s)
+        self.degraded_ticks = 0
         self.set_tenants(cfg.tenants)
 
     def _now(self) -> float:
@@ -256,7 +285,8 @@ class SyntheticTenantTraffic:
                 self._updates_q = self.bus.subscribe("market_updates")
             if self.tenant_engine is None:
                 self.tenant_engine = TenantEngine(
-                    self.symbols, n, trading=self.cfg.trading)
+                    self.symbols, n, trading=self.cfg.trading,
+                    containment=self.cfg.containment)
             else:
                 self.tenant_engine.configure(n, trading=self.cfg.trading)
         else:
@@ -266,9 +296,12 @@ class SyntheticTenantTraffic:
 
     def close(self) -> None:
         """Flush/close the sampled-provenance journal (a batched veto
-        tail must land on disk before `cli why --file` reads it)."""
+        tail must land on disk before `cli why --file` reads it) and the
+        fleet snapshot journal."""
         if self.flightrec is not None:
             self.flightrec.close()
+        if self.fleet_journal is not None:
+            self.fleet_journal.close()
 
     def reset_measurement(self) -> None:
         """Start a fresh measurement window: latencies, throughput
@@ -327,7 +360,31 @@ class SyntheticTenantTraffic:
                                         tick_eng.last_valid, due_mask=due)
         else:                        # per-symbol monitor path fallback
             feats = eng.feats_from_updates(updates)
-        out = eng.decide(feats)
+        # dispatch-level degradation ladder: a failed/aborted fused
+        # dispatch (XLA error, transfer-guard abort) retries ONCE from
+        # the last good host mirror (decide's abort path flags the
+        # re-seed — the donated carry is unknown, the mirror is
+        # authoritative); a second failure feeds the tenant_engine
+        # breaker and this tick degrades to the object parity path.
+        # Once the breaker quarantines, the dispatch is only probed on
+        # its quarantine cadence and every other tick degrades.
+        brk, now = self.engine_breaker, self._now()
+        out = None
+        if brk.should_run(now):
+            try:
+                out = eng.decide(feats)
+                brk.record_success(now)
+            except Exception as e:             # noqa: BLE001
+                brk.record_failure(now, repr(e))
+                try:
+                    out = eng.decide(feats)    # retry from the mirror
+                    brk.record_success(now)
+                except Exception as e2:        # noqa: BLE001
+                    brk.record_failure(now, repr(e2))
+        if out is None:
+            self.degraded_ticks += 1
+            self.metrics.inc("fleet_degraded_ticks_total")
+            return await self._vm_degraded(updates)
         if self.cfg.engine_lag_s:
             time.sleep(self.cfg.engine_lag_s)        # BLOCKING on purpose
         self.analyzed += eng.n_tenants * len(updates)
@@ -415,6 +472,82 @@ class SyntheticTenantTraffic:
                 else:
                     self._pending_rids[(n, s)] = rid
 
+    async def _vm_degraded(self, updates: dict) -> set[int]:
+        """The breaker's degraded mode: with the fused dispatch down, the
+        SAMPLED lanes fall back to the object-lane parity path — raw
+        market updates fan out as analyzer-style signals and each lane
+        executor's OWN veto_reason gates them (the PR 10 baseline,
+        gate-for-gate).  Unsampled lanes pause (no decisions) rather
+        than trade without their device state: bounded service beats
+        unbounded risk.  Hand-back is automatic — the breaker's next
+        successful probe resumes the fused path, and the engine re-seeds
+        from its mirror (venue truth re-anchored it all along via
+        `_vm_reconcile`)."""
+        fs = fleetscope.active()
+        eng = self.tenant_engine
+        lanes = (fs.sample_lanes(eng.n_tenants) if fs is not None
+                 else sorted(self._vm_lanes))
+        dirty: set[int] = set()
+        for n in lanes:
+            lane = self._vm_lane(n)
+            for sym, u in updates.items():
+                strength = float(u.get("signal_strength", 0.0) or 0.0)
+                signal = {
+                    "symbol": sym, "timestamp": self._now(),
+                    "current_price": u.get("current_price"),
+                    "signal": u.get("signal", "NEUTRAL"),
+                    "signal_strength": strength,
+                    "volatility": u.get("volatility", 0.0),
+                    "avg_volume": u.get("avg_volume", 0.0),
+                    # the deterministic analyzer verdict
+                    # (TechnicalPolicyBackend): the executor's gates veto
+                    # from here exactly as they do for object lanes
+                    "decision": ("BUY" if u.get("signal") == "BUY"
+                                 else "HOLD"),
+                    "confidence": round(min(strength / 100.0, 1.0) * 0.9,
+                                        3),
+                    "reasoning": "degraded: fused dispatch quarantined",
+                    "model_version": None,
+                    "lane": lane.name,
+                }
+                await self.bus.publish(f"trading_signals.{lane.name}",
+                                       signal)
+            self.analyzed += len(updates)
+            dirty.add(n)
+        return dirty
+
+    def _vm_heal(self) -> None:
+        """The host healer: quarantined lanes whose cooldown expired
+        re-seed from VENUE TRUTH — the lane venue's quote balance plus
+        the lane executor's surviving position book.  A lane whose venue
+        read is itself non-finite or failing (poisoned/out venue — the
+        chaos harness makes both) stays quarantined: healing from poison
+        would re-trip the detector on the very next dispatch."""
+        eng = self.tenant_engine
+        for n in eng.heal_ready():
+            lane = self._vm_lane(n)
+            try:
+                bal = float(lane.venue.get_balances().get("USDC", 0.0))
+            except Exception:                  # noqa: BLE001
+                continue                       # venue down — next tick
+            positions = {sym: (float(t.entry_price), float(t.quantity))
+                         for sym, t in lane.executor.active_trades.items()}
+            vals = [bal] + [v for eq in positions.values() for v in eq]
+            if not np.isfinite(vals).all():
+                continue                       # venue truth is poisoned
+            eng.heal_lane(n, balance=bal, positions=positions)
+
+    def _fleet_snapshot(self) -> None:
+        """Periodic durable snapshot of the [N] lane mirror (the mirror
+        is already host-side after the decide's one host_read — zero
+        extra syncs), bounded by the journal's compaction."""
+        if self.fleet_journal is None or self.tenant_engine is None:
+            return
+        self._snap_due += 1
+        if self._snap_due >= max(self.cfg.fleet_snapshot_every, 1):
+            self._snap_due = 0
+            self.fleet_journal.write(self.tenant_engine.snapshot())
+
     def _vm_reconcile(self) -> None:
         """Venue truth wins, per MATERIALIZED tenant: the engine's open
         set re-anchors on the executor's books (an entry that never
@@ -432,9 +565,14 @@ class SyntheticTenantTraffic:
             # doing its job (sale proceeds the engine's entry model never
             # sees) — `expected` exempts it from the FleetBalanceDrift
             # accounting; an UNEXPLAINED divergence still counts
-            self.tenant_engine.sync_balance(
-                n, lane.venue.get_balances().get("USDC", 0.0),
-                expected=closed)
+            try:
+                balance = lane.venue.get_balances().get("USDC", 0.0)
+            except Exception:
+                # that lane's venue is down: keep the mirror's last truth
+                # rather than failing the whole fleet's reconcile pass —
+                # the lane re-anchors on the next healthy read
+                continue
+            self.tenant_engine.sync_balance(n, balance, expected=closed)
 
     # -- one tick -------------------------------------------------------------
     async def tick(self, timed: bool = True) -> float:
@@ -472,6 +610,9 @@ class SyntheticTenantTraffic:
                     if cfg.executor_lag_s:
                         time.sleep(cfg.executor_lag_s)
                 self._vm_reconcile()
+                if cfg.heal:
+                    self._vm_heal()
+                self._fleet_snapshot()
         else:
             with sat.stage("analyzer"):
                 for lane in self.lanes:
@@ -516,8 +657,22 @@ class SyntheticTenantTraffic:
         lat = np.asarray(self.latencies_ms or [0.0])
         fs = fleetscope.active()
         fleet = (fs.status() if fs is not None and fs.decides else None)
+        eng = self.tenant_engine
+        containment = None
+        if eng is not None:
+            containment = {
+                "enabled": eng.containment,
+                "quarantined": eng.quarantined_lanes(),
+                "quarantine_trips": eng.quarantine_trips,
+                "heals_total": eng.heals_total,
+                "degraded_ticks": self.degraded_ticks,
+                "engine_breaker": self.engine_breaker.state(),
+                "snapshots": (self.fleet_journal.writes
+                              if self.fleet_journal is not None else 0),
+            }
         return {
             **({"fleet": fleet} if fleet else {}),
+            **({"containment": containment} if containment else {}),
             "tenants": cfg.tenants, "symbols": cfg.symbols,
             "lanes": cfg.tenants * cfg.symbols,
             "mode": cfg.mode,

@@ -204,6 +204,12 @@ def default_rules() -> list[AlertRule]:
                   "engine-mirror balance diverged from venue truth "
                   "beyond the re-anchor budget with no explaining "
                   "closure (fee-model error or mirror corruption)"),
+        AlertRule("FleetLaneQuarantined", "warning",
+                  lambda s: s.get("fleet_quarantined_lanes", 0) > 0,
+                  "lanes quarantined by the in-program poison detector "
+                  "(NaN/Inf in lane state or params) — masked out of "
+                  "sizing/entry until the host healer re-seeds them "
+                  "from venue truth"),
     ]
 
 

@@ -177,7 +177,9 @@ def append_history(rows: list, path: str | None = None,
               "BENCH_LOAD_SLO_MS",
               "BENCH_GA_T", "BENCH_GA_POP", "BENCH_GA_GENS",
               "BENCH_LOB_SCENARIOS", "BENCH_LOB_STEPS", "BENCH_LOB_LEVELS",
-              "BENCH_COLDSTART_TICKS")
+              "BENCH_COLDSTART_TICKS",
+              "BENCH_FLEET_TENANTS", "BENCH_FLEET_SYMBOLS",
+              "BENCH_FLEET_TICKS")
              if os.environ.get(k)}
     with open(path, "a", encoding="utf-8") as f:
         for row in rows:
@@ -865,6 +867,74 @@ def bench_recovery():
          journal_records=n_records)
 
 
+def bench_fleet_recovery():
+    """Target row: fleet restart time — the newest checksummed snapshot
+    of the vmapped [N] tenant mirror loaded from the WAL-format fleet
+    journal and restored into a FRESH TenantEngine (utils/journal.py
+    SnapshotJournal + ops/tenant_engine.py restore()): the cost a fleet
+    host pays between process death and being ready to re-seed the first
+    post-crash dispatch, at BENCH_FLEET_TENANTS lanes."""
+    import tempfile
+
+    import numpy as np
+
+    from ai_crypto_trader_tpu.ops.tenant_engine import TenantEngine
+    from ai_crypto_trader_tpu.utils.journal import (
+        SnapshotJournal,
+        load_snapshot,
+    )
+
+    n = int(os.environ.get("BENCH_FLEET_TENANTS", "256"))
+    n_syms = int(os.environ.get("BENCH_FLEET_SYMBOLS", "4"))
+    ticks = int(os.environ.get("BENCH_FLEET_TICKS", "4"))
+    syms = [f"F{i:03d}USDC" for i in range(n_syms)]
+    eng = TenantEngine(syms, n)
+    rng = np.random.default_rng(17)
+    S = eng.S
+
+    def feats():
+        return {
+            "price": rng.uniform(10.0, 500.0, S).astype(np.float32),
+            "signal": rng.integers(-1, 2, S).astype(np.int32),
+            "strength": rng.uniform(0.0, 120.0, S).astype(np.float32),
+            "volatility": rng.uniform(0.0, 0.05, S).astype(np.float32),
+            "avg_volume": rng.uniform(1e3, 1.2e5, S).astype(np.float32),
+            "valid": np.ones(S, bool),
+        }
+
+    for _ in range(ticks):                  # real positions + drawdown in
+        eng.decide(feats())                 # the mirror, not a blank fleet
+
+    with tempfile.TemporaryDirectory() as td:
+        journal = SnapshotJournal(os.path.join(td, "fleet.journal"))
+        for _ in range(3):                  # realistic depth: stale
+            journal.write(eng.snapshot())   # checkpoints behind the
+        journal.close()                     # newest one
+
+        t0 = time.perf_counter()
+        payload, stats = load_snapshot(journal.path)
+        fresh = TenantEngine(syms, n)
+        report = fresh.restore(payload)
+        ms = (time.perf_counter() - t0) * 1e3
+        # first post-restore dispatch stamped separately: it re-seeds the
+        # donated device state from the restored mirror (a transfer, not
+        # a recompile — the program cache is keyed on shapes, unchanged)
+        t0 = time.perf_counter()
+        out = fresh.decide(feats())
+        first_ms = (time.perf_counter() - t0) * 1e3
+    assert out["gate"] is not None         # the fleet decided post-restore
+    log(f"fleet recovery: {report['lanes']} lanes "
+        f"({report['open_positions']} open positions, "
+        f"{report['quarantined']} quarantined) restored from snapshot "
+        f"seq {stats['replayed']} in {ms:.1f} ms "
+        f"(+{first_ms:.1f} ms first re-seeded dispatch)")
+    emit("fleet_recovery_ms", ms, "ms", None, tenants=n, symbols=n_syms,
+         open_positions=report["open_positions"],
+         snapshot_records=stats["replayed"],
+         snapshot_dispatches=report["snapshot_dispatches"],
+         first_dispatch_ms=round(first_ms, 3))
+
+
 def bench_nn():
     """BASELINE row: NN train step time (batch 32 × seq 60, LSTM-64).
 
@@ -1389,6 +1459,22 @@ def bench_capacity():
     log(f"capacity: fleetscope overhead at N={n_star}: on {on_ms:.2f} ms "
         f"vs off {off_ms:.2f} ms p50 → {fleet_overhead:.2f}% "
         f"(budget 5%)")
+
+    # containment overhead probe (ops/tenant_engine.py quarantine
+    # predicates): same back-to-back shape as the fleetscope probe —
+    # the rep_off run above already measured fleetscope-off with
+    # containment ON (the production default), so pair it against one
+    # more run with the traced poison detector compiled OUT.  Same ≤5%
+    # budget: a default-on fault detector must pay for itself in the
+    # vmapped dispatch, not just in prose.
+    rep_con_off = run_load(_replace(probe, fleetscope=False,
+                                    containment=False))
+    con_on_ms, con_off_ms = off_ms, rep_con_off["p50_ms"]
+    con_overhead = (max((con_on_ms - con_off_ms) / con_off_ms * 100.0, 0.0)
+                    if con_off_ms else 0.0)
+    log(f"capacity: containment overhead at N={n_star}: on "
+        f"{con_on_ms:.2f} ms vs off {con_off_ms:.2f} ms p50 → "
+        f"{con_overhead:.2f}% (budget 5%)")
     emit("capacity", float(vm_lanes), "tenant_symbols", None,
          mode="vmapped", tenants_cap=vm_tenants,
          tenants=best_vm.get("tenants", 0), symbols=symbols,
@@ -1404,7 +1490,10 @@ def bench_capacity():
          fleetscope_overhead_pct=round(fleet_overhead, 3),
          fleetscope_on_p50_ms=round(on_ms, 3),
          fleetscope_off_p50_ms=round(off_ms, 3),
-         fleetscope_probe_tenants=n_star)
+         fleetscope_probe_tenants=n_star,
+         containment_overhead_pct=round(con_overhead, 3),
+         containment_on_p50_ms=round(con_on_ms, 3),
+         containment_off_p50_ms=round(con_off_ms, 3))
 
 
 def bench_flightrec():
@@ -1824,6 +1913,7 @@ def run_worker():
         ("lob", bench_lob),
         ("nn", bench_nn),
         ("recovery", bench_recovery),
+        ("fleet_recovery", bench_fleet_recovery),
     ]
     for name, fn in secondary:
         if not want(name):

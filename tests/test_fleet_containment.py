@@ -1,0 +1,722 @@
+"""Fault-contained fleet (ISSUE 17): in-program lane quarantine, durable
+vmapped state, and fleet-scale chaos.
+
+Covers:
+  * the `lane_quarantined` gate vocabulary (obs/flightrec.py): appended to
+    GATES, FIRST in VETO_ORDER — quarantine outranks every other veto;
+  * in-program containment (ops/tenant_engine.py): NaN/Inf in one lane's
+    state or param slice trips the traced detector, masks the lane out of
+    sizing/entry, and leaves every healthy lane BIT-IDENTICAL to a run
+    without the poisoned neighbor (N=8 and N=1000); the NaN sl/tp
+    overrides (the documented "no override" sentinel) never trip it;
+  * the quarantine lifecycle: edge-armed cooldown (one trip counted, the
+    detector re-fires without re-arming), heal_ready after expiry, and
+    the HEAL-PARITY pin — a healed lane equals a fresh venue-truth seed;
+  * durable fleet state (utils/journal.py SnapshotJournal +
+    TenantEngine.snapshot/restore): checksummed JSON roundtrip is
+    bit-identical, torn snapshot tails fall back to the previous intact
+    checkpoint, per-array CRCs catch bit rot, identity mismatches raise;
+  * the one-dispatch/one-sync/zero-steady-recompile contract WITH
+    containment active and a quarantine trip mid-stream (trip, cooldown,
+    heal are all array content — the meshprof sentinel stays quiet);
+  * chaos drift (testing/chaos.py): every ExchangeInterface method is
+    either wired through the fault injector or listed in FAULT_EXEMPT —
+    the __getattr__ passthrough can never silently exempt new surface;
+  * per-lane fault targeting: `ld<i>-` coid namespace routing
+    (lane_of_coid + lane_schedules), deterministic outage windows, and
+    NaN poison payloads on ticker/balance reads;
+  * dispatch-level degradation (testing/loadgen.py): a failing fused
+    dispatch trips the tenant_engine breaker, ticks degrade to the
+    object-lane parity path, and hand-back is automatic;
+  * the fleet chaos soak (tier-1 smoke; `-m slow` at N=64): per-lane
+    state/param poisoning + a per-lane venue outage + a mid-run kill and
+    snapshot restore, asserting blast radius = the faulted lanes only,
+    zero duplicate client order ids per lane namespace, per-lane ledger
+    conservation, and healthy-lane state bit-identical to a clean twin.
+"""
+
+import asyncio
+import inspect
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.config import TradingParams
+from ai_crypto_trader_tpu.obs.flightrec import GATES, VETO_ORDER
+from ai_crypto_trader_tpu.ops import tenant_engine
+from ai_crypto_trader_tpu.ops.tenant_engine import GATE_ID, TenantEngine
+from ai_crypto_trader_tpu.parallel import SingleDevicePartitioner
+from ai_crypto_trader_tpu.testing import chaos
+from ai_crypto_trader_tpu.testing.chaos import (
+    ChaosExchange,
+    FaultSchedule,
+    lane_of_coid,
+    poison_lane_params,
+    poison_lane_state,
+    torn_tail,
+)
+from ai_crypto_trader_tpu.utils import meshprof
+from ai_crypto_trader_tpu.utils.journal import (
+    SnapshotJournal,
+    load_snapshot,
+    pack_array,
+    unpack_array,
+)
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+SYMS = [f"P{i:03d}USDC" for i in range(4)]
+Q_GATE = GATE_ID["lane_quarantined"]
+PERMISSIVE = TradingParams(ai_confidence_threshold=0.0,
+                           min_signal_strength=0.0, min_trade_amount=1.0)
+
+
+def _feats(eng, price, signal, strength, vol, avol, valid=None):
+    S, n = eng.S, len(price)
+    pad = lambda a, dt: np.asarray(        # noqa: E731
+        list(a) + [0] * (S - n), dt)
+    return {
+        "price": pad(price, np.float32),
+        "signal": pad(signal, np.int32),
+        "strength": pad(strength, np.float32),
+        "volatility": pad(vol, np.float32),
+        "avg_volume": pad(avol, np.float32),
+        "valid": pad(valid if valid is not None else [True] * n, bool),
+    }
+
+
+def _feat_stream(eng, seed=5, ticks=6):
+    """A deterministic multi-tick feature sequence (prices drift so
+    positions open AND close across the run)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    base = rng.uniform(50.0, 200.0, len(SYMS))
+    for t in range(ticks):
+        price = base * (1.0 + 0.02 * np.sin(0.7 * t + np.arange(len(SYMS))))
+        out.append(_feats(
+            eng, list(price),
+            list(rng.integers(-1, 2, len(SYMS))),
+            list(rng.uniform(40.0, 110.0, len(SYMS))),
+            [0.015] * len(SYMS), [60_000.0] * len(SYMS)))
+    return out
+
+
+def _state_rows(eng, lanes):
+    """One lane-slice dict per requested lane, for bit-identity pins."""
+    return {k: np.asarray(v)[list(lanes)]
+            for k, v in eng._state_np.items()}
+
+
+class TestQuarantineVocabulary:
+    def test_gate_appended_and_first_in_veto_order(self):
+        assert "lane_quarantined" in GATES
+        assert GATES[Q_GATE] == "lane_quarantined"
+        # appended-only vocabulary: the new gate is the LAST id (positional
+        # ids in journaled flightrec records must never shift)
+        assert Q_GATE == len(GATES) - 1
+        # ...but the FIRST veto resolved: a quarantined lane's verdict is
+        # containment, not whatever NaN artifact the poison produces
+        assert VETO_ORDER[0] == "lane_quarantined"
+
+    def test_alert_rule_exists_in_both_engines(self):
+        from ai_crypto_trader_tpu.utils.alerts import default_rules
+
+        rules = {r.name: r for r in default_rules()}
+        rule = rules["FleetLaneQuarantined"]
+        assert rule.severity == "warning"
+        assert rule.predicate({"fleet_quarantined_lanes": 1})
+        assert not rule.predicate({"fleet_quarantined_lanes": 0})
+        assert not rule.predicate({})
+        with open(os.path.join(os.path.dirname(__file__), "..",
+                               "monitoring", "alert_rules.yml"),
+                  encoding="utf-8") as f:
+            yml = f.read()
+        assert "FleetLaneQuarantined" in yml
+        assert "crypto_trader_tpu_fleet_quarantined_lanes > 0" in yml
+
+
+class TestContainment:
+    def test_state_poison_trips_gate_and_masks_lane(self):
+        part = SingleDevicePartitioner()
+        eng = TenantEngine(SYMS, 8, trading=PERMISSIVE, partitioner=part,
+                           quarantine_cooldown=3)
+        feats = _feat_stream(eng)[0]
+        eng.decide(feats)
+        assert eng.quarantined_lanes() == []
+        poison_lane_state(eng, 2, "balance")
+        out = eng.decide(feats)
+        # every decided cell of the poisoned lane resolves to containment
+        decided = np.asarray(out["gate"][2]) != tenant_engine.NO_DECISION
+        assert decided.any()
+        assert (np.asarray(out["gate"][2])[decided] == Q_GATE).all()
+        # masked out of entry: no executable cell on the poisoned lane
+        assert not any(n == 2 for n, _ in eng.executable(out))
+        view = eng.quarantined_lanes()
+        assert view == [{"lane": 2, "gate": "lane_quarantined",
+                         "cooldown": 3}]
+        assert eng.quarantine_trips == 1
+
+    def test_param_poison_trips_and_override_nan_does_not(self):
+        part = SingleDevicePartitioner()
+        eng = TenantEngine(SYMS, 4, trading=PERMISSIVE, partitioner=part)
+        feats = _feat_stream(eng)[0]
+        # NaN sl/tp overrides are the documented "no override" sentinel —
+        # the whole fleet carries them by default and must stay healthy
+        eng.set_live_overrides(None, None)
+        eng.decide(feats)
+        assert eng.quarantined_lanes() == []
+        poison_lane_params(eng, 1, "conf_threshold")
+        eng.decide(feats)
+        assert [v["lane"] for v in eng.quarantined_lanes()] == [1]
+
+    def test_cooldown_is_edge_armed_and_detector_refires(self):
+        part = SingleDevicePartitioner()
+        eng = TenantEngine(SYMS, 4, trading=PERMISSIVE, partitioner=part,
+                           quarantine_cooldown=2)
+        feats = _feat_stream(eng)[0]
+        eng.decide(feats)
+        poison_lane_state(eng, 0, "balance")
+        eng.decide(feats)                      # trip edge: arms cooldown
+        assert eng.quarantine_trips == 1
+        assert eng.heal_ready() == []
+        eng.decide(feats)                      # poison persists: re-fires,
+        eng.decide(feats)                      # but the edge counted once
+        assert eng.quarantine_trips == 1
+        # cooldown expired → heal-ready; still quarantined until healed
+        assert eng.heal_ready() == [0]
+        assert [v["lane"] for v in eng.quarantined_lanes()] == [0]
+
+    @pytest.mark.parametrize("n_tenants", [8, 1000])
+    def test_healthy_lanes_bit_identical_with_poisoned_neighbor(
+            self, n_tenants):
+        """The containment parity pin: every never-poisoned lane's state
+        and decisions are BIT-IDENTICAL with and without poisoned
+        neighbors in the same dispatch — containment by masking, not by
+        perturbation."""
+        part = SingleDevicePartitioner()
+        bad = [2, n_tenants - 1] + ([123] if n_tenants > 200 else [])
+        eng_a = TenantEngine(SYMS, n_tenants, trading=PERMISSIVE,
+                             partitioner=part)
+        eng_b = TenantEngine(SYMS, n_tenants, trading=PERMISSIVE,
+                             partitioner=part)
+        stream = _feat_stream(eng_a, ticks=3)
+        eng_a.decide(stream[0])
+        eng_b.decide(stream[0])
+        poison_lane_state(eng_a, bad[0], "balance")
+        poison_lane_params(eng_a, bad[1], "min_strength",
+                           value=float("inf"))
+        if len(bad) > 2:
+            poison_lane_state(eng_a, bad[2], "entry")
+        healthy = [i for i in range(n_tenants) if i not in bad]
+        for feats in stream[1:]:
+            out_a = eng_a.decide(feats)
+            out_b = eng_b.decide(feats)
+            assert sorted(v["lane"] for v in eng_a.quarantined_lanes()) \
+                == sorted(bad)
+            for k in out_a:
+                np.testing.assert_array_equal(
+                    np.asarray(out_a[k])[healthy],
+                    np.asarray(out_b[k])[healthy], err_msg=k)
+            rows_a = _state_rows(eng_a, healthy)
+            rows_b = _state_rows(eng_b, healthy)
+            for k in rows_a:
+                np.testing.assert_array_equal(rows_a[k], rows_b[k],
+                                              err_msg=k)
+
+    def test_containment_off_measures_bare_program(self):
+        """containment=False (the bench overhead probe's OFF arm) compiles
+        the detector out: poison produces NaN artifacts, never the gate."""
+        part = SingleDevicePartitioner()
+        eng = TenantEngine(SYMS, 4, trading=PERMISSIVE, partitioner=part,
+                           containment=False)
+        feats = _feat_stream(eng)[0]
+        eng.decide(feats)
+        poison_lane_state(eng, 1, "balance")
+        out = eng.decide(feats)
+        assert (np.asarray(out["gate"][1]) != Q_GATE).all()
+        assert eng.quarantined_lanes() == []
+
+    def test_one_dispatch_one_sync_zero_recompile_through_a_trip(
+            self, monkeypatch):
+        """The PR 12 contract WITH containment active: trip, cooldown and
+        heal are array content — the recompile sentinel stays quiet and
+        every decide is one dispatch + one host_read."""
+        syncs = {"n": 0}
+        real_read = tenant_engine.host_read
+
+        def counting_read(tree):
+            syncs["n"] += 1
+            return real_read(tree)
+
+        monkeypatch.setattr(tenant_engine, "host_read", counting_read)
+        mp = meshprof.MeshProf(metrics=MetricsRegistry())
+        with meshprof.use(mp):
+            eng = TenantEngine(SYMS, 8, trading=PERMISSIVE,
+                               quarantine_cooldown=1)
+            feats = _feat_stream(eng)[0]
+            eng.decide(feats)                  # cold (declared)
+            poison_lane_state(eng, 3, "balance")
+            eng.decide(feats)                  # trip
+            eng.decide(feats)                  # cooldown expires
+            assert eng.heal_ready() == [3]
+            eng.heal_lane(3, balance=10_000.0)
+            eng.decide(feats)                  # healed lane trades again
+            assert eng.quarantined_lanes() == []
+            assert syncs["n"] == 4
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+            assert mp.recompiles.windows["tenant_engine"] == 4
+
+
+class TestHealParity:
+    def test_healed_lane_equals_fresh_venue_truth_seed(self):
+        """Quarantine → cooldown → heal, then decide on: the healed lane
+        is bit-identical to a lane freshly provisioned from the same
+        venue truth (heal is a re-seed, not a patched zombie)."""
+        part = SingleDevicePartitioner()
+        eng = TenantEngine(SYMS, 4, trading=PERMISSIVE, partitioner=part,
+                           quarantine_cooldown=2)
+        stream = _feat_stream(eng, ticks=6)
+        eng.decide(stream[0])
+        poison_lane_state(eng, 1, "balance")
+        poison_lane_params(eng, 1, "conf_threshold")
+        for feats in stream[1:4]:
+            eng.decide(feats)
+        assert eng.heal_ready() == [1]
+        eng.heal_lane(1, balance=9_500.0)
+        assert eng.heals_total == 1
+        assert eng.quarantined_lanes() == []
+        # the fresh twin: same venue truth provisioned onto a new lane
+        twin = TenantEngine(SYMS, 4, trading=PERMISSIVE, partitioner=part,
+                            quarantine_cooldown=2)
+        twin.set_tenant(1, balance=9_500.0)
+        for feats in stream[4:]:
+            out_a = eng.decide(feats)
+            out_b = twin.decide(feats)
+            for k in out_a:
+                np.testing.assert_array_equal(
+                    np.asarray(out_a[k])[1], np.asarray(out_b[k])[1],
+                    err_msg=k)
+            rows_a = _state_rows(eng, [1])
+            rows_b = _state_rows(twin, [1])
+            for k in rows_a:
+                np.testing.assert_array_equal(rows_a[k], rows_b[k],
+                                              err_msg=k)
+        # a healed lane's poisoned param row rolled back to the default —
+        # it must NOT re-trip on the next dispatch
+        assert eng.quarantine_trips == 1
+
+    def test_heal_restores_open_positions_from_venue_truth(self):
+        part = SingleDevicePartitioner()
+        eng = TenantEngine(SYMS, 2, trading=PERMISSIVE, partitioner=part,
+                           quarantine_cooldown=1)
+        feats = _feat_stream(eng)[0]
+        eng.decide(feats)
+        poison_lane_state(eng, 0, "qty")
+        eng.decide(feats)
+        eng.decide(feats)
+        eng.heal_lane(0, balance=8_000.0,
+                      positions={SYMS[1]: (120.0, 2.5)})
+        st = eng._state_np
+        s = eng.sym_index[SYMS[1]]
+        assert st["open"][0, s] and st["qty"][0, s] == np.float32(2.5)
+        assert st["entry"][0, s] == np.float32(120.0)
+        # PnL accounting re-based at venue equity: balance + position value
+        assert st["equity0"][0] == np.float32(8_000.0 + 120.0 * 2.5)
+        assert st["max_drawdown"][0] == 0.0
+
+
+class TestDurableFleetState:
+    def _traded_engine(self, part=None, n=6):
+        eng = TenantEngine(SYMS, n, trading=PERMISSIVE,
+                           partitioner=part or SingleDevicePartitioner())
+        for feats in _feat_stream(eng, ticks=3):
+            eng.decide(feats)
+        return eng
+
+    def test_snapshot_json_roundtrip_restores_bit_identical(self):
+        part = SingleDevicePartitioner()
+        eng = self._traded_engine(part)
+        assert eng.open_positions() > 0       # the snapshot carries books
+        payload = json.loads(json.dumps(eng.snapshot()))
+        fresh = TenantEngine(SYMS, 6, trading=PERMISSIVE, partitioner=part)
+        rep = fresh.restore(payload)
+        assert rep["lanes"] == 6
+        assert rep["open_positions"] == eng.open_positions()
+        assert rep["snapshot_dispatches"] == eng.dispatch_count
+        for k, v in eng._state_np.items():
+            np.testing.assert_array_equal(fresh._state_np[k], v, err_msg=k)
+        for k, v in eng._params_np.items():
+            np.testing.assert_array_equal(fresh._params_np[k], v,
+                                          err_msg=k)
+        # the restored fleet decides identically from the first dispatch
+        feats = _feat_stream(eng, seed=9)[0]
+        out_a, out_b = eng.decide(feats), fresh.decide(feats)
+        for k in out_a:
+            np.testing.assert_array_equal(out_a[k], out_b[k], err_msg=k)
+
+    def test_restore_after_kill_falls_back_past_torn_tail(self, tmp_path):
+        """Crash mid-checkpoint: the torn final record is dropped and the
+        PREVIOUS intact snapshot restores — newest-complete wins."""
+        part = SingleDevicePartitioner()
+        eng = TenantEngine(SYMS, 4, trading=PERMISSIVE, partitioner=part)
+        stream = _feat_stream(eng, ticks=4)
+        path = str(tmp_path / "fleet.journal")
+        journal = SnapshotJournal(path)
+        eng.decide(stream[0])
+        eng.decide(stream[1])
+        journal.write(eng.snapshot())
+        good = {k: v.copy() for k, v in eng._state_np.items()}
+        eng.decide(stream[2])
+        journal.write(eng.snapshot())          # the checkpoint that tears
+        journal.close()
+        torn_tail(path)
+        payload, stats = load_snapshot(path)
+        assert stats["torn_tail"] is True
+        assert payload is not None
+        fresh = TenantEngine(SYMS, 4, trading=PERMISSIVE, partitioner=part)
+        fresh.restore(payload)
+        for k, v in good.items():
+            np.testing.assert_array_equal(fresh._state_np[k], v, err_msg=k)
+
+    def test_snapshot_journal_compacts_to_one_record(self, tmp_path):
+        path = str(tmp_path / "fleet.journal")
+        journal = SnapshotJournal(path, compact_every=3)
+        for i in range(3):
+            journal.write({"tick": i})
+        journal.close()
+        with open(path, encoding="utf-8") as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        assert len(lines) == 1                 # bounded by compaction
+        payload, stats = load_snapshot(path)
+        assert payload == {"tick": 2}          # newest snapshot survived
+        assert stats["torn_tail"] is False
+
+    def test_pack_array_crc_catches_bit_rot(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        obj = json.loads(json.dumps(pack_array(a)))
+        np.testing.assert_array_equal(unpack_array(obj), a)
+        obj["crc"] = (obj["crc"] + 1) & 0xFFFFFFFF
+        with pytest.raises(ValueError):
+            unpack_array(obj)
+
+    def test_restore_rejects_identity_mismatches(self):
+        eng = self._traded_engine()
+        payload = eng.snapshot()
+        other = TenantEngine([s + "X" for s in SYMS], 6)
+        with pytest.raises(ValueError):
+            other.restore(payload)
+        bad = json.loads(json.dumps(payload))
+        del bad["state"]["quarantined"]
+        fresh = TenantEngine(SYMS, 6)
+        with pytest.raises(ValueError):
+            fresh.restore(bad)
+        assert payload["version"] == 1
+        with pytest.raises(ValueError):
+            fresh.restore({**payload, "version": 99})
+
+
+class TestChaosDrift:
+    def test_every_exchange_method_is_fault_wired_or_exempt(self):
+        """The drift that hid list_symbols behind __getattr__ can never
+        come back: every public ExchangeInterface method must be
+        overridden in ChaosExchange (wired through the fault schedule) or
+        deliberately listed in FAULT_EXEMPT."""
+        from ai_crypto_trader_tpu.shell.exchange import ExchangeInterface
+
+        surface = {name for name, fn
+                   in inspect.getmembers(ExchangeInterface,
+                                         predicate=callable)
+                   if not name.startswith("_")}
+        assert surface, "interface introspection found nothing"
+        wired = {name for name in vars(ChaosExchange)
+                 if not name.startswith("_")}
+        missing = surface - wired - chaos.FAULT_EXEMPT
+        assert not missing, (
+            f"ExchangeInterface methods pass through ChaosExchange "
+            f"un-faulted: {sorted(missing)} — wire them through _fault "
+            f"or add them to FAULT_EXEMPT with a reason")
+        # no stale exemptions for methods that no longer exist
+        assert chaos.FAULT_EXEMPT <= surface
+        # the regression itself, pinned by name
+        assert "list_symbols" in wired
+
+    def test_lane_of_coid_parses_only_the_lane_namespace(self):
+        assert lane_of_coid("ld7-ent-P000USDC-3") == 7
+        assert lane_of_coid("ld123-x") == 123
+        assert lane_of_coid("wj-ent-BTCUSDC-1") is None
+        assert lane_of_coid("ldx-broken") is None
+        assert lane_of_coid(None) is None
+        assert lane_of_coid("") is None
+
+    def test_outage_window_is_deterministic(self):
+        sched = FaultSchedule(seed=0, outages=((2, 4),))
+        got = [sched.next_fault("get_ticker", ("error",))
+               for _ in range(6)]
+        assert got == [None, None, "error", "error", None, None]
+        # scripted entries override the window
+        sched2 = FaultSchedule(seed=0, outages=((0, 2),),
+                               script={1: "latency"})
+        assert sched2.next_fault("get_ticker", ("error", "latency")) \
+            == "error"
+        assert sched2.next_fault("get_ticker", ("error", "latency")) \
+            == "latency"
+
+    def _venue(self):
+        from ai_crypto_trader_tpu.data.ingest import from_dict
+        from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+
+        series = {"BTCUSDC": from_dict(
+            {k: v for k, v in generate_ohlcv(n=400, seed=4).items()
+             if k != "regime"}, symbol="BTCUSDC")}
+        ex = FakeExchange(series, quote_balance=50_000.0)
+        ex.advance(steps=300)
+        return ex
+
+    def test_lane_schedules_route_by_coid_namespace(self):
+        inner = self._venue()
+        broken = FaultSchedule(seed=0, rates={"error": 1.0})
+        ex = ChaosExchange(inner, FaultSchedule(seed=0),
+                           lane_schedules={1: broken})
+        # lane 1's orders always die; lane 0 (shared schedule, no rates)
+        # sails through — the blast radius is the coid namespace
+        with pytest.raises(ConnectionError):
+            ex.place_order("BTCUSDC", "BUY", "MARKET", 0.01,
+                           client_order_id="ld1-ent-BTCUSDC-0")
+        out = ex.place_order("BTCUSDC", "BUY", "MARKET", 0.01,
+                             client_order_id="ld0-ent-BTCUSDC-0")
+        assert out
+        # a lane-TAGGED wrapper routes its reads through the lane schedule
+        ex_lane = ChaosExchange(inner, FaultSchedule(seed=0), lane=1,
+                                lane_schedules={1: broken})
+        with pytest.raises(ConnectionError):
+            ex_lane.get_balances()
+
+    def test_poison_faults_serve_nan_payloads(self):
+        inner = self._venue()
+        ex = ChaosExchange(inner, FaultSchedule(
+            seed=0, script={0: "poison", 1: "poison"}))
+        tick = ex.get_ticker("BTCUSDC")
+        assert not np.isfinite(tick["price"])
+        bals = ex.get_balances()
+        assert bals and all(not np.isfinite(v) for v in bals.values())
+        # after the scripted poison, reads are clean again
+        assert np.isfinite(ex.get_ticker("BTCUSDC")["price"])
+
+
+def _soak_config(tmp_path=None, **kw):
+    from ai_crypto_trader_tpu.testing.loadgen import LoadConfig
+
+    base = dict(mode="vmapped", tenants=6, symbols=2, ticks=8,
+                warmup_ticks=2, window=64, min_samples=2, seed=3,
+                slo_p99_ms=30_000.0, trading=PERMISSIVE,
+                fleet_snapshot_every=2)
+    if tmp_path is not None:
+        base["fleet_journal_path"] = str(tmp_path / "fleet.journal")
+    base.update(kw)
+    return LoadConfig(**base)
+
+
+class TestDispatchDegradation:
+    def test_failed_dispatch_trips_breaker_then_hands_back(self):
+        """The degradation ladder: dispatch raises → retry → breaker
+        failure → degraded tick (object parity path for sampled lanes);
+        a healthy dispatch hands back and the breaker recovers."""
+        from ai_crypto_trader_tpu.testing.loadgen import (
+            SyntheticTenantTraffic)
+
+        traffic = SyntheticTenantTraffic(_soak_config(ticks=6), points=1)
+        eng = traffic.tenant_engine
+        real_decide = eng.decide
+
+        def exploding(feats):
+            raise RuntimeError("chaos: dispatch aborted")
+
+        async def go():
+            for _ in range(2):
+                await traffic.tick(timed=False)    # warm + compile
+            eng.decide = exploding
+            await traffic.tick()
+            assert traffic.degraded_ticks == 1
+            assert traffic.engine_breaker.failures >= 2
+            assert traffic.engine_breaker.quarantined
+            eng.decide = real_decide
+            # the breaker quarantine window (4 tick-steps) keeps ticks on
+            # the degraded path even though the dispatch is healthy again;
+            # the first probe after the window hands back
+            degraded_in_window = 0
+            for _ in range(5):
+                before = traffic.degraded_ticks
+                await traffic.tick()
+                degraded_in_window += traffic.degraded_ticks - before
+            assert 0 < degraded_in_window < 5
+            assert not traffic.engine_breaker.quarantined
+            return traffic.report()
+
+        rep = asyncio.run(go())
+        traffic.close()
+        con = rep["containment"]
+        assert con["enabled"] is True
+        assert con["degraded_ticks"] == traffic.degraded_ticks >= 1
+        # hand-back happened: the breaker saw a post-failure success
+        assert con["engine_breaker"]["failures"] == 0
+        assert traffic.metrics.counters.get(
+            "crypto_trader_tpu_fleet_degraded_ticks_total", 0) >= 1
+
+    def test_report_and_snapshots_flow_through_run_load(self, tmp_path):
+        from ai_crypto_trader_tpu.testing.loadgen import run_load
+
+        rep = run_load(_soak_config(tmp_path))
+        assert rep["containment"]["enabled"] is True
+        assert rep["containment"]["quarantined"] == []
+        assert rep["containment"]["snapshots"] >= 1
+        payload, stats = load_snapshot(str(tmp_path / "fleet.journal"))
+        assert payload is not None and payload["n_tenants"] == 6
+
+
+def _drive_soak(traffic, ticks, poison_at=None, poison=()):
+    """Drive a vmapped harness; at tick index ``poison_at`` apply the
+    ``poison`` callables (engine corruption, venue wraps)."""
+
+    async def go():
+        for _ in range(traffic.cfg.warmup_ticks):
+            await traffic.tick(timed=False)
+        for i in range(ticks):
+            if poison_at is not None and i == poison_at:
+                for fn in poison:
+                    fn(traffic)
+            await traffic.tick()
+
+    asyncio.run(go())
+
+
+def _lane_ledger_conserved(traffic, quote0=10_000.0):
+    """Per-lane ledger conservation: every materialized lane's venue
+    balances re-derive exactly from its fill log, and every fill's coid
+    stays in the lane's own ld<i>- namespace (zero duplicates)."""
+    for n, lane in traffic._vm_lanes.items():
+        venue = getattr(lane.venue, "inner", lane.venue)
+        coids = [f["client_order_id"] for f in venue.fills
+                 if f.get("client_order_id")]
+        assert len(coids) == len(set(coids)), f"lane {n}: duplicate coid"
+        for coid in coids:
+            assert lane_of_coid(coid) == n, \
+                f"lane {n} venue saw foreign coid {coid}"
+        derived = {"USDC": quote0}
+        for f in venue.fills:
+            base = f["symbol"][:-4]
+            cost = f["quantity"] * f["price"]
+            sign = -1.0 if f["side"] == "BUY" else 1.0
+            derived["USDC"] = (derived.get("USDC", 0.0) + sign * cost
+                               - f.get("fee", 0.0))
+            derived[base] = derived.get(base, 0.0) - sign * f["quantity"]
+        for asset, v in venue.get_balances().items():
+            np.testing.assert_allclose(v, derived.get(asset, 0.0),
+                                       rtol=1e-9, atol=1e-5,
+                                       err_msg=f"lane {n} asset {asset}")
+
+
+def _fleet_soak(tmp_path, n_tenants, ticks):
+    """The fleet chaos soak body (smoke and slow share it): clean twin
+    parity, per-lane poison + venue outage, heal, mid-run kill +
+    snapshot restore, ledger + coid invariants, recompile sentinel."""
+    from ai_crypto_trader_tpu.testing.loadgen import SyntheticTenantTraffic
+
+    bad_state, bad_param = 2, n_tenants - 1
+    bad = {bad_state, bad_param}
+    cfg = _soak_config(tmp_path, tenants=n_tenants, ticks=ticks)
+    traffic = SyntheticTenantTraffic(cfg, points=1)
+    twin = SyntheticTenantTraffic(_soak_config(tenants=n_tenants,
+                                               ticks=ticks), points=1)
+    # fast heal for the soak budget: cooldown is param array CONTENT
+    for t in (traffic, twin):
+        t.tenant_engine._params_np["cooldown_ticks"][:] = 2
+        t.tenant_engine._need_seed = True
+
+    outage = FaultSchedule(seed=1, rates={"error": 1.0})
+
+    def corrupt(tr):
+        poison_lane_state(tr.tenant_engine, bad_state, "balance")
+        poison_lane_params(tr.tenant_engine, bad_param, "conf_threshold")
+        # lane `bad_state`'s venue goes DOWN too: the healer must skip it
+        # (blast radius: that lane stays quarantined, nothing else)
+        lane = tr._vm_lane(bad_state)
+        lane.venue = ChaosExchange(lane.venue, outage, lane=bad_state)
+
+    mp = meshprof.MeshProf(metrics=MetricsRegistry())
+    with meshprof.use(mp):
+        _drive_soak(traffic, ticks, poison_at=2, poison=(corrupt,))
+        _drive_soak(twin, ticks)
+    # containment is array content: zero steady-state recompiles across
+    # trip + outage + heal, with the observatory-declared colds exempt
+    assert mp.recompiles.steady_total() == 0, mp.recompiles.status()
+
+    eng, eng_t = traffic.tenant_engine, twin.tenant_engine
+    # the poisoned-state lane healed once its venue outage cleared? No —
+    # the outage never clears during the run, so it MUST still be
+    # quarantined (heal-from-a-dead-venue is forbidden); the poisoned-
+    # param lane's venue is healthy, so it healed
+    q_now = {v["lane"] for v in eng.quarantined_lanes()}
+    assert bad_state in q_now, "dead-venue lane healed from nothing"
+    assert bad_param not in q_now, "healthy-venue lane never healed"
+    assert eng.heals_total >= 1
+    assert eng.quarantine_trips >= 2
+    assert q_now <= bad, f"blast radius exceeded the faulted lanes: {q_now}"
+
+    # healthy lanes bit-identical to the clean twin (fleet-scale parity)
+    healthy = [i for i in range(n_tenants) if i not in bad]
+    for k, v in eng._state_np.items():
+        np.testing.assert_array_equal(
+            np.asarray(v)[healthy],
+            np.asarray(eng_t._state_np[k])[healthy], err_msg=k)
+
+    _lane_ledger_conserved(traffic)
+    rep = traffic.report()
+    assert rep["containment"]["heals_total"] == eng.heals_total
+    assert rep["containment"]["snapshots"] >= 1
+
+    # -- the kill: snapshots are flushed, the process state is gone --------
+    traffic.fleet_journal.write(eng.snapshot())
+    final = {k: v.copy() for k, v in eng._state_np.items()}
+    counters = (eng.balance_resyncs, eng.quarantine_trips, eng.heals_total)
+    traffic.fleet_journal.journal.simulate_crash()
+
+    revived = SyntheticTenantTraffic(cfg, points=1)
+    payload, stats = load_snapshot(str(tmp_path / "fleet.journal"))
+    assert payload is not None and stats["corrupt_records"] == 0
+    rep2 = revived.tenant_engine.restore(payload)
+    assert rep2["lanes"] == n_tenants
+    assert rep2["quarantined"] == len(q_now)
+    for k, v in final.items():
+        np.testing.assert_array_equal(revived.tenant_engine._state_np[k],
+                                      v, err_msg=k)
+    assert (revived.tenant_engine.balance_resyncs,
+            revived.tenant_engine.quarantine_trips,
+            revived.tenant_engine.heals_total) == counters
+    # the revived fleet trades: lanes re-seed from the restored mirror,
+    # the still-quarantined lane stays contained, and its heal completes
+    # once the venue comes back (the revived harness has a FRESH venue)
+    revived.tenant_engine._params_np["cooldown_ticks"][:] = 2
+    revived.tenant_engine._need_seed = True
+    _drive_soak(revived, 5)
+    assert revived.tenant_engine.heals_total > counters[2]
+    assert revived.tenant_engine.quarantined_lanes() == []
+    _lane_ledger_conserved(revived)
+    for t in (traffic, twin, revived):
+        t.close()
+
+
+def test_fleet_chaos_soak_smoke(tmp_path):
+    """Tier-1 budget variant of the fleet soak: 6 lanes, 8 decided
+    ticks, one poisoned lane + one poisoned param row + one per-lane
+    venue outage + one kill/restore."""
+    _fleet_soak(tmp_path, n_tenants=6, ticks=8)
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak_full(tmp_path):
+    """The full fleet soak at N=64 (the acceptance scale): same
+    invariants, more lanes, more ticks."""
+    _fleet_soak(tmp_path, n_tenants=64, ticks=16)
