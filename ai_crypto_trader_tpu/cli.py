@@ -307,7 +307,10 @@ def cmd_trade(args):
                            enable_devprof=args.devprof,
                            enable_meshprof=args.meshprof,
                            enable_fleetscope=args.fleetscope,
-                           flightrec_path=args.flightrec)
+                           flightrec_path=args.flightrec,
+                           pipelined=args.pipelined,
+                           precision=args.precision,
+                           aot_cache_dir=args.aot_cache)
     if args.full_stack:
         from ai_crypto_trader_tpu.shell.stack import build_full_stack
         from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
@@ -351,6 +354,9 @@ def cmd_trade(args):
                 # bus), so without an explicit suspension the loop never
                 # schedules the metrics server's connection handlers
                 await asyncio.sleep(0)
+            # pipelined tick path: the last dispatch is still inflight —
+            # drain it so its decisions publish before the status dump
+            await system.monitor.flush_pipeline()
         finally:
             if msrv is not None:
                 msrv.close()
@@ -715,6 +721,93 @@ def _render_latency(tickpath_block: dict, coldstart_block: dict,
               f"{build_block.get('process_start')}")
 
 
+def _run_latency_burst(symbol: str, ticks: int, seed: int,
+                       pipelined: bool = False) -> tuple[dict, dict, dict]:
+    """One local paper burst for the latency views: builds a fresh
+    TradingSystem (serial or pipelined tick path), drives `ticks` ticks on
+    the virtual clock, and returns its (tickpath, coldstart, build)
+    status blocks.  The pipelined/serial toggle is the SAME TickEngine
+    ctor knob the parity tests flip — what `--compare` renders is the
+    exact configuration the contract suite certifies."""
+    from ai_crypto_trader_tpu.data.ingest import from_dict
+    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+    from ai_crypto_trader_tpu.shell.exchange import make_exchange
+    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+    d = generate_ohlcv(n=ticks + 600, seed=seed)
+    series = from_dict({k: v for k, v in d.items() if k != "regime"},
+                       symbol=symbol)
+    # virtual clock aligned to the synthetic candle open-times (i*60_000
+    # epoch-ms), so the demo's event→decision ages read as a real feed's
+    # would instead of clamping to zero or blowing past the budget
+    clock = {"t": 600 * 60.0}
+    ex = make_exchange("fake", series={symbol: series},
+                       quote_balance=10_000.0)
+    ex.advance(symbol, steps=600)
+    system = TradingSystem(ex, [symbol], now_fn=lambda: clock["t"],
+                           pipelined=pipelined)
+
+    async def go():
+        for _ in range(ticks):
+            ex.advance(symbol)
+            clock["t"] += 60.0
+            await system.tick()
+        # drain the last inflight dispatch so the final decision publishes
+        # and no donated buffer is abandoned mid-flight
+        await system.monitor.flush_pipeline()
+
+    try:
+        asyncio.run(go())
+        return (system.tickpath.status(),
+                system.tickpath.coldstart_status(),
+                system.build_info)
+    finally:
+        system.shutdown()
+
+
+def _render_latency_compare(serial_tp: dict, pipe_tp: dict,
+                            ticks: int) -> None:
+    """Side-by-side serial vs pipelined waterfalls: per-phase p50 columns
+    with deltas, then the overlap story — how much dispatch→ready host
+    idle the serial path exposes (headroom) and how much of it the
+    pipelined path actually filled with host work (reclaimed)."""
+    s_phases = serial_tp.get("phases") or {}
+    p_phases = pipe_tp.get("phases") or {}
+    names = [n for n in s_phases
+             if (s_phases.get(n, {}).get("count")
+                 or p_phases.get(n, {}).get("count"))]
+    print(f"serial vs pipelined tick path ({ticks} paper ticks each, "
+          f"phase p50 ms):")
+    print(f"  {'phase':<16}{'serial':>10}{'pipelined':>12}{'delta':>10}")
+    s_total = p_total = 0.0
+    for name in names:
+        s50 = s_phases.get(name, {}).get("p50_ms", 0.0) or 0.0
+        p50 = p_phases.get(name, {}).get("p50_ms", 0.0) or 0.0
+        s_total += s50
+        p_total += p50
+        print(f"  {name:<16}{s50:>10.2f}{p50:>12.2f}{p50 - s50:>+10.2f}")
+    print(f"  {'(sum of p50s)':<16}{s_total:>10.2f}{p_total:>12.2f}"
+          f"{p_total - s_total:>+10.2f}")
+    s_head = (serial_tp.get("overlap_headroom_ms") or {}).get("p50")
+    p_head = (pipe_tp.get("overlap_headroom_ms") or {}).get("p50")
+    reclaimed = (pipe_tp.get("overlap_reclaimed_ms") or {}).get("p50")
+    if s_head is not None:
+        print(f"\noverlap headroom (host-idle dispatch→ready wait): "
+              f"serial p50 {s_head:.2f} ms"
+              + (f" → pipelined p50 {p_head:.2f} ms"
+                 if p_head is not None else ""))
+    if reclaimed is not None:
+        print(f"overlap reclaimed by pipelining (device compute hidden "
+              f"behind host work): p50 {reclaimed:.2f} ms/tick")
+    s_age = serial_tp.get("event_age_ms") or {}
+    p_age = pipe_tp.get("event_age_ms") or {}
+    if s_age.get("count") and p_age.get("count"):
+        print(f"event→decision age p50: serial {s_age.get('p50', 0.0):.0f} "
+              f"ms, pipelined {p_age.get('p50', 0.0):.0f} ms (budget "
+              f"{s_age.get('budget_ms', 0.0):.0f} ms; pipelined publishes "
+              f"tick T at T+1's poll)")
+
+
 def cmd_latency(args):
     """Decision critical-path operator view (obs/tickpath.py): WHERE each
     tick's time goes (phase waterfall), the overlap headroom pipelining
@@ -722,45 +815,27 @@ def cmd_latency(args):
     ledger (first-compile cost per hot program).  With `--url`, reads a
     LIVE system's /state.json tickpath/coldstart blocks (no jax import);
     without it, drives a short local paper burst so the view is
-    demonstrable on any dev host."""
+    demonstrable on any dev host.  `--compare` drives the burst TWICE —
+    serial then pipelined — and renders the waterfalls side by side."""
     if args.url:
         state = _fetch_state(args.url)
         _render_latency(state.get("tickpath") or {},
                         state.get("coldstart") or {},
                         state.get("build"))
         return
-    from ai_crypto_trader_tpu.data.ingest import from_dict
-    from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
-    from ai_crypto_trader_tpu.shell.exchange import make_exchange
-    from ai_crypto_trader_tpu.shell.launcher import TradingSystem
-
-    d = generate_ohlcv(n=args.ticks + 600, seed=args.seed)
-    series = from_dict({k: v for k, v in d.items() if k != "regime"},
-                       symbol=args.symbol)
-    # virtual clock aligned to the synthetic candle open-times (i*60_000
-    # epoch-ms), so the demo's event→decision ages read as a real feed's
-    # would instead of clamping to zero or blowing past the budget
-    clock = {"t": 600 * 60.0}
-    ex = make_exchange("fake", series={args.symbol: series},
-                       quote_balance=10_000.0)
-    ex.advance(args.symbol, steps=600)
-    system = TradingSystem(ex, [args.symbol], now_fn=lambda: clock["t"])
-
-    async def go():
-        for _ in range(args.ticks):
-            ex.advance(args.symbol)
-            clock["t"] += 60.0
-            await system.tick()
-
-    try:
-        asyncio.run(go())
-        print(f"(local demo: {args.ticks} paper ticks on {args.symbol}; "
+    if args.compare:
+        serial_tp, _, _ = _run_latency_burst(args.symbol, args.ticks,
+                                             args.seed, pipelined=False)
+        pipe_tp, _, _ = _run_latency_burst(args.symbol, args.ticks,
+                                           args.seed, pipelined=True)
+        print(f"(local demo: 2×{args.ticks} paper ticks on {args.symbol}; "
               f"point --url at a running `trade --serve` for live state)\n")
-        _render_latency(system.tickpath.status(),
-                        system.tickpath.coldstart_status(),
-                        system.build_info)
-    finally:
-        system.shutdown()
+        _render_latency_compare(serial_tp, pipe_tp, args.ticks)
+        return
+    tp, cold, build = _run_latency_burst(args.symbol, args.ticks, args.seed)
+    print(f"(local demo: {args.ticks} paper ticks on {args.symbol}; "
+          f"point --url at a running `trade --serve` for live state)\n")
+    _render_latency(tp, cold, build)
 
 
 def cmd_status(args):
@@ -933,6 +1008,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "aggregated lane telemetry for any vmapped "
                          "tenant engine in this process — fleet_* "
                          "gauges, /state.json fleet block, Fleet* alerts")
+    sp.add_argument("--pipelined", action="store_true",
+                    help="pipelined tick path (ops/tick_engine.py): "
+                         "double-buffered candle ring + async host_read "
+                         "— publish tick T−1 while T computes on device")
+    sp.add_argument("--precision", default=None,
+                    metavar="{f32,bf16,tf32}",
+                    help="matmul precision for the fused decide programs "
+                         "(default full f32; bf16 trades tolerance-"
+                         "bounded decision drift for device throughput)")
+    sp.add_argument("--aot-cache", default=None, metavar="DIR",
+                    help="persistent AOT compile cache rooted at DIR "
+                         "(utils/aotcache.py): restarts replay the hot "
+                         "set's executables instead of recompiling")
     sp.set_defaults(fn=cmd_trade)
     sp = sub.add_parser("why", help="decision provenance for a symbol "
                                     "(flight-recorder query)")
@@ -1031,6 +1119,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ticks", type=int, default=12,
                     help="local demo burst length (no --url)")
     sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--compare", action="store_true",
+                    help="run the local burst twice — serial and "
+                         "pipelined tick path — and render the phase "
+                         "waterfalls side by side (no --url)")
     sp.set_defaults(fn=cmd_latency)
     sp = sub.add_parser("status", help="operator summary from a live "
                                        "dashboard server (/state.json)")

@@ -136,7 +136,8 @@ class _ColdStartCtx:
             since = self._mon.since(self._before)
             self.tp.record_cold_start(
                 self.name, wall_s=time.perf_counter() - self._t0,
-                compile_s=since["compile_s"], compiles=since["compiles"])
+                compile_s=since["compile_s"], compiles=since["compiles"],
+                cache_hits=since["cache_hits"])
         return False                      # never swallow — callers recover
 
 
@@ -160,6 +161,9 @@ class TickPathScope:
         self.phases: dict[str, SlidingQuantiles] = {}
         self.last: dict[str, float] = {}          # newest sample per phase
         self.overlap = SlidingQuantiles(window=self.window)
+        # headroom actually FILLED by pipelining: host work that ran
+        # between dispatch-return and the drain's readiness wait
+        self.reclaimed = SlidingQuantiles(window=self.window)
         self.event_age = SlidingQuantiles(window=self.window)  # milliseconds
         self.clock_skew_total = 0
         self.cold_programs: dict[str, dict] = {}  # program -> ledger entry
@@ -220,6 +224,15 @@ class TickPathScope:
         with self._lock:
             self.overlap.observe(max(float(seconds), 0.0))
 
+    def observe_reclaimed(self, seconds: float) -> None:
+        """One tick's overlap headroom actually FILLED by pipelining: the
+        host work (publish/analyzer/executor/next-tick ingest) that ran
+        between a dispatch returning and its drain starting to wait.
+        Serial execution observes ~0 here; the pipelined tick path's
+        reclaimed p50 is the before/after ledger for ROADMAP item 4."""
+        with self._lock:
+            self.reclaimed.observe(max(float(seconds), 0.0))
+
     # -- event-age SLO -------------------------------------------------------
     def observe_event_age(self, age_ms: float) -> float:
         """Fold one venue-E → decision-publish age (ms); returns the
@@ -254,7 +267,8 @@ class TickPathScope:
         return _ColdStartCtx(self, name)
 
     def record_cold_start(self, name: str, *, wall_s: float,
-                          compile_s: float, compiles: int) -> None:
+                          compile_s: float, compiles: int,
+                          cache_hits: int = 0) -> None:
         with self._lock:
             if name in self.cold_programs:
                 return                     # first cold window wins
@@ -262,6 +276,11 @@ class TickPathScope:
                 "wall_ms": round(wall_s * 1000.0, 3),
                 "compile_ms": round(compile_s * 1000.0, 3),
                 "compiles": int(compiles),
+                # persistent-compilation-cache hits during the cold window:
+                # a warm restart REPLAYS the executable (cache_hits ≥ 1,
+                # compile_ms collapses) instead of recompiling — the
+                # utils/aotcache.py warm-restart evidence
+                "cache_hits": int(cache_hits),
                 "t": time.time(),
             }
         if self.metrics is not None:
@@ -290,11 +309,14 @@ class TickPathScope:
                         1.0 if name == bn else 0.0, phase=name)
         with self._lock:
             overlap = list(self.overlap.buf)
+            reclaimed = list(self.reclaimed.buf)
             ages = list(self.event_age.buf)
             total_wall = sum(e["wall_ms"] for e in
                              self.cold_programs.values())
         m.set_gauge("tickpath_overlap_headroom_seconds",
                     percentile(overlap, 50))
+        m.set_gauge("tickpath_overlap_reclaimed_seconds",
+                    percentile(reclaimed, 50))
         m.set_gauge("latency_p50_seconds", percentile(ages, 50) / 1000.0,
                     slo="event_to_decision")
         m.set_gauge("latency_p99_seconds", percentile(ages, 99) / 1000.0,
@@ -324,6 +346,7 @@ class TickPathScope:
         with self._lock:
             last = dict(self.last)
             overlap = list(self.overlap.buf)
+            reclaimed = list(self.reclaimed.buf)
             ages = list(self.event_age.buf)
             skew = self.clock_skew_total
         phases = {}
@@ -341,6 +364,10 @@ class TickPathScope:
             "overlap_headroom_ms": {
                 "p50": round(percentile(overlap, 50) * 1000.0, 3),
                 "p99": round(percentile(overlap, 99) * 1000.0, 3),
+            },
+            "overlap_reclaimed_ms": {
+                "p50": round(percentile(reclaimed, 50) * 1000.0, 3),
+                "p99": round(percentile(reclaimed, 99) * 1000.0, 3),
             },
             "event_age_ms": {
                 "p50": round(percentile(ages, 50), 3),
@@ -406,6 +433,12 @@ def observe_overlap(seconds: float) -> None:
     tp = _ACTIVE
     if tp is not None:
         tp.observe_overlap(seconds)
+
+
+def observe_reclaimed(seconds: float) -> None:
+    tp = _ACTIVE
+    if tp is not None:
+        tp.observe_reclaimed(seconds)
 
 
 def observe_event_age(age_ms: float) -> float | None:

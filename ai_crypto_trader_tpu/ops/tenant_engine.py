@@ -93,6 +93,7 @@ def host_read(tree):
 # module's S: the two pads must never drift apart
 from ai_crypto_trader_tpu.ops.tick_engine import (  # noqa: E402
     _pad_symbols as _pad_pow2,
+    _precision_ctx,
 )
 
 
@@ -343,9 +344,18 @@ class TenantEngine:
                  partitioner=None, quote_balance: float = 10_000.0,
                  confidence_scale: float = 0.9, fee_rate: float = 0.001,
                  pad_pow2: bool = True, containment: bool = True,
-                 quarantine_cooldown: int = DEFAULT_QUARANTINE_COOLDOWN):
+                 quarantine_cooldown: int = DEFAULT_QUARANTINE_COOLDOWN,
+                 precision: str | None = None):
         from ai_crypto_trader_tpu.parallel import SingleDevicePartitioner
+        from ai_crypto_trader_tpu.models.train_loop import canonical_precision
 
+        # matmul precision for the fused decide (the PR 2 knob, same
+        # plumbing as ops/tick_engine.py); None = full f32 default.  The
+        # precision participates in the jit cache key, so an engine built
+        # with a different setting traces its own program — configure()
+        # declares the next dispatch cold either way.
+        canonical_precision(precision)     # validate eagerly, fail loud
+        self.precision = precision
         self.symbols = list(symbols)
         self.sym_index = {s: i for i, s in enumerate(self.symbols)}
         self.S = _pad_pow2(len(self.symbols))      # tick-engine symbol pad
@@ -635,7 +645,8 @@ class TenantEngine:
         tp = tickpath.active()
         try:
             with tickpath.coldstart("tenant_engine", cold=self._cold), \
-                    meshprof.watch("tenant_engine", cold=self._cold):
+                    meshprof.watch("tenant_engine", cold=self._cold), \
+                    _precision_ctx(self.precision):
                 t_d0 = time.perf_counter()
                 res = program(self._pop, feats_dev)
                 t_d1 = time.perf_counter()
@@ -656,6 +667,11 @@ class TenantEngine:
                     tree["fleet"] = res["fleet"]
                 host = host_read(tree)
                 host_read_s = time.perf_counter() - t_hr
+                # readiness-mark the whole carry: host_read only syncs
+                # the leaves it pulls, and donating a carry leaf PJRT
+                # hasn't marked ready degrades the next decide's dispatch
+                # to synchronous execution on the CPU thunk runtime
+                jax.block_until_ready(self._pop)
         except Exception:
             # a mid-step abort leaves the donated carry in an unknown
             # state; the host mirror is authoritative → next decide

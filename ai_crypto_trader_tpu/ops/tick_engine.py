@@ -36,6 +36,7 @@ program; dead lanes are masked invalid and cost only device FLOPs.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 
@@ -67,6 +68,19 @@ def host_read(tree):
         out = jax.device_get(tree)
     devprof.observe_latency("host_read", time.perf_counter() - t0)
     return out
+
+
+def _precision_ctx(precision: str | None):
+    """Matmul-precision context for the fused dispatch: the PR 2 knob
+    (models/train_loop.matmul_precision) threaded through the tick path.
+    None = backend default (f32 on CPU) = a zero-cost nullcontext.  The
+    precision participates in the jit cache key, so a bf16 engine traces
+    its OWN compiled program — declared cold like any fresh engine."""
+    if precision is None:
+        return contextlib.nullcontext()
+    from ai_crypto_trader_tpu.models.train_loop import matmul_precision
+
+    return matmul_precision(precision)
 
 
 def _pad_symbols(n: int) -> int:
@@ -190,14 +204,38 @@ class TickEngine:
     is re-seeded: the whole buffer re-uploads once via device_put — a
     transfer, not a compile.  ``step()`` then runs ONE jitted dispatch for
     every (symbol, frame) lane and performs ONE host_read.
+
+    ``pipelined=True`` switches to the DOUBLE-BUFFERED async tick path
+    (ROADMAP item 4): two device rings alternate, each poll's row writes
+    fan into both buffers' pending maps (a buffer dispatches every other
+    tick, so it also applies the writes from the tick it sat out — dict
+    assignment keeps latest-write-wins per ring slot), and ``step()``
+    returns IMMEDIATELY after dispatching tick T — handing back tick
+    T−1's drained host output (None on the very first tick).  The
+    readback of T then overlaps the host's publish/analyzer/executor
+    work and the next poll's fetch+ingest; ``flush()`` is the drain seam
+    for teardown and parity tests.  Both buffers share ONE compiled
+    program (identical shapes), the donation verifier runs against each
+    buffer's first carded dispatch, and a failed dispatch OR drain drops
+    everything in flight and re-seeds both buffers from the host mirror
+    (a transfer, never a compile — the PR 17 containment discipline).
+
+    ``precision`` threads the PR 2 matmul-precision knob ("bf16" for the
+    reduced-precision decide path) through the fused program; None keeps
+    the backend default (full f32 on CPU).
     """
 
     def __init__(self, symbols, intervals, window: int = 256,
-                 max_new: int = 8):
+                 max_new: int = 8, pipelined: bool = False,
+                 precision: str | None = None):
         self.symbols = list(symbols)
         self.intervals = tuple(intervals)
         self.window = int(window)
         self.max_new = int(max_new)
+        self.pipelined = bool(pipelined)
+        from ai_crypto_trader_tpu.models.train_loop import canonical_precision
+        canonical_precision(precision)     # validate eagerly, fail loud
+        self.precision = precision
         self.sym_index = {s: i for i, s in enumerate(self.symbols)}
         self.iv_index = {iv: i for i, iv in enumerate(self.intervals)}
         S = _pad_symbols(len(self.symbols))
@@ -227,6 +265,23 @@ class TickEngine:
         # in XLA, which could desync the device ring from the host mirror
         self._pending: dict = {}               # (s, f, pos) -> row
         self._need_seed = True
+        # per-lane stream-sync flag: True iff every row OFFERED to this
+        # lane since its last full-window ingest() was applied (ingest_row
+        # returned True).  While True, a full-window re-ingest of the same
+        # source is provably a zero-change diff — the monitor skips it for
+        # stream-served lanes (lane_synced), which removes the dominant
+        # steady-state host cost (re-parsing window × lanes every tick).
+        # Any refused row, warming lane, or re-seed clears it; only a
+        # completed full ingest sets it.
+        self._synced = np.zeros((S, F), bool)
+        # pipelined double-buffer state: two donated device rings, each
+        # with its own accumulated pending map and per-buffer donation
+        # check; _inflight holds the not-yet-drained dispatch
+        self._bufs: list = [None, None]
+        self._buf_pending: list[dict] = [{}, {}]
+        self._donation_checked = [False, False]
+        self._cur = 0
+        self._inflight: dict | None = None
         self.dispatch_count = 0
         self.full_seeds = 0
         self.last_valid = np.zeros((S, F), bool)
@@ -299,7 +354,8 @@ class TickEngine:
         elif ts > int(tail[-1]):
             step = int(tail[-1] - tail[-2]) if T >= 2 else 0
             if step <= 0 or ts != int(tail[-1]) + step:
-                return False                   # gap/misalignment: re-seed
+                self._synced[s, f] = False     # gap/misalignment: re-seed
+                return False
             self._ts[s, f] = np.roll(tail, -1)
             self._ts[s, f, -1] = ts
             self._win[s, f] = np.roll(self._win[s, f], -1, axis=0)
@@ -308,7 +364,8 @@ class TickEngine:
             self._base[s, f] = base
             pos = (base + T - 1) % T
         else:
-            return False                       # older than the window tail
+            self._synced[s, f] = False         # older than the window tail
+            return False
         self._ring_np[s, f, pos] = arr
         self._pending[(s, f, pos)] = arr       # latest write wins
         return True
@@ -324,17 +381,20 @@ class TickEngine:
         rows = klines[-T:]
         if len(rows) < T:
             self._count[s, f] = len(rows)      # warming: lane stays invalid
+            self._synced[s, f] = False
             return
         arr = np.asarray([r[1:6] for r in rows], np.float32)
         ts = np.asarray([int(r[0]) for r in rows], np.int64)
         if self._count[s, f] < T:
             self._seed_slot(s, f, ts, arr)     # warming → full transition
+            self._synced[s, f] = True
             return
         old_ts = self._ts[s, f]
         j = int(np.searchsorted(old_ts, ts[0]))
         if j >= T or old_ts[j] != ts[0] \
                 or not np.array_equal(old_ts[j:], ts[:T - j]):
             self._seed_slot(s, f, ts, arr)     # gap/misalignment: re-seed
+            self._synced[s, f] = True
             return
         k = j                                  # window advanced by k candles
         changed = np.flatnonzero(
@@ -342,6 +402,7 @@ class TickEngine:
         writes = list(changed) + list(range(T - k, T))
         if len(writes) > self.max_new:
             self._seed_slot(s, f, ts, arr)
+            self._synced[s, f] = True
             return
         base = (int(self._base[s, f]) + k) % T
         self._base[s, f] = base
@@ -351,12 +412,36 @@ class TickEngine:
             self._pending[(s, f, pos)] = arr[i]   # latest write wins
         self._win[s, f] = arr
         self._ts[s, f] = ts
+        self._synced[s, f] = True
+
+    def lane_synced(self, symbol: str, interval: str) -> bool:
+        """True iff this lane's window already reflects every row offered
+        since its last full ingest — i.e. a full-window re-ingest of the
+        same source would be a zero-change diff.  The stream attaches this
+        as provenance on the windows it serves (`serve_klines`), letting
+        the fused poll skip the redundant re-diff per lane."""
+        s = self.sym_index.get(symbol)
+        f = self.iv_index.get(interval)
+        if s is None or f is None:
+            return False
+        return bool(self._synced[s, f]) \
+            and int(self._count[s, f]) >= self.window
 
     # -- step -----------------------------------------------------------------
-    def step(self) -> dict:
-        """ONE fused dispatch over every (symbol, frame) lane + ONE host
-        readback.  Returns the numpy output pytree ([S, F] per feature);
-        per-step transfer/dispatch accounting lands in ``last_stats``."""
+    def step(self) -> dict | None:
+        """ONE fused dispatch over every (symbol, frame) lane.
+
+        Serial mode (default): dispatch + ONE host readback, returning
+        THIS tick's numpy output pytree.  Pipelined mode: dispatch tick T
+        against the current ring buffer, flip buffers, then drain and
+        return tick T−1's output — None on the first tick, when nothing
+        is in flight yet.  Per-step transfer/dispatch accounting lands in
+        ``last_stats`` either way."""
+        if self.pipelined:
+            return self._step_pipelined()
+        return self._step_serial()
+
+    def _step_serial(self) -> dict:
         t_step0 = time.perf_counter()
         S, F, T = self._ring_np.shape[:3]
         W = S * F * self.max_new               # scatter capacity
@@ -420,7 +505,8 @@ class TickEngine:
             with tickpath.coldstart("tick_engine",
                                     cold=self.dispatch_count == 0), \
                     meshprof.watch("tick_engine",
-                                   cold=self.dispatch_count == 0):
+                                   cold=self.dispatch_count == 0), \
+                    _precision_ctx(self.precision):
                 t_d0 = time.perf_counter()
                 self._ring, out = _tick_program(self._ring, self._base,
                                                 rows, s_ix, f_ix, pos,
@@ -441,6 +527,14 @@ class TickEngine:
                 t_hr = time.perf_counter()
                 host = host_read(out)
                 host_read_s = time.perf_counter() - t_hr
+                # readiness-mark the NEW ring too: on the XLA CPU thunk
+                # runtime an output-leaf sync does not cover the aliased
+                # ring output, and donating a buffer PJRT hasn't marked
+                # ready silently degrades the next dispatch to synchronous
+                # execution (the whole device compute lands inside the
+                # dispatch call).  The compute is already finished here,
+                # so this is event bookkeeping, not a wait.
+                jax.block_until_ready(self._ring)
         except Exception:
             # a mid-step abort (counted guard violation, XLA runtime
             # error) leaves the donated device ring in an unknown state;
@@ -454,17 +548,7 @@ class TickEngine:
         # existed BEFORE this dispatch; lanes past warm-up with no reference
         # capture this window's histogram as their baseline (one device_put,
         # no recompile — pathology stays array content).
-        drift_hist = host.pop("drift_hist")
-        drift_psi = host.pop("drift_psi")
-        ref_was_set = self._drift_ref_set.copy()
-        newly = valid & ~self._drift_ref_set
-        if newly.any():
-            self._drift_ref_np[newly] = drift_hist[newly]
-            self._drift_ref_set |= valid
-            self._drift_ref = jnp.asarray(self._drift_ref_np)
-            self.drift_ref_uploads += 1
-        self.last_drift = {"psi": drift_psi, "hist": drift_hist,
-                           "ref_set": ref_was_set}
+        self._pop_drift(host, valid, self._drift_ref_set.copy())
         # newest host output pytree: the tenant engine's feed
         # (ops/tenant_engine.py reads its [S, F] feature columns directly —
         # no per-symbol dict assembly between the two fused programs)
@@ -495,3 +579,222 @@ class TickEngine:
             tp.observe_phase("host_read", host_read_s)
             tp.observe_overlap(overlap_headroom_s)
         return host
+
+    def _pop_drift(self, host: dict, valid: np.ndarray,
+                   ref_was_set: np.ndarray) -> None:
+        """Pop the drift outputs off a drained readback into ``last_drift``
+        and capture first-full-window references (one device_put, never a
+        recompile).  ``ref_was_set`` is the reference state AS OF THE
+        DISPATCH that produced ``host`` — in pipelined mode that dispatch
+        happened one tick before this drain, so the snapshot rides the
+        in-flight record instead of being read now."""
+        drift_hist = host.pop("drift_hist")
+        drift_psi = host.pop("drift_psi")
+        newly = valid & ~self._drift_ref_set
+        if newly.any():
+            self._drift_ref_np[newly] = drift_hist[newly]
+            self._drift_ref_set |= valid
+            self._drift_ref = jnp.asarray(self._drift_ref_np)
+            self.drift_ref_uploads += 1
+        self.last_drift = {"psi": drift_psi, "hist": drift_hist,
+                           "ref_set": ref_was_set}
+
+    # -- pipelined step (double-buffered ring, async host_read) ---------------
+    def _scatter_capacity(self) -> int:
+        """Scatter-list capacity W.  A pipelined buffer dispatches every
+        other tick, so it accumulates up to TWO polls of row writes —
+        double the serial capacity (a different compiled shape; each
+        engine is one program either way)."""
+        S, F = self._ring_np.shape[:2]
+        return S * F * self.max_new * (2 if self.pipelined else 1)
+
+    def _build_scatter(self, pending: dict, W: int, T: int):
+        rows = np.zeros((W, 5), np.float32)
+        s_ix = np.zeros((W,), np.int32)
+        f_ix = np.zeros((W,), np.int32)
+        pos = np.full((W,), T, np.int32)       # T = dropped write
+        for w, ((ps, pf, p), row) in enumerate(pending.items()):
+            s_ix[w] = ps
+            f_ix[w] = pf
+            pos[w] = p
+            rows[w] = row
+        return rows, s_ix, f_ix, pos
+
+    def _abort_pipeline(self) -> None:
+        """A failed dispatch or drain leaves one or both donated device
+        rings in an unknown state.  Drop everything in flight and re-seed
+        BOTH buffers from the authoritative host mirror on the next step —
+        a transfer, never a compile, and never a duplicate publish (the
+        in-flight output is discarded, not re-drained)."""
+        self._inflight = None
+        self._bufs = [None, None]
+        self._buf_pending[0].clear()
+        self._buf_pending[1].clear()
+        self._need_seed = True
+
+    def _step_pipelined(self) -> dict | None:
+        t_step0 = time.perf_counter()
+        S, F, T = self._ring_np.shape[:3]
+        W = self._scatter_capacity()
+        # fan this poll's writes into BOTH buffers: the one dispatching
+        # now and the one that sat this tick out (dict assignment keeps
+        # latest-write-wins per absolute ring slot, so merging across
+        # ticks is safe — a superseded row simply never lands)
+        if self._pending:
+            self._buf_pending[0].update(self._pending)
+            self._buf_pending[1].update(self._pending)
+            self._pending.clear()
+        cur = self._cur
+        if len(self._buf_pending[cur]) > W:    # paranoia: spilled capacity
+            self._need_seed = True
+        seeded = self._bufs[cur] is None or self._need_seed
+        upload_bytes = 0
+        if seeded:
+            # re-seed BOTH buffers (two transfers, no compile): any
+            # accumulated per-buffer deltas are inside the seed already
+            self._bufs[0] = jnp.asarray(self._ring_np)
+            self._bufs[1] = jnp.asarray(self._ring_np)
+            upload_bytes += 2 * self._ring_np.nbytes
+            self._buf_pending[0].clear()
+            self._buf_pending[1].clear()
+            n_writes = 0
+            rows, s_ix, f_ix, pos = self._build_scatter({}, W, T)
+        else:
+            buf_pending = self._buf_pending[cur]
+            n_writes = len(buf_pending)
+            rows, s_ix, f_ix, pos = self._build_scatter(buf_pending, W, T)
+            buf_pending.clear()                # consumed by this dispatch
+            upload_bytes += (rows.nbytes + s_ix.nbytes + f_ix.nbytes
+                             + pos.nbytes)
+        valid = self._count >= T
+        if self._drift_ref is None:
+            self._drift_ref = jnp.asarray(self._drift_ref_np)
+        # one-shot cost card (shapes identical for both buffers — one
+        # card) + PER-BUFFER donation verification on each buffer's first
+        # profiled dispatch
+        carding = (devprof.active() is not None
+                   and not devprof.has_card("tick_engine"))
+        if carding:
+            devprof.cost_card("tick_engine", _tick_program, self._bufs[cur],
+                              self._base, rows, s_ix, f_ix, pos, valid,
+                              self._drift_ref)
+        verify = (devprof.active() is not None
+                  and not self._donation_checked[cur])
+        donated_ring = self._bufs[cur] if verify else None
+        cold = self.dispatch_count == 0
+        try:
+            with tickpath.coldstart("tick_engine", cold=cold), \
+                    meshprof.watch("tick_engine", cold=cold), \
+                    _precision_ctx(self.precision):
+                t_d0 = time.perf_counter()
+                self._bufs[cur], out = _tick_program(
+                    self._bufs[cur], self._base, rows, s_ix, f_ix, pos,
+                    valid, self._drift_ref)
+                t_d1 = time.perf_counter()
+                if donated_ring is not None:
+                    devprof.verify_donation("tick_engine", donated_ring)
+                    self._donation_checked[cur] = True
+        except Exception:
+            self._abort_pipeline()
+            raise
+        self.dispatch_count += 1
+        self._need_seed = False
+        self._cur = 1 - cur
+        scatter_build_s = t_d0 - t_step0
+        dispatch_s = t_d1 - t_d0
+        tp = tickpath.active()
+        if tp is not None:
+            tp.observe_phase("scatter_build", scatter_build_s)
+            tp.observe_phase("dispatch", dispatch_s)
+        prev, self._inflight = self._inflight, {
+            "out": out, "buf": cur, "valid": valid, "seeded": bool(seeded),
+            "n_writes": int(n_writes), "upload_bytes": int(upload_bytes),
+            "lanes": int(S * F), "scatter_capacity": int(W),
+            "scatter_build_s": scatter_build_s, "dispatch_s": dispatch_s,
+            # reference state as of THIS dispatch (see _pop_drift)
+            "ref_set": self._drift_ref_set.copy(),
+            "t_step0": t_step0, "t_disp_ret": t_d1,
+        }
+        if prev is None:
+            # pipeline fill: nothing to drain yet — the caller publishes
+            # nothing this tick and collects T's output next poll (or via
+            # flush() at teardown)
+            self.last_stats = {
+                "dispatches": 1, "upload_rows": int(n_writes),
+                "upload_bytes": int(upload_bytes), "full_seed": bool(seeded),
+                "lanes": int(S * F), "valid_lanes": int(valid.sum()),
+                "scatter_capacity": int(W), "host_read_s": 0.0,
+                "step_s": time.perf_counter() - t_step0, "inflight": True,
+            }
+            return None
+        return self._drain(prev)
+
+    def _drain(self, inflight: dict) -> dict:
+        """Collect one in-flight dispatch's readback: the async half of
+        the pipelined step.  The sentinel-leaf wait measures the RESIDUAL
+        device_compute — everything the host did since that dispatch
+        returned (publish, analyzer, executor, the next poll's fetch and
+        ingest) already overlapped it, and is scored as reclaimed overlap
+        (``tickpath_overlap_reclaimed_seconds``)."""
+        t_drain0 = time.perf_counter()
+        try:
+            t_w0 = time.perf_counter()
+            jax.block_until_ready(
+                jax.tree_util.tree_leaves(inflight["out"])[0])
+            # readiness-mark this dispatch's ring output as well: the
+            # output-leaf sync above does not cover the aliased ring on
+            # the CPU thunk runtime, and this buffer is the one the NEXT
+            # dispatch on it will donate — donating a buffer PJRT hasn't
+            # marked ready degrades that dispatch to synchronous
+            # execution, which is exactly the overlap this pipeline
+            # exists to reclaim
+            ring_new = self._bufs[inflight["buf"]]
+            if ring_new is not None:
+                jax.block_until_ready(ring_new)
+            t_ready = time.perf_counter()
+            t_hr = time.perf_counter()
+            host = host_read(inflight["out"])
+            host_read_s = time.perf_counter() - t_hr
+        except Exception:
+            # a wedged/failed drain must not wedge the ring: drop the
+            # in-flight outputs (this one AND the dispatch just issued)
+            # and re-seed from the host mirror — the caller's stage
+            # breaker handles the skipped tick
+            self._abort_pipeline()
+            raise
+        valid = inflight["valid"]
+        self._pop_drift(host, valid, inflight["ref_set"])
+        self.last_valid = valid
+        self.last_out = host
+        device_compute_s = t_ready - t_w0      # residual blocked wait
+        reclaimed_s = max(t_w0 - inflight["t_disp_ret"], 0.0)
+        self.last_stats = {
+            "dispatches": 1, "upload_rows": inflight["n_writes"],
+            "upload_bytes": inflight["upload_bytes"],
+            "full_seed": inflight["seeded"], "lanes": inflight["lanes"],
+            "valid_lanes": int(valid.sum()),
+            "scatter_capacity": inflight["scatter_capacity"],
+            "scatter_build_s": inflight["scatter_build_s"],
+            "dispatch_s": inflight["dispatch_s"],
+            "device_compute_s": device_compute_s,
+            "overlap_headroom_s": device_compute_s,
+            "overlap_reclaimed_s": reclaimed_s,
+            "host_read_s": host_read_s,
+            "step_s": time.perf_counter() - t_drain0,
+        }
+        tp = tickpath.active()
+        if tp is not None:
+            tp.observe_phase("device_compute", device_compute_s)
+            tp.observe_phase("host_read", host_read_s)
+            tp.observe_overlap(device_compute_s)
+            tp.observe_reclaimed(reclaimed_s)
+        return host
+
+    def flush(self) -> dict | None:
+        """Drain the in-flight dispatch, if any: the pipeline teardown /
+        parity seam (monitor.flush_pipeline, shutdown, tests).  Returns
+        the drained host output, or None when nothing was in flight."""
+        inflight, self._inflight = self._inflight, None
+        if inflight is None:
+            return None
+        return self._drain(inflight)

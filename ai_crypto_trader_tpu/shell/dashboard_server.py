@@ -309,6 +309,13 @@ class DashboardServer:
             # two blocks)
             out["tickpath"] = tickpath.status()
             out["coldstart"] = tickpath.coldstart_status()
+        aot = getattr(system, "aot_cache", None)
+        if aot is not None:
+            # persistent AOT compile cache (utils/aotcache.py): whether
+            # this restart replayed the hot set (warm), where the
+            # provenance-keyed directory points, and why the cache is
+            # off when it's off
+            out["aot_cache"] = aot.status()
         build = getattr(system, "build_info", None)
         if build is not None:
             # process provenance: start time, jax version, backend, device

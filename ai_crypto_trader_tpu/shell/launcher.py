@@ -113,6 +113,23 @@ class TradingSystem:
     # Measured fused-tick overhead is budgeted ≤5% (stamped by the bench
     # stream_latency row); the disabled path is one module-global check.
     enable_tickpath: bool = True
+    # Pipelined tick path (ROADMAP item 4, ops/tick_engine.py): the fused
+    # monitor double-buffers the candle ring and publishes tick T−1 while
+    # T computes on device — host work overlaps device_compute, and the
+    # waterfall's host_read collapses into reclaimed overlap
+    # (tickpath_overlap_reclaimed_seconds).  Serial (False) stays the
+    # default and the parity oracle.
+    pipelined: bool = False
+    # Matmul precision for the fused decide programs ("bf16" = the PR 2
+    # reduced-precision knob threaded through tick/tenant engines);
+    # None = full f32.
+    precision: str | None = None
+    # Persistent AOT compile cache (utils/aotcache.py): when set, the JAX
+    # compilation cache points at <dir>/<provenance-key> BEFORE the first
+    # hot compile, so a production restart REPLAYS the carded executables
+    # (~29 s of tick-engine compile on the dev CPU) instead of rebuilding
+    # them.  Every failure degrades to a recompile, never a crash.
+    aot_cache_dir: str | None = None
     # Stage supervision (utils/supervision.py): a non-ExchangeUnavailable
     # exception inside monitor/analyzer/executor is isolated with
     # exponential backoff; N consecutive failures quarantine the stage
@@ -206,6 +223,18 @@ class TradingSystem:
             self.build_info["device_kind"] = jax.devices()[0].device_kind
         except Exception:                  # noqa: BLE001 — provenance is
             pass                           # best-effort, never fatal
+        # persistent AOT compile cache: enabled between provenance
+        # resolution and the FIRST hot compile (every engine compiles
+        # lazily at its first dispatch, so this is early enough); a
+        # failed enable() runs uncached — recorded, never raised
+        self.aot_cache = None
+        if self.aot_cache_dir:
+            from ai_crypto_trader_tpu.utils.aotcache import AOTCache
+
+            self.aot_cache = AOTCache(self.aot_cache_dir)
+            if not self.aot_cache.enable(self.build_info):
+                self.log.warning("aot cache disabled",
+                                 error=self.aot_cache.error)
         # bus telemetry: fanout latency + queue depth metrics, and slow-
         # subscriber warnings through the structured log (trace-correlated)
         self.bus = EventBus(now_fn=self.now_fn, metrics=self.metrics,
@@ -248,7 +277,9 @@ class TradingSystem:
             self.attribution = PnLAttribution(metrics=self.metrics)
         self._attr_cursor = 0
         self.monitor = MarketMonitor(self.bus, self.exchange,
-                                     symbols=self.symbols, now_fn=self.now_fn)
+                                     symbols=self.symbols, now_fn=self.now_fn,
+                                     pipelined=self.pipelined,
+                                     precision=self.precision)
         self.analyzer = SignalAnalyzer(
             self.bus, now_fn=self.now_fn, flightrec=self.flightrec,
             analysis_interval_s=self.config.trading.ai_analysis_interval)
@@ -959,6 +990,8 @@ class TradingSystem:
             capture = getattr(self.stream.stream, "depth", None)
             if capture is not None:
                 capture.close()            # flush the depth JSONL tail
+        if self.aot_cache is not None:
+            self.aot_cache.close()         # release the writer flock
 
     async def run(self, duration_s: float | None = None,
                   tick_interval_s: float = 5.0):

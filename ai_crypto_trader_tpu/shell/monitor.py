@@ -71,6 +71,16 @@ class MarketMonitor:
     # (kept as the parity oracle and for ad-hoc off-universe polls).
     fused: bool = True
     max_new: int = 8                    # ring rows per (s, f) before re-seed
+    # Pipelined tick path (ROADMAP item 4): the engine double-buffers the
+    # candle ring and step() returns tick T−1's output while T computes on
+    # device; the monitor carries each tick's publish context (due list,
+    # fetched klines, wall clock, event-time snapshot) one poll forward so
+    # published payloads stay byte-identical to serial mode at matched
+    # ticks — the parity-test seam.  False = the serial dispatch+readback.
+    pipelined: bool = False
+    # Matmul precision for the fused decide program (the PR 2 knob,
+    # models/train_loop.canonical_precision names); None = full f32.
+    precision: str | None = None
     # per-symbol primary-frame feature drift ({symbol: {feature: PSI}}),
     # refreshed by each fused poll from the engine's on-device PSI output
     # (obs/drift.py); the launcher exports feature_psi gauges from this
@@ -78,6 +88,10 @@ class MarketMonitor:
     _engine: TickEngine | None = field(default=None, repr=False)
     _last_pub: dict = field(default_factory=dict)
     _warming: set = field(default_factory=set)
+    # the in-flight tick's publish context (pipelined mode): consumed by
+    # the NEXT poll's drain, invalidated when a dispatch fails so a
+    # re-seeded ring can never pair with a stale context
+    _pending_pub: dict | None = field(default=None, repr=False)
 
     def _note_warmup(self, symbol: str, interval: str, have: int):
         """Surface the cold-start gap (VERDICT r4 weak#5): a frame below the
@@ -174,10 +188,15 @@ class MarketMonitor:
         if (eng is None or eng.symbols != list(self.symbols)
                 or eng.intervals != tuple(self.intervals)
                 or eng.window != self.kline_limit
-                or eng.max_new != self.max_new):
+                or eng.max_new != self.max_new
+                or eng.pipelined != self.pipelined
+                or eng.precision != self.precision):
             self._engine = eng = TickEngine(
                 self.symbols, self.intervals, window=self.kline_limit,
-                max_new=self.max_new)
+                max_new=self.max_new, pipelined=self.pipelined,
+                precision=self.precision)
+            self._pending_pub = None       # stale ctx can't pair with a
+            #                                fresh engine's pipeline
         return eng
 
     def _extract_features(self, out: dict, s: int,
@@ -335,10 +354,17 @@ class MarketMonitor:
                     if kl is None:
                         fetched[(symbol, iv0)] = None
                         continue
+                    # stream-served windows carry provenance: the engine
+                    # ring already holds every row (applied one-by-one via
+                    # ingest_row as the frames landed), so the full-window
+                    # re-diff below would find zero changes — skip the
+                    # re-parse + re-diff for that lane entirely.  Any
+                    # plain list (REST, tests) still takes the full path.
+                    current = getattr(kl, "engine_current", False)
                     kl = kl[-self.kline_limit:]
                     fetched[(symbol, iv0)] = kl
                     self._note_warmup(symbol, iv0, len(kl))
-                    if kl:
+                    if kl and not current:
                         eng.ingest(symbol, iv0, kl)
                     if len(kl) < self.kline_limit:
                         continue        # warming: no publish, like the
@@ -346,8 +372,10 @@ class MarketMonitor:
                     for iv in self.intervals[1:]:
                         res = fetch(symbol, iv)
                         if res:
+                            cur = getattr(res, "engine_current", False)
                             res = res[-self.kline_limit:]
-                            eng.ingest(symbol, iv, res)
+                            if not cur:
+                                eng.ingest(symbol, iv, res)
                         fetched[(symbol, iv)] = res
                 except Exception as e:   # noqa: BLE001 — re-raised below
                     fetch_error = e
@@ -362,16 +390,81 @@ class MarketMonitor:
             # outage (every fetch None) or universe-wide cold start: nothing
             # can publish, so skip the dispatch + readback entirely — the
             # per-symbol path did zero device work here too.  Queued ingest
-            # deltas stay pending and ride the next poll's step.
+            # deltas stay pending and ride the next poll's step.  A
+            # pipelined tick still in flight drains NOW rather than aging
+            # behind an idle poll.
+            published = 0
+            if self.pipelined and self._pending_pub is not None:
+                published = await self._flush_fused()
             if fetch_error is not None:
                 raise fetch_error
-            return 0
-        with tracing.span("monitor.tick_engine", service="monitor") as sp:
-            out = eng.step()
-            sp.set_attribute("symbols", len(due))
-            for k, v in eng.last_stats.items():
-                sp.set_attribute(k, v)
+            return published
+        try:
+            with tracing.span("monitor.tick_engine", service="monitor") as sp:
+                out = eng.step()
+                sp.set_attribute("symbols", len(due))
+                for k, v in eng.last_stats.items():
+                    sp.set_attribute(k, v)
+        except Exception:
+            # the engine dropped everything in flight and will re-seed;
+            # its publish context must die with it — a stale context can
+            # never pair with a later tick's output (duplicate publish)
+            self._pending_pub = None
+            raise
+        if self.pipelined:
+            # carry THIS tick's context forward; publish the PREVIOUS
+            # tick's drained output with the context captured at ITS
+            # dispatch, so payloads match serial mode byte for byte
+            prev = self._pending_pub
+            self._pending_pub = {"due": due, "fetched": fetched, "now": now,
+                                 "event_ms": dict(eng.last_event_ms)}
+            if out is None or prev is None:
+                if fetch_error is not None:
+                    raise fetch_error
+                return 0                   # pipeline fill: nothing drained
+            self._expose_drift(eng, prev["due"])
+            published = await self._publish_batch(
+                eng, out, prev["due"], prev["fetched"], prev["now"],
+                event_ms=prev["event_ms"])
+            if fetch_error is not None:
+                raise fetch_error
+            return published
         self._expose_drift(eng, due)
+        published = await self._publish_batch(eng, out, due, fetched, now)
+        if fetch_error is not None:
+            raise fetch_error
+        return published
+
+    async def flush_pipeline(self) -> int:
+        """Drain seam: collect + publish the in-flight pipelined tick, if
+        any — the last tick's output at shutdown, the parity tests'
+        equalizer, and the idle-poll drain.  No-op in serial mode."""
+        if not self.pipelined or self._engine is None:
+            return 0
+        return await self._flush_fused()
+
+    async def _flush_fused(self) -> int:
+        eng = self._engine
+        ctx, self._pending_pub = self._pending_pub, None
+        out = eng.flush()                  # a failed drain re-seeds + raises
+        if ctx is None or out is None:
+            return 0
+        self._expose_drift(eng, ctx["due"])
+        return await self._publish_batch(eng, out, ctx["due"],
+                                         ctx["fetched"], ctx["now"],
+                                         event_ms=ctx["event_ms"])
+
+    async def _publish_batch(self, eng: TickEngine, out: dict, due: list,
+                             fetched: dict, now: float,
+                             event_ms: dict | None = None) -> int:
+        """Per-symbol feature extraction + bus fan-out for one drained
+        tick — shared verbatim by the serial and pipelined paths.
+        ``event_ms`` is the pipelined path's event-time snapshot captured
+        at the tick's DISPATCH (serial passes None and reads the engine
+        live — same values, the snapshot just pins them across the one
+        -poll carry)."""
+        iv0 = self.intervals[0]
+        ev_src = event_ms if event_ms is not None else eng.last_event_ms
         blend_iv = self._blend_iv()
         published = 0
         t_pub0 = time.perf_counter()
@@ -417,7 +510,7 @@ class MarketMonitor:
                 # the engine's newest candle/stream event time — the
                 # analyzer stamps event_age_ms onto the flight-recorder
                 # record from this field (obs/tickpath.py)
-                ev_ms = eng.last_event_ms.get(symbol)
+                ev_ms = ev_src.get(symbol)
                 if ev_ms is not None:
                     update["event_ms"] = ev_ms
                 self.bus.set(f"market_data_{symbol}", update)
@@ -427,8 +520,6 @@ class MarketMonitor:
         # publish/fan-out phase: per-symbol feature extraction + bus set
         # + market_updates publish for the whole batch
         tickpath.observe_phase("publish", time.perf_counter() - t_pub0)
-        if fetch_error is not None:
-            raise fetch_error
         return published
 
     def _expose_drift(self, eng: TickEngine, due: list) -> None:

@@ -325,6 +325,18 @@ class _CandleBook:
         return "append"
 
 
+class _ServedWindow(list):
+    """Kline rows + provenance for the fused poll.  ``engine_current=True``
+    asserts the tick engine's ring already reflects every row in this
+    window (each one was applied via ``TickEngine.ingest_row`` when its
+    frame landed), so the monitor may skip the full-window re-diff for
+    the lane — the diff would provably find zero changes.  A plain list
+    (REST backfill, tests, any non-stream source) carries no such claim
+    and always takes the full ingest path."""
+
+    engine_current = False
+
+
 @dataclass
 class MarketStream:
     """Frames → continuity-checked candle books → batched monitor refresh.
@@ -357,6 +369,16 @@ class MarketStream:
     # REST fetch — a once-seeded lane whose kline channel isn't in the
     # subscription must never freeze its indicators on stale rows
     book_fresh_s: float = 90.0
+    # frame micro-batching (ROADMAP item 4): run() coalesces frames that
+    # are already queued — or arrive within ``microbatch_s`` — into ONE
+    # ingest burst followed by ONE fused drain, instead of one dispatch
+    # per frame.  The wait bounds the added decision latency to
+    # microbatch_s per burst, three orders of magnitude under the 2 s
+    # event-age budget (obs/tickpath.DEFAULT_EVENT_AGE_BUDGET_MS);
+    # ``microbatch`` caps the burst so a firehose can never starve the
+    # drain.  microbatch=1 restores strict frame-per-dispatch.
+    microbatch: int = 64
+    microbatch_s: float = 0.001
     # bounded depth-frame capture (None = depth frames are ignored).  The
     # capture rides the SAME parsed-frame path as klines/miniTickers, so
     # a mixed combined-stream subscription needs no second socket.
@@ -380,6 +402,9 @@ class MarketStream:
     backfills: int = 0
     frames_ignored: int = 0                      # off-universe / off-interval
     streamed_rows: int = 0                       # rows applied to the engine
+    served_current: int = 0                      # windows served engine-current
+    micro_batches: int = 0                       # drains that coalesced > 1
+    micro_batched_frames: int = 0                # frames riding those drains
     last_event_ms: int = 0                       # newest exchange event time
 
     # -- parsing --------------------------------------------------------------
@@ -626,7 +651,16 @@ class MarketStream:
             if rows:
                 book.seed(rows)
             return rows
-        return list(book.rows)
+        rows = _ServedWindow(book.rows)
+        # steady-state fast path: every row in this window already rode
+        # ingest_row into the engine's ring, so stamp the provenance that
+        # lets the fused poll skip re-parsing + re-diffing all window ×
+        # lane rows per tick (the dominant host cost once warm)
+        eng = getattr(self.monitor, "_engine", None)
+        if eng is not None and eng.lane_synced(symbol, interval):
+            rows.engine_current = True
+            self.served_current += 1
+        return rows
 
     def _symbol_needs_backfill(self, symbol: str) -> bool:
         """Would serving this symbol hit REST?  (Same predicate
@@ -675,15 +709,51 @@ class MarketStream:
 
     async def run(self, frames: AsyncIterator[str]) -> int:
         """Consume a frame source to exhaustion (or cancellation); returns
-        the number of updates published."""
+        the number of updates published.
+
+        Bursty sources micro-batch: after the head frame of a cycle, any
+        frames already queued (or arriving within ``microbatch_s``) fold
+        into the SAME ingest pass, so the whole burst rides ONE fused
+        drain — one dispatch, one readback — instead of a dispatch per
+        frame.  A frame that arrives after the budget is never dropped:
+        its pending read becomes the next cycle's head."""
         published = 0
-        async for frame in frames:
-            # one root span per frame: the stream is where a live tick's
+        it = frames.__aiter__()
+        head_task = None            # a not-yet-arrived frame read, carried
+        exhausted = False           # across cycles instead of cancelled
+        while not exhausted:
+            task = (head_task if head_task is not None
+                    else asyncio.ensure_future(it.__anext__()))
+            head_task = None
+            try:
+                frame = await task
+            except StopAsyncIteration:
+                break
+            # one root span per burst: the stream is where a live tick's
             # causal chain begins, so downstream monitor/analyzer/executor
             # spans all hang off this trace
             with tracing.span("stream.frame", service="stream") as sp:
-                marked = self.ingest_frame(frame)
+                marked = list(self.ingest_frame(frame))
+                burst = 1
+                while burst < max(self.microbatch, 1):
+                    task = asyncio.ensure_future(it.__anext__())
+                    done, _ = await asyncio.wait(
+                        {task}, timeout=max(self.microbatch_s, 0.0))
+                    if task not in done:
+                        head_task = task   # arrives later → next cycle
+                        break
+                    try:
+                        nxt = task.result()
+                    except StopAsyncIteration:
+                        exhausted = True
+                        break
+                    marked.extend(self.ingest_frame(nxt))
+                    burst += 1
+                if burst > 1:
+                    self.micro_batches += 1
+                    self.micro_batched_frames += burst
                 n = await self.drain()
+                sp.set_attribute("frames", burst)
                 sp.set_attribute("marked", len(marked))
                 sp.set_attribute("published", n)
                 # fused-monitor drains: how many candle rows this batch
@@ -892,6 +962,11 @@ class StreamSupervisor:
               d("stream_out_of_order_total", st.ooo_frames))
         m.inc("stream_malformed_frames_total",
               d("stream_malformed_frames_total", st.malformed_frames))
+        m.inc("stream_micro_batches_total",
+              d("stream_micro_batches_total", st.micro_batches))
+        m.inc("stream_micro_batched_frames_total",
+              d("stream_micro_batched_frames_total",
+                st.micro_batched_frames))
         dc = st.depth
         if dc is not None:
             # depth-capture telemetry rides the same export: totals as

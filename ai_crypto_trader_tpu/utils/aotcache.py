@@ -1,0 +1,217 @@
+"""Persistent AOT compile cache: a production restart replays the hot set.
+
+The cold-start ledger (obs/tickpath.py, PR 16) put a number on restart
+downtime: ~34 s on the dev CPU, ~29 s of it the tick-engine first
+compile.  None of that work depends on anything but the program and the
+toolchain — so this module keys the JAX persistent compilation cache by
+the BUILD-PROVENANCE block (jax version, backend, device kind: the
+``build_info`` coordinates the launcher already stamps on /state.json)
+and points ``jax_compilation_cache_dir`` at the matching subdirectory.
+A warm restart then REPLAYS every carded executable (the
+JitCompileMonitor counts ``cache_hits`` instead of
+``backend_compile_duration``; the cold-start ledger's ``cache_hits``
+field is the evidence) instead of recompiling the whole hot set.
+
+Three disciplines, all inherited from hard-won precedents:
+
+  * **Provenance keying**: executables serialized under one toolchain are
+    undefined under another.  The active directory is
+    ``<path>/<sha256(jax_version, backend, device_kind)[:16]>`` — a
+    toolchain upgrade lands in a FRESH directory, so a stale cache is
+    structurally unreachable rather than detected-and-handled.
+  * **Single writer** (the tests/conftest.py flock pattern): concurrent
+    writers tear entries, and jax SEGFAULTS — not raises — reading a torn
+    entry back.  The advisory ``flock`` on a long-lived fd has no stale
+    state (the kernel releases it when the owner dies); a second process
+    that cannot take the lock runs UNCACHED, never half-cached.
+  * **Fallback = recompile, never crash**: every failure mode here
+    (unwritable dir, lock contention, a corrupt entry pruned by hand,
+    jax config drift) degrades to exactly the behavior before this
+    module existed — a cold compile — and is recorded on ``status()``
+    for /state.json instead of raised into the tick path.
+
+The directory is size-bounded: ``enable()`` prunes oldest-mtime entries
+past ``max_bytes`` while holding the writer lock, so a long-lived host
+can't grow an unbounded executable museum.  ``prune_dir`` is the shared
+helper conftest.py reuses to bound the tier-1 test cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+#: default directory size bound — a handful of carded executables is a
+#: few MB; 512 MB absorbs years of shape drift before pruning matters
+DEFAULT_MAX_BYTES = 512 * 1024 * 1024
+#: compiles cheaper than this aren't worth a disk entry (the conftest
+#: threshold is 1.0 s; production keeps smaller programs too so a warm
+#: restart replays the mid-size tenant/analyzer programs as well)
+DEFAULT_MIN_COMPILE_TIME_S = 0.2
+
+#: bookkeeping files that are never cache entries (and never pruned)
+_META_FILES = (".writer.pid", "meta.json")
+
+
+def _dir_entries(path: str) -> list[tuple[str, float, int]]:
+    """(file, mtime, bytes) for every cache entry under ``path`` —
+    bookkeeping files excluded."""
+    out = []
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return out
+    for name in names:
+        if name in _META_FILES:
+            continue
+        fp = os.path.join(path, name)
+        try:
+            st = os.stat(fp)
+        except OSError:
+            continue
+        if os.path.isfile(fp):
+            out.append((fp, st.st_mtime, st.st_size))
+    return out
+
+
+def prune_dir(path: str, max_bytes: int) -> int:
+    """Delete oldest-mtime cache entries until the directory fits in
+    ``max_bytes``; returns the number of files removed.  Callers hold the
+    writer lock — pruning a file another process is reading would recreate
+    exactly the torn-entry segfault the lock exists to prevent."""
+    entries = _dir_entries(path)
+    total = sum(size for _, _, size in entries)
+    if total <= max_bytes:
+        return 0
+    removed = 0
+    for fp, _, size in sorted(entries, key=lambda e: e[1]):
+        if total <= max_bytes:
+            break
+        try:
+            os.remove(fp)
+            total -= size
+            removed += 1
+        except OSError:
+            continue
+    return removed
+
+
+def provenance_key(build_info: dict | None = None) -> str:
+    """Cache-directory key over the build-provenance coordinates that
+    determine executable compatibility (the launcher's ``build_info``
+    block).  Missing coordinates are resolved from the live jax runtime
+    so a bare child process (the bench coldstart subprocess) keys
+    identically to the launcher that populated the cache."""
+    import jax
+
+    info = build_info or {}
+    coords = {
+        "jax_version": info.get("jax_version") or jax.__version__,
+        "backend": info.get("backend") or jax.default_backend(),
+        "device_kind": (info.get("device_kind")
+                        or jax.devices()[0].device_kind),
+    }
+    blob = json.dumps(coords, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+class AOTCache:
+    """One process's handle on the persistent compile cache.
+
+    ``enable()`` (call BEFORE the first hot compile) points jax at the
+    provenance-keyed subdirectory under the writer lock; ``status()`` is
+    the /state.json block; ``close()`` releases the lock at shutdown.
+    Every failure is recorded, none is raised."""
+
+    def __init__(self, path: str, *, max_bytes: int = DEFAULT_MAX_BYTES,
+                 min_compile_time_s: float = DEFAULT_MIN_COMPILE_TIME_S):
+        self.path = str(path)
+        self.max_bytes = int(max_bytes)
+        self.min_compile_time_s = float(min_compile_time_s)
+        self.enabled = False
+        self.active_dir: str | None = None
+        self.key: str | None = None
+        self.warm = False                 # entries existed at enable time
+        self.entries_at_enable = 0
+        self.bytes_at_enable = 0
+        self.pruned_files = 0
+        self.error: str | None = None
+        self._lock_fh = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def enable(self, build_info: dict | None = None) -> bool:
+        """Activate the cache: resolve the provenance directory, take the
+        writer lock, prune past the size bound, and re-point jax's
+        persistent compilation cache.  False (with ``error`` set) means
+        the process runs uncached — a recompile, never a crash."""
+        import fcntl
+
+        import jax
+
+        try:
+            self.key = provenance_key(build_info)
+            active = os.path.join(self.path, self.key)
+            os.makedirs(active, exist_ok=True)
+            fh = open(os.path.join(active, ".writer.pid"), "a+")
+            try:
+                fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                fh.close()
+                self.error = "concurrent writer holds the cache lock"
+                return False
+            fh.seek(0)
+            fh.truncate()
+            fh.write(str(os.getpid()))
+            fh.flush()
+            self._lock_fh = fh            # fd lifetime IS the lock lifetime
+            self.pruned_files = prune_dir(active, self.max_bytes)
+            entries = _dir_entries(active)
+            self.entries_at_enable = len(entries)
+            self.bytes_at_enable = sum(size for _, _, size in entries)
+            self.warm = self.entries_at_enable > 0
+            jax.config.update("jax_compilation_cache_dir", active)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              self.min_compile_time_s)
+            meta = os.path.join(active, "meta.json")
+            with open(meta, "w") as f:
+                json.dump({"key": self.key, "pid": os.getpid(),
+                           "t": time.time(),
+                           "jax_version": jax.__version__}, f)
+            self.active_dir = active
+            self.enabled = True
+            return True
+        except Exception as exc:          # noqa: BLE001 — never crash
+            self.error = f"{type(exc).__name__}: {exc}"
+            return False
+
+    def close(self) -> None:
+        """Release the writer lock (shutdown seam).  The pidfile stays as
+        a breadcrumb — see the conftest lock notes on why removing it
+        could split the lock between two late starters."""
+        if self._lock_fh is not None:
+            try:
+                self._lock_fh.close()
+            finally:
+                self._lock_fh = None
+
+    # -- views ---------------------------------------------------------------
+    def status(self) -> dict:
+        """The /state.json ``aot_cache`` block: where the cache points,
+        whether this restart was warm, and why it's off when it's off."""
+        entries = (_dir_entries(self.active_dir)
+                   if self.active_dir else [])
+        return {
+            "enabled": self.enabled,
+            "dir": self.active_dir,
+            "key": self.key,
+            "warm": self.warm,
+            "entries_at_enable": self.entries_at_enable,
+            "bytes_at_enable": self.bytes_at_enable,
+            "entries": len(entries),
+            "bytes": sum(size for _, _, size in entries),
+            "pruned_files": self.pruned_files,
+            "max_bytes": self.max_bytes,
+            "error": self.error,
+        }

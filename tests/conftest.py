@@ -93,6 +93,16 @@ def pytest_configure(config):
     if not _acquire_cache_lock(cache_dir):
         return                           # concurrent same-tier run: no cache
     _TEST_CACHE_DIR = cache_dir
+    # Size-bound the per-tier cache while we hold the writer lock (same
+    # oldest-mtime policy as the production AOT cache — utils/aotcache.py
+    # shares the helper): months of shape churn otherwise grow an
+    # unbounded executable museum under .jax_cache_test.
+    from ai_crypto_trader_tpu.utils.aotcache import prune_dir
+
+    pruned = prune_dir(cache_dir, 256 * 1024 * 1024)
+    if pruned:
+        print(f"[conftest] pruned {pruned} old compile-cache entries "
+              f"from {cache_dir}", file=sys.stderr)
     jax.config.update("jax_compilation_cache_dir", _TEST_CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 

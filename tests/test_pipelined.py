@@ -1,0 +1,517 @@
+"""Pipelined tick path (double-buffered ring + async host_read) and the
+persistent AOT compile cache.
+
+The pipelined engine's contract is PARITY SHIFTED BY ONE TICK: the same
+tape through serial and pipelined engines yields byte-identical outputs,
+delivered one step later; the monitor carries the matching publish
+context so the published `market_updates` payloads are byte-identical
+too.  The serial path (default) stays the oracle — the pipelined toggle
+is ONE ctor knob, which is exactly what these tests flip.
+
+The failure contract: a wedged drain drops everything in flight and
+re-seeds the ring (transfer, never a compile, never a duplicate publish);
+a stale/contended/corrupt compile cache degrades to a recompile, never a
+crash (docs/RESILIENCE.md rows)."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ai_crypto_trader_tpu.data.ingest import OHLCV
+from ai_crypto_trader_tpu.data.synthetic import generate_ohlcv
+from ai_crypto_trader_tpu.ops import tick_engine
+from ai_crypto_trader_tpu.ops.tick_engine import TickEngine
+from ai_crypto_trader_tpu.shell.bus import EventBus
+from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+from ai_crypto_trader_tpu.shell.monitor import MarketMonitor
+from ai_crypto_trader_tpu.utils import aotcache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIMIT = 128          # same compiled shape bucket as tests/test_stream.py
+
+
+def _series(n=900, seed=7, symbol="BTCUSDC"):
+    d = generate_ohlcv(n=n, seed=seed)
+    return OHLCV(timestamp=np.arange(n, dtype=np.int64) * 60_000,
+                 open=d["open"], high=d["high"], low=d["low"],
+                 close=d["close"], volume=d["volume"] * 1000, symbol=symbol)
+
+
+def _exchange(symbols=("BTCUSDC", "ETHUSDC"), n=900, advance=700):
+    ex = FakeExchange({s: _series(n=n, seed=7 + i, symbol=s)
+                       for i, s in enumerate(symbols)})
+    ex.advance(steps=advance)
+    return ex
+
+
+def _feed(eng, ex, symbols, intervals):
+    for s in symbols:
+        for iv in intervals:
+            eng.ingest(s, iv, ex.get_klines(s, iv, LIMIT))
+
+
+def _assert_tree_equal(a, b, where=""):
+    """Byte-identical pytree-of-arrays comparison (dicts of arrays and
+    nested dicts — the engine's host output)."""
+    assert set(a) == set(b), (where, set(a) ^ set(b))
+    for k in b:
+        if isinstance(b[k], dict):
+            _assert_tree_equal(a[k], b[k], f"{where}/{k}")
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(a[k]), np.asarray(b[k]), err_msg=f"{where}/{k}")
+
+
+class TestEnginePipelined:
+    def test_outputs_match_serial_shifted_one_tick(self):
+        """The tentpole parity: pipelined tick T returns serial tick T−1's
+        output byte for byte (same ring, same scatter, same program);
+        flush() delivers the final tick."""
+        symbols, ivs = ("BTCUSDC", "ETHUSDC"), ("1m", "3m")
+        ex_s = _exchange(symbols)
+        ex_p = _exchange(symbols)      # identical tape, independent cursor
+        serial = TickEngine(list(symbols), list(ivs), window=LIMIT)
+        pipe = TickEngine(list(symbols), list(ivs), window=LIMIT,
+                          pipelined=True)
+        serial_outs = []
+        pipe_outs = []
+        for i in range(5):
+            _feed(serial, ex_s, symbols, ivs)
+            _feed(pipe, ex_p, symbols, ivs)
+            serial_outs.append(serial.step())
+            got = pipe.step()
+            if i == 0:
+                assert got is None                 # pipeline fill
+                assert pipe.last_stats["inflight"]
+            else:
+                pipe_outs.append(got)
+            ex_s.advance(steps=1)
+            ex_p.advance(steps=1)
+        pipe_outs.append(pipe.flush())             # the final inflight tick
+        assert pipe.flush() is None                # idempotent drain
+        assert len(pipe_outs) == len(serial_outs) == 5
+        for i, (a, b) in enumerate(zip(pipe_outs, serial_outs)):
+            _assert_tree_equal(a, b, f"tick{i}")
+        assert serial.dispatch_count == pipe.dispatch_count == 5
+
+    def test_contract_one_host_read_zero_steady_recompiles(self, monkeypatch):
+        """The serial poll contract, pipelined: ONE host_read per steady
+        step (the drain), zero steady-window recompiles even though the
+        dispatch alternates buffers, and donation verified on BOTH
+        buffers."""
+        from ai_crypto_trader_tpu.utils import devprof, meshprof
+
+        symbols, ivs = ("BTCUSDC", "ETHUSDC"), ("1m",)
+        ex = _exchange(symbols)
+        eng = TickEngine(list(symbols), list(ivs), window=LIMIT,
+                         pipelined=True)
+        syncs = {"n": 0}
+        real_read = tick_engine.host_read
+
+        def counting_read(tree):
+            syncs["n"] += 1
+            return real_read(tree)
+
+        monkeypatch.setattr(tick_engine, "host_read", counting_read)
+        mp = meshprof.MeshProf()
+        with devprof.use(devprof.DevProf()), meshprof.use(mp):
+            _feed(eng, ex, symbols, ivs)
+            assert eng.step() is None              # seed + compile, fill
+            assert syncs["n"] == 0                 # nothing drained yet
+            for tick in range(1, 4):               # steady state
+                ex.advance(steps=1)
+                _feed(eng, ex, symbols, ivs)
+                assert eng.step() is not None
+                assert syncs["n"] == tick          # ONE read per step
+                # drained stats describe the PREVIOUS dispatch (the tick
+                # just collected): tick 1 drains the seed itself
+                stats = eng.last_stats
+                assert stats["full_seed"] == (tick == 1)
+                assert stats["overlap_reclaimed_s"] >= 0.0
+        # the sentinel saw ZERO steady compiles across BOTH buffers (the
+        # two rings share one compiled shape) and donation was verified
+        # on each buffer's first profiled dispatch
+        assert mp.recompiles.steady_total() == 0, mp.recompiles.status()
+        assert eng._donation_checked == [True, True]
+        assert eng.dispatch_count == 4
+        # doubled scatter capacity: a buffer consumes up to TWO polls
+        assert eng.last_stats["scatter_capacity"] == \
+            eng._ring_np.shape[0] * eng._ring_np.shape[1] * eng.max_new * 2
+
+    def test_failed_drain_reseeds_not_wedges(self, monkeypatch):
+        """RESILIENCE row: a drain that dies (device reset, XLA abort)
+        drops every in-flight buffer, re-seeds on the next step, and the
+        post-recovery outputs still match the serial oracle."""
+        symbols, ivs = ("BTCUSDC",), ("1m",)
+        ex_p = _exchange(symbols)
+        ex_s = _exchange(symbols)
+        eng = TickEngine(list(symbols), list(ivs), window=LIMIT,
+                         pipelined=True)
+        oracle = TickEngine(list(symbols), list(ivs), window=LIMIT)
+        _feed(eng, ex_p, symbols, ivs)
+        _feed(oracle, ex_s, symbols, ivs)
+        assert eng.step() is None
+        oracle.step()
+
+        real_read = tick_engine.host_read
+
+        def dying_read(tree):
+            raise RuntimeError("device wedged mid-readback")
+
+        monkeypatch.setattr(tick_engine, "host_read", dying_read)
+        ex_p.advance(steps=1)
+        ex_s.advance(steps=1)
+        _feed(eng, ex_p, symbols, ivs)
+        _feed(oracle, ex_s, symbols, ivs)
+        with pytest.raises(RuntimeError, match="wedged"):
+            eng.step()                             # drain of tick 1 dies
+        # pipeline fully aborted: nothing in flight, both buffers dropped,
+        # next step re-seeds from the host mirror
+        assert eng._inflight is None
+        assert eng._bufs == [None, None]
+        assert eng._need_seed
+        monkeypatch.setattr(tick_engine, "host_read", real_read)
+        oracle.step()                              # oracle saw tick 2 too
+        assert eng.step() is None                  # re-seed + re-fill
+        assert eng.last_stats["full_seed"]
+        ex_p.advance(steps=1)
+        ex_s.advance(steps=1)
+        _feed(eng, ex_p, symbols, ivs)
+        _feed(oracle, ex_s, symbols, ivs)
+        serial_out = oracle.step()                 # tick 3 oracle
+        got = None
+        # tick 3's step drains tick 2 (dropped tick's successor): advance
+        # once more so the drained output lines up with the oracle's t=3
+        got = eng.step()                           # drains the re-seeded t2
+        assert got is not None
+        final = eng.flush()                        # t3
+        _assert_tree_equal(final, serial_out, "post-recovery")
+
+
+class TestMonitorPipelinedParity:
+    def _run(self, pipelined: bool, ticks: int = 6):
+        symbols = ("BTCUSDC", "ETHUSDC")
+        ex = _exchange(symbols)
+        clock = {"t": 0.0}
+        bus = EventBus()
+        q = bus.subscribe("market_updates")
+        mon = MarketMonitor(bus, ex, symbols=list(symbols),
+                            now_fn=lambda: clock["t"], kline_limit=LIMIT,
+                            fused=True, pipelined=pipelined)
+
+        async def go():
+            await mon.poll(force=True)
+            for _ in range(ticks):
+                ex.advance(steps=1)
+                clock["t"] += 60.0
+                await mon.poll()
+            await mon.flush_pipeline()
+
+        asyncio.run(go())
+        out = []
+        while not q.empty():
+            env = q.get_nowait()
+            out.append(env["data"])        # the envelope stamps publish-
+            #                                time ts; the PAYLOAD is data
+        return out
+
+    def test_published_payloads_byte_identical(self):
+        """Satellite (c): the pipelined monitor publishes the SAME
+        market_updates as the serial monitor at matched ticks — every
+        field byte-identical, including the carried event-time ages."""
+        serial = self._run(pipelined=False)
+        pipe = self._run(pipelined=True)
+        assert len(serial) == len(pipe) > 0
+        for i, (a, b) in enumerate(zip(pipe, serial)):
+            assert a == b, (i, {k: (a.get(k), b.get(k))
+                                for k in set(a) | set(b)
+                                if a.get(k) != b.get(k)})
+
+    def test_drain_crash_no_duplicate_publish(self, monkeypatch):
+        """Kill the readback between dispatch and drain: the poll raises
+        (stage-skip semantics), the pending publish context dies with the
+        pipeline, and recovery re-seeds — every published (symbol,
+        candle-timestamp) pair is unique across the whole run."""
+        symbols = ("BTCUSDC",)
+        ex = _exchange(symbols)
+        clock = {"t": 0.0}
+        bus = EventBus()
+        q = bus.subscribe("market_updates")
+        mon = MarketMonitor(bus, ex, symbols=list(symbols),
+                            now_fn=lambda: clock["t"], kline_limit=LIMIT,
+                            fused=True, pipelined=True)
+        real_read = tick_engine.host_read
+
+        def dying_read(tree):
+            raise RuntimeError("wedged drain")
+
+        async def go():
+            await mon.poll(force=True)             # dispatch t0, fill
+            ex.advance(steps=1)
+            clock["t"] += 60.0
+            monkeypatch.setattr(tick_engine, "host_read", dying_read)
+            with pytest.raises(RuntimeError, match="wedged"):
+                await mon.poll()                   # drain of t0 dies
+            assert mon._pending_pub is None        # context died with it
+            assert mon._engine._need_seed
+            monkeypatch.setattr(tick_engine, "host_read", real_read)
+            for _ in range(3):
+                ex.advance(steps=1)
+                clock["t"] += 60.0
+                await mon.poll()                   # re-seed + steady
+            await mon.flush_pipeline()
+
+        asyncio.run(go())
+        seen = set()
+        while not q.empty():
+            upd = q.get_nowait()["data"]
+            key = (upd["symbol"], upd["timestamp"])
+            assert key not in seen, f"duplicate publish {key}"
+            seen.add(key)
+        assert len(seen) >= 2                      # recovery kept publishing
+
+
+class TestPrecision:
+    def test_bf16_decide_parity_within_tolerance(self):
+        """Satellite (c): the bf16 knob keeps decisions within tolerance
+        of f32 (exactly equal where the backend has no reduced-precision
+        path — the knob is a matmul-precision hint, not a dtype cast)."""
+        symbols, ivs = ("BTCUSDC",), ("1m",)
+        ex_a = _exchange(symbols)
+        ex_b = _exchange(symbols)
+        f32 = TickEngine(list(symbols), list(ivs), window=LIMIT)
+        bf16 = TickEngine(list(symbols), list(ivs), window=LIMIT,
+                          precision="bf16")
+        assert bf16.precision == "bf16"
+        _feed(f32, ex_a, symbols, ivs)
+        _feed(bf16, ex_b, symbols, ivs)
+        a, b = f32.step(), bf16.step()
+
+        def walk(x, y, where):
+            for k in y:
+                if isinstance(y[k], dict):
+                    walk(x[k], y[k], f"{where}/{k}")
+                else:
+                    np.testing.assert_allclose(
+                        np.asarray(x[k], np.float64),
+                        np.asarray(y[k], np.float64),
+                        rtol=5e-2, atol=5e-2, err_msg=f"{where}/{k}")
+
+        walk(b, a, "bf16")
+
+    def test_invalid_precision_rejected_eagerly(self):
+        with pytest.raises(ValueError):
+            TickEngine(["BTCUSDC"], ["1m"], window=LIMIT, precision="fp8")
+
+    def test_tenant_engine_validates_precision(self):
+        from ai_crypto_trader_tpu.ops.tenant_engine import TenantEngine
+
+        with pytest.raises(ValueError):
+            TenantEngine(["BTCUSDC"], 2, precision="bogus")
+
+
+class TestReclaimedGauge:
+    def test_export_beside_headroom(self):
+        """Satellite (a): tickpath_overlap_reclaimed_seconds exports next
+        to the headroom gauge, and the status block carries both ms
+        quantile views — what the Grafana panel and recording rule read."""
+        from ai_crypto_trader_tpu.obs.tickpath import TickPathScope
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        tp = TickPathScope(metrics=m)
+        tp.observe_overlap(0.004)
+        tp.observe_reclaimed(0.003)
+        tp.observe_reclaimed(-1.0)                 # clamped, never negative
+        tp.export()
+        g = m.gauges
+        assert g["crypto_trader_tpu_tickpath_overlap_headroom_seconds"] \
+            == pytest.approx(0.004)
+        assert g["crypto_trader_tpu_tickpath_overlap_reclaimed_seconds"] \
+            == pytest.approx(0.003 / 2, abs=0.0016)   # p50 of {0.003, 0.0}
+        st = tp.status()
+        assert st["overlap_reclaimed_ms"]["p50"] >= 0.0
+        assert st["overlap_reclaimed_ms"]["p99"] <= 3.1
+
+    def test_coldstart_ledger_carries_cache_hits(self):
+        from ai_crypto_trader_tpu.obs.tickpath import TickPathScope
+
+        tp = TickPathScope()
+        tp.record_cold_start("tick_engine", wall_s=1.0, compile_s=0.01,
+                             compiles=1, cache_hits=3)
+        entry = tp.coldstart_status()["programs"]["tick_engine"]
+        assert entry["cache_hits"] == 3            # warm-replay evidence
+
+
+class TestMicroBatching:
+    def test_burst_coalesces_into_one_drain(self):
+        """Satellite: queued frames coalesce into ONE fused dispatch —
+        the burst publishes once per symbol, and the supervisor exports
+        the coalescing counters."""
+        from ai_crypto_trader_tpu.shell.stream import (MarketStream,
+                                                       StreamSupervisor,
+                                                       replay_frames)
+
+        symbols = ("BTCUSDC", "ETHUSDC")
+        ex = _exchange(symbols, n=900, advance=700)
+        clock = {"t": 1_000_000.0}
+        bus = EventBus()
+        mon = MarketMonitor(bus, ex, symbols=list(symbols),
+                            now_fn=lambda: clock["t"], kline_limit=LIMIT)
+        st = MarketStream(mon, now_fn=lambda: clock["t"])
+        frames = [json.dumps([{"e": "24hrMiniTicker", "s": s,
+                               "c": "50000", "q": "1e6"}])
+                  for s in symbols for _ in range(3)]
+        published = asyncio.run(st.run(replay_frames(frames)))
+        assert published >= len(symbols)
+        # 6 frames arrived back-to-back: at least one drain coalesced
+        assert st.micro_batches >= 1
+        assert st.micro_batched_frames >= 2
+        assert st.ticks_in == len(frames)
+        from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        sup = StreamSupervisor(st, metrics=m, now_fn=lambda: clock["t"])
+        sup.export()
+        for name in ("stream_micro_batches_total",
+                     "stream_micro_batched_frames_total"):
+            assert any(name in k for k in m.counters), (name, m.counters)
+
+    def test_microbatch_one_restores_frame_per_drain(self):
+        """microbatch=1 is the strict compatibility mode: every frame
+        drains alone (no coalescing counters move)."""
+        from ai_crypto_trader_tpu.shell.stream import (MarketStream,
+                                                       replay_frames)
+
+        symbols = ("BTCUSDC",)
+        ex = _exchange(symbols)
+        clock = {"t": 1_000_000.0}
+        mon = MarketMonitor(EventBus(), ex, symbols=list(symbols),
+                            now_fn=lambda: clock["t"], kline_limit=LIMIT)
+        st = MarketStream(mon, now_fn=lambda: clock["t"], microbatch=1)
+        frames = [json.dumps([{"e": "24hrMiniTicker", "s": "BTCUSDC",
+                               "c": "50000", "q": "1e6"}])
+                  for _ in range(3)]
+        asyncio.run(st.run(replay_frames(frames)))
+        assert st.micro_batches == 0
+        assert st.micro_batched_frames == 0
+
+
+class TestAOTCache:
+    def test_provenance_key_is_stable_and_coordinate_sensitive(self):
+        a = aotcache.provenance_key({"jax_version": "1", "backend": "cpu",
+                                     "device_kind": "x"})
+        b = aotcache.provenance_key({"jax_version": "1", "backend": "cpu",
+                                     "device_kind": "x"})
+        c = aotcache.provenance_key({"jax_version": "2", "backend": "cpu",
+                                     "device_kind": "x"})
+        assert a == b and a != c and len(a) == 16
+
+    def test_single_writer_lock_and_status(self, tmp_path):
+        """Second opener runs UNCACHED (never half-cached); close()
+        releases the lock for the next starter."""
+        first = aotcache.AOTCache(str(tmp_path))
+        try:
+            assert first.enable({"jax_version": "1", "backend": "cpu",
+                                 "device_kind": "x"})
+            assert first.enabled and not first.warm
+            st = first.status()
+            assert st["enabled"] and st["key"] == first.key
+            second = aotcache.AOTCache(str(tmp_path))
+            assert not second.enable({"jax_version": "1", "backend": "cpu",
+                                      "device_kind": "x"})
+            assert "lock" in second.error
+        finally:
+            first.close()
+        third = aotcache.AOTCache(str(tmp_path))
+        try:
+            assert third.enable({"jax_version": "1", "backend": "cpu",
+                                 "device_kind": "x"})
+            # bookkeeping files (meta.json, .writer.pid) are NOT cache
+            # entries — an empty directory stays cold
+            assert not third.warm
+            (tmp_path / first.key / "exe.bin").write_bytes(b"x" * 10)
+            fourth_status = third.status()
+            assert fourth_status["entries"] == 1
+        finally:
+            third.close()
+        fourth = aotcache.AOTCache(str(tmp_path))
+        try:
+            assert fourth.enable({"jax_version": "1", "backend": "cpu",
+                                  "device_kind": "x"})
+            assert fourth.warm                     # real entry → warm restart
+            assert fourth.entries_at_enable == 1
+        finally:
+            fourth.close()
+
+    def test_enable_failure_degrades_never_raises(self, tmp_path):
+        """RESILIENCE row: an unusable cache root (here: the path is a
+        FILE, so the provenance subdirectory cannot exist) is recorded on
+        status() and the process runs uncached — no exception escapes."""
+        root = tmp_path / "not_a_dir"
+        root.write_text("occupied")
+        c = aotcache.AOTCache(str(root))
+        ok = c.enable({"jax_version": "1", "backend": "cpu",
+                       "device_kind": "x"})
+        assert not ok and c.error
+        assert c.status()["enabled"] is False
+
+    def test_prune_dir_bounds_oldest_first(self, tmp_path):
+        for i in range(4):
+            p = tmp_path / f"entry{i}"
+            p.write_bytes(b"x" * 100)
+            os.utime(p, (i, i))          # entry0 oldest
+        (tmp_path / "meta.json").write_text("{}")   # never pruned
+        removed = aotcache.prune_dir(str(tmp_path), 250)
+        assert removed == 2
+        assert not (tmp_path / "entry0").exists()
+        assert not (tmp_path / "entry1").exists()
+        assert (tmp_path / "entry3").exists()
+        assert (tmp_path / "meta.json").exists()
+
+    @pytest.mark.slow
+    def test_fresh_subprocess_replays_compile(self, tmp_path):
+        """Satellite (c): round-trip in a FRESH interpreter — the first
+        child populates the provenance-keyed cache, the second REPLAYS
+        (cache_hits > 0, compile collapses) instead of recompiling."""
+        child = r"""
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax, jax.numpy as jnp
+from ai_crypto_trader_tpu.utils.aotcache import AOTCache
+from ai_crypto_trader_tpu.utils.tracing import JitCompileMonitor
+
+mon = JitCompileMonitor.install()
+c = AOTCache(sys.argv[1], min_compile_time_s=0.0)
+assert c.enable({"jax_version": jax.__version__, "backend": "cpu",
+                 "device_kind": "test"}), c.error
+before = mon.sample()
+# a shape/closure combination nothing else in the child compiles
+f = jax.jit(lambda x: jnp.tanh(x @ x.T) * 2.719)
+jax.block_until_ready(f(jnp.ones((33, 9))))
+since = mon.since(before)
+c.close()
+print(json.dumps({"cache_hits": since["cache_hits"],
+                  "warm": c.warm, "enabled": c.enabled}))
+"""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+        def run():
+            p = subprocess.run([sys.executable, "-c", child, str(tmp_path)],
+                               capture_output=True, text=True, cwd=REPO,
+                               env=env, timeout=180)
+            assert p.returncode == 0, p.stderr[-800:]
+            return json.loads(p.stdout.strip().splitlines()[-1])
+
+        cold = run()
+        assert cold["enabled"] and not cold["warm"]
+        warm = run()
+        assert warm["enabled"] and warm["warm"]
+        assert warm["cache_hits"] >= 1, warm      # replayed, not recompiled

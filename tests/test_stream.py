@@ -506,6 +506,87 @@ class TestStreamedDrains:
         asyncio.run(go())
 
 
+class TestStreamCurrentSkip:
+    def test_steady_state_skips_full_ingest_ring_stays_oracle_equal(self):
+        """Once a lane is warm and stream-fed, drains serve engine-current
+        windows and the fused poll SKIPS the full-window re-diff — while
+        the ring stays bit-equal to the venue oracle (the skip claims a
+        zero-change diff; this pins that the claim is true)."""
+        clock, bus, mon, ex, counting = _kline_setup(symbols=("BTCUSDC",))
+        st = MarketStream(mon, now_fn=clock)
+        ivs = mon.intervals
+
+        async def go():
+            for f in _venue_frames(ex, ["BTCUSDC"], ivs,
+                                   event_ms=int(clock.t * 1000)):
+                st.ingest_frame(f)
+            assert await st.drain() == 1               # seed: full path
+            eng = mon._engine
+            ingests = {"n": 0}
+            real_ingest = eng.ingest
+
+            def counted(*a, **kw):
+                ingests["n"] += 1
+                return real_ingest(*a, **kw)
+
+            eng.ingest = counted
+            for _ in range(3):
+                ex.advance(steps=1)
+                clock.t += 60.0
+                for f in _venue_frames(ex, ["BTCUSDC"], ivs,
+                                       event_ms=int(clock.t * 1000)):
+                    st.ingest_frame(f)
+                assert await st.drain() == 1
+            assert ingests["n"] == 0                   # every lane skipped
+            assert st.served_current >= 3 * len(ivs)
+            for iv in ivs:
+                assert eng.lane_synced("BTCUSDC", iv)
+                oracle = ex.get_klines("BTCUSDC", iv, mon.kline_limit)
+                want = np.asarray([r[1:6] for r in oracle], np.float32)
+                s, f = eng.sym_index["BTCUSDC"], eng.iv_index[iv]
+                np.testing.assert_array_equal(eng._win[s, f], want)
+
+        asyncio.run(go())
+
+    def test_gap_takes_full_path_and_refused_row_clears_sync(self):
+        """A reconnect gap must never be served engine-current: the book's
+        needs_backfill forces the REST path (plain list, no provenance)
+        and the repair drain takes the full-diff path; independently, a
+        row the ENGINE refuses (its window lagging the book) drops the
+        lane's synced flag at the engine layer."""
+        clock, bus, mon, ex, counting = _kline_setup(symbols=("BTCUSDC",))
+        st = MarketStream(mon, now_fn=clock)
+        ivs = mon.intervals
+
+        async def go():
+            for f in _venue_frames(ex, ["BTCUSDC"], ivs,
+                                   event_ms=int(clock.t * 1000)):
+                st.ingest_frame(f)
+            await st.drain()
+            eng = mon._engine
+            assert eng.lane_synced("BTCUSDC", "1m")
+            # a 5-candle outage the stream never saw
+            ex.advance(steps=5)
+            clock.t += 300.0
+            gap_row = ex.get_klines("BTCUSDC", "1m", 1)[-1]
+            st.ingest_frame(_venue_frames(ex, ["BTCUSDC"], ["1m"])[0])
+            assert st._books[("BTCUSDC", "1m")].needs_backfill
+            served = st.serve_klines("BTCUSDC", "1m")   # REST path
+            assert not getattr(served, "engine_current", False)
+            # the engine layer's own guard: offering the ring a row that
+            # doesn't extend its window contiguously refuses AND desyncs
+            assert not eng.ingest_row("BTCUSDC", "1m", gap_row)
+            assert not eng.lane_synced("BTCUSDC", "1m")
+            assert await st.drain() == 1               # full-diff repair
+            assert eng.lane_synced("BTCUSDC", "1m")
+            oracle = ex.get_klines("BTCUSDC", "1m", mon.kline_limit)
+            want = np.asarray([r[1:6] for r in oracle], np.float32)
+            s, f = eng.sym_index["BTCUSDC"], eng.iv_index["1m"]
+            np.testing.assert_array_equal(eng._win[s, f], want)
+
+        asyncio.run(go())
+
+
 # ---------------------------------------------------------------------------
 # the supervised lifecycle
 # ---------------------------------------------------------------------------
