@@ -219,6 +219,65 @@ def cmd_evolve(args):
                                       best._asdict().items()}}, indent=2))
 
 
+def cmd_rl(args):
+    """Population-based RL: PBT-train a DQN fleet inside the LOB
+    simulator and print the fitness/lineage table.  Fully local (the
+    `cli fleet` demo-mode pattern): synthesized scenario markets, no
+    --url, no venue — the smallest end-to-end PBT session that exercises
+    the real sharded program."""
+    import jax
+
+    from ai_crypto_trader_tpu.parallel import get_partitioner
+    from ai_crypto_trader_tpu.rl import (
+        DQNConfig, PBTConfig, adopt_winner, obs_size, pbt_env_params,
+        train_pbt)
+
+    key = jax.random.PRNGKey(args.seed)
+    env, _labels = pbt_env_params(key, num_scenarios=args.scenarios,
+                                  steps=args.steps,
+                                  episode_len=args.episode_len,
+                                  dynamics=args.dynamics)
+    cfg = DQNConfig(state_size=obs_size(env), num_envs=args.envs,
+                    rollout_len=args.rollout, replay_capacity=2048,
+                    batch_size=32)
+    pcfg = PBTConfig(population=args.population,
+                     generations=args.generations,
+                     iters_per_generation=args.iters)
+    partitioner = get_partitioner()
+    res = train_pbt(key, env, cfg, pcfg, partitioner=partitioner)
+
+    print(f"population={pcfg.population} devices={partitioner.device_count} "
+          f"dynamics={args.dynamics} scenarios={args.scenarios}")
+    print(f"{'gen':>3} {'best':>9} {'mean':>9} {'exploited':>9} {'loss':>9}")
+    for h in res.history:
+        print(f"{h['generation']:>3} {h['best_fitness']:>9.4f} "
+              f"{h['mean_fitness']:>9.4f} {h['n_exploited']:>9} "
+              f"{h['loss']:>9.4f}")
+    last = res.history[-1]
+    hy = last["hypers"]
+    print("\nfinal fleet (* = winner; 'from' = PBT lineage, the member "
+          "this slot last copied):")
+    print(f"{'member':>6} {'fitness':>9} {'from':>5} {'lr':>9} "
+          f"{'gamma':>7} {'eps_decay':>10} {'eps_min':>8} {'sync':>5}")
+    for i in range(pcfg.population):
+        star = "*" if i == res.best_member else " "
+        print(f"{i:>5}{star} {last['fitness'][i]:>9.4f} "
+              f"{last['lineage'][i]:>5} "
+              f"{hy['learning_rate'][i]:>9.2e} {hy['gamma'][i]:>7.4f} "
+              f"{hy['epsilon_decay'][i]:>10.5f} "
+              f"{hy['epsilon_min'][i]:>8.4f} "
+              f"{int(hy['target_sync_every'][i]):>5}")
+    if args.registry:
+        from ai_crypto_trader_tpu.obs.scorecard import Scorecard
+        from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+        out = adopt_winner(res, ModelRegistry(path=args.registry),
+                           Scorecard())
+        print(f"\nregistered {out['version']} "
+              f"({'ACTIVE' if out['adopted'] else 'SHADOW'}: "
+              f"{out['reason']}) fitness={out['fitness']:.4f}")
+
+
 def cmd_generate(args):
     """Strategy-structure generation (`ai_strategy_evaluator.py:732`):
     search rule compositions with real CV backtests, register improvements,
@@ -953,6 +1012,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--population", type=int, default=20)
     sp.add_argument("--generations", type=int, default=10)
     sp.set_defaults(fn=cmd_evolve)
+    sp = sub.add_parser("rl", help="population-based RL: PBT-train a DQN "
+                        "fleet inside the LOB simulator (local, no venue)")
+    sp.add_argument("--population", type=int, default=8)
+    sp.add_argument("--generations", type=int, default=4)
+    sp.add_argument("--iters", type=int, default=4,
+                    help="train iterations per member per generation")
+    sp.add_argument("--envs", type=int, default=16)
+    sp.add_argument("--rollout", type=int, default=8)
+    sp.add_argument("--scenarios", type=int, default=8)
+    sp.add_argument("--steps", type=int, default=1024)
+    sp.add_argument("--episode-len", type=int, default=256)
+    sp.add_argument("--dynamics", choices=("lob", "gbm"), default="lob")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--registry", default=None,
+                    help="register + scorecard-gate the winner into this "
+                         "registry JSON")
+    sp.set_defaults(fn=cmd_rl)
     sp = sub.add_parser("generate",
                         help="generate strategy structures (real-CV search)")
     sp.add_argument("--folds", type=int, default=3)

@@ -5,3 +5,7 @@ from ai_crypto_trader_tpu.evolve.ga import (  # noqa: F401
     population_diversity,
     run_ga,
 )
+from ai_crypto_trader_tpu.evolve.selection import (  # noqa: F401
+    quantile_split,
+    tournament,
+)

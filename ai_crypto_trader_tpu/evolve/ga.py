@@ -53,6 +53,7 @@ from ai_crypto_trader_tpu.parallel.partitioner import (
     Partitioner,
     SingleDevicePartitioner,
 )
+from ai_crypto_trader_tpu.evolve.selection import tournament
 from ai_crypto_trader_tpu.obs import tickpath
 from ai_crypto_trader_tpu.utils import devprof, meshprof
 
@@ -124,13 +125,9 @@ def backtest_fitness(ohlcv: dict, *, min_sharpe_weight: float = 1.0,
     return fitness
 
 
-def _tournament(key, fitness, k: int, n_picks: int):
-    """[n_picks] winner indices of size-k tournaments
-    (`genetic_algorithm.py:152-161`)."""
-    pop = fitness.shape[0]
-    cand = jax.random.randint(key, (n_picks, k), 0, pop)
-    cand_fit = fitness[cand]
-    return cand[jnp.arange(n_picks), jnp.argmax(cand_fit, axis=1)]
+# Selection primitive shared with rl/population.py — moved to
+# evolve/selection.py; the alias keeps the GA's internal name stable.
+_tournament = tournament
 
 
 def _evolve_core(key, state: GAState, cfg: GAParams) -> GAState:

@@ -1,0 +1,51 @@
+"""Shared selection primitives for the evolutionary workloads.
+
+The GA (`evolve/ga.py`) and the PBT population trainer
+(`rl/population.py`) both rank a population by fitness and pick who
+breeds / who copies whom.  The primitives live here so the two
+workloads share one implementation — pure, shape-static, and traceable
+inside either compiled program.
+
+Everything operates on a [P] fitness vector and returns index arrays;
+no genome/params gathering happens here (callers `tree_map` the gather
+so the same code serves flat genome matrices and full DQN state trees).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tournament(key, fitness, k: int, n_picks: int):
+    """[n_picks] winner indices of size-``k`` uniform tournaments
+    (`genetic_algorithm.py:152-161`).  Moved verbatim from evolve/ga.py —
+    the GA's key-stream consumption (ONE `randint` draw of shape
+    [n_picks, k]) is part of its bit-exactness contract, so this must
+    stay a single draw."""
+    pop = fitness.shape[0]
+    cand = jax.random.randint(key, (n_picks, k), 0, pop)
+    cand_fit = fitness[cand]
+    return cand[jnp.arange(n_picks), jnp.argmax(cand_fit, axis=1)]
+
+
+def quantile_split(fitness, frac: float):
+    """PBT exploit bracket: indices of the bottom-``frac`` and
+    top-``frac`` quantiles by fitness (Fast PBT, arXiv 2206.08888 —
+    truncation selection).
+
+    ``n = floor(P * frac)`` is a Python int (``frac`` is static), so the
+    returned index arrays are shape-static under jit: at P=1 (or any
+    population too small for the bracket) ``n == 0`` and both brackets
+    are empty — the exploit step becomes a structural no-op, which is
+    exactly what the P=1 bit-parity oracle pins.
+
+    Returns ``(bottom, top, n)`` — ``bottom[i]`` is the i-th worst
+    member, ``top[i]`` the i-th best (both ascending in rank distance
+    from the extreme)."""
+    pop = fitness.shape[0]
+    n = int(pop * frac)
+    order = jnp.argsort(fitness)       # ascending: worst first
+    bottom = order[:n]
+    top = order[pop - n:][::-1]        # best first
+    return bottom, top, n

@@ -168,17 +168,30 @@ class Scorecard:
         return sum(c for c, _, _ in q) / len(q)
 
     def adoption_gate(self, candidate_arch: str, incumbent_arch: str,
-                      symbol: str, interval: str) -> tuple[bool, str]:
+                      symbol: str, interval: str,
+                      candidate_score: float | None = None,
+                      incumbent_score: float | None = None
+                      ) -> tuple[bool, str]:
         """May ``candidate_arch`` replace ``incumbent_arch`` live?
 
-        Blocks only a candidate with a KNOWN-WORSE live score than a
-        scored incumbent; an unscored candidate passes flagged (it has
-        never served, so it has no live score to compare — the registry
-        records the adoption as shadow-grade)."""
+        Blocks only a candidate with a KNOWN-WORSE score than a scored
+        incumbent; an unscored candidate passes flagged (it has never
+        served, so it has no live score to compare — the registry
+        records the adoption as shadow-grade).
+
+        Scores default to the live directional-accuracy windows; the
+        ``candidate_score`` / ``incumbent_score`` overrides let OFFLINE
+        champions gate on a shared offline metric instead — the PBT
+        winner (rl/population.py) submits simulator fitness for both
+        sides, so a freshly trained policy that never served live can
+        still be refused when it is measurably worse than the incumbent
+        policy on the same simulated markets."""
         if candidate_arch == incumbent_arch:
             return True, "same_architecture"
-        inc = self.live_score(incumbent_arch, symbol, interval)
-        cand = self.live_score(candidate_arch, symbol, interval)
+        inc = (incumbent_score if incumbent_score is not None
+               else self.live_score(incumbent_arch, symbol, interval))
+        cand = (candidate_score if candidate_score is not None
+                else self.live_score(candidate_arch, symbol, interval))
         if inc is None:
             return True, "incumbent_unscored"
         if cand is None:

@@ -120,6 +120,15 @@ class Partitioner:
     def trial_devices(self) -> list:
         raise NotImplementedError
 
+    def pad_for(self, population: int) -> int:
+        """Members `population_eval` appends (by repeating the last one)
+        to split ``population`` evenly over the devices — 0 on a single
+        device or whenever the population divides the mesh axis.  The
+        analytic twin of the layout card's ``pad``: bench/tests that
+        need the pad fraction before (or without) a traced program
+        compute it here instead of re-deriving the modulo inline."""
+        return (-population) % max(self.device_count, 1)
+
     def _device_list(self) -> list:
         return [jax.devices()[0]]
 

@@ -9,10 +9,22 @@ from ai_crypto_trader_tpu.rl.env import (  # noqa: F401
 from ai_crypto_trader_tpu.rl.dqn import (  # noqa: F401
     DQNConfig,
     DQNState,
+    Hypers,
     act,
     dqn_init,
     evaluate_policy,
+    hypers_from_config,
     train_dqn,
     train_iteration,
     train_iterations,
+)
+from ai_crypto_trader_tpu.rl.population import (  # noqa: F401
+    PBTConfig,
+    PBTResult,
+    PopState,
+    adopt_winner,
+    best_params,
+    pbt_env_params,
+    pop_init,
+    train_pbt,
 )

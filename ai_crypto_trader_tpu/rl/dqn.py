@@ -87,8 +87,39 @@ class DQNState(NamedTuple):
     key: jnp.ndarray
 
 
-def _optimizer(cfg: DQNConfig):
-    return optax.adam(cfg.learning_rate)
+class Hypers(NamedTuple):
+    """The PBT-searchable hyperparameters as *array content*.
+
+    Everything here is a traced scalar, not a Python constant baked into
+    the compiled program: a population can then carry a [P] batch of
+    these through ONE executable, and PBT explore steps rewrite them
+    in place without triggering a recompile (rl/population.py).  The
+    learning rate moves out of the optax chain for the same reason —
+    `_learn` applies ``-learning_rate`` to `scale_by_adam` updates
+    itself, which is bit-identical to `optax.adam` (adam ≡
+    chain(scale_by_adam, scale(-lr)), and IEEE multiplication gives
+    ``(-lr)·u == step_size·u`` exactly)."""
+
+    learning_rate: jnp.ndarray   # f32
+    gamma: jnp.ndarray           # f32 discount
+    epsilon_decay: jnp.ndarray   # f32 per-env-step multiplier
+    epsilon_min: jnp.ndarray     # f32 exploration floor
+    target_sync_every: jnp.ndarray  # i32 learn-steps between target syncs
+
+
+def hypers_from_config(cfg: DQNConfig) -> Hypers:
+    return Hypers(
+        learning_rate=jnp.asarray(cfg.learning_rate, jnp.float32),
+        gamma=jnp.asarray(cfg.gamma, jnp.float32),
+        epsilon_decay=jnp.asarray(cfg.epsilon_decay, jnp.float32),
+        epsilon_min=jnp.asarray(cfg.epsilon_min, jnp.float32),
+        target_sync_every=jnp.asarray(cfg.target_sync_every, jnp.int32),
+    )
+
+
+def _optimizer():
+    # lr-free: `_learn` scales the updates by the traced Hypers lr
+    return optax.scale_by_adam()
 
 
 def dqn_init(key, env_params: EnvParams, cfg: DQNConfig) -> DQNState:
@@ -111,7 +142,7 @@ def dqn_init(key, env_params: EnvParams, cfg: DQNConfig) -> DQNState:
     # whole DQNState, and XLA rejects donating the same buffer twice
     return DQNState(params=params,
                     target_params=jax.tree.map(jnp.copy, params),
-                    opt_state=_optimizer(cfg).init(params), replay=replay,
+                    opt_state=_optimizer().init(params), replay=replay,
                     env_states=env_states, obs=obs,
                     epsilon=jnp.asarray(cfg.epsilon, jnp.float32),
                     learn_steps=jnp.asarray(0, jnp.int32), key=key)
@@ -142,13 +173,14 @@ def _replay_add(rep: Replay, obs, actions, rewards, next_obs, dones) -> Replay:
     )
 
 
-def _learn(params, target_params, opt_state, rep: Replay, key, cfg: DQNConfig):
+def _learn(params, target_params, opt_state, rep: Replay, key,
+           cfg: DQNConfig, hy: Hypers):
     """One Q-learning update on a sampled batch
     (`reinforcement_learning.py:335-419`)."""
     idx = jax.random.randint(key, (cfg.batch_size,), 0, jnp.maximum(rep.size, 1))
     net = QNetwork(cfg.hidden, cfg.n_actions)
     q_next = net.apply(target_params, rep.next_obs[idx])
-    target = rep.rewards[idx] + cfg.gamma * jnp.max(q_next, axis=-1) * (
+    target = rep.rewards[idx] + hy.gamma * jnp.max(q_next, axis=-1) * (
         1.0 - rep.dones[idx].astype(jnp.float32))
 
     def loss_fn(p):
@@ -157,14 +189,21 @@ def _learn(params, target_params, opt_state, rep: Replay, key, cfg: DQNConfig):
         return jnp.mean((q_sel - target) ** 2)
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
-    updates, opt_state = _optimizer(cfg).update(grads, opt_state, params)
+    updates, opt_state = _optimizer().update(grads, opt_state, params)
+    updates = jax.tree.map(lambda u: -hy.learning_rate * u, updates)
     return optax.apply_updates(params, updates), opt_state, loss
 
 
-def _iteration(env_params: EnvParams, state: DQNState, cfg: DQNConfig):
+def _iteration(env_params: EnvParams, state: DQNState, cfg: DQNConfig,
+               hy: Hypers | None = None):
     """One iteration body: rollout_len vmapped env steps → replay writes
     → learn_steps_per_iter updates → target sync / ε decay.  Shared by the
-    single-iteration jit and the multi-iteration scan below."""
+    single-iteration jit, the multi-iteration scan below, and the vmapped
+    population generation program (rl/population.py — which passes a
+    per-member ``hy``; the single-agent paths use the config's values,
+    traced from the same constants and therefore bit-identical)."""
+    if hy is None:
+        hy = hypers_from_config(cfg)
 
     def rollout_step(carry, _):
         env_states, obs, eps, key = carry
@@ -180,7 +219,7 @@ def _iteration(env_params: EnvParams, state: DQNState, cfg: DQNConfig):
                 dones.reshape(dones.shape + (1,) * (a.ndim - 1)), b, a),
             env_states2, reset_states)
         obs3 = jnp.where(dones[:, None], reset_obs, obs2)
-        eps = jnp.maximum(eps * cfg.epsilon_decay, cfg.epsilon_min)
+        eps = jnp.maximum(eps * hy.epsilon_decay, hy.epsilon_min)
         return (env_states3, obs3, eps, key), (obs, actions, rewards, obs2, dones)
 
     key = state.key
@@ -199,10 +238,10 @@ def _iteration(env_params: EnvParams, state: DQNState, cfg: DQNConfig):
     for i in range(cfg.learn_steps_per_iter):
         key, k_learn = jax.random.split(key)
         params, opt_state, loss = _learn(params, target_params, opt_state,
-                                         replay, k_learn, cfg)
+                                         replay, k_learn, cfg, hy)
         losses = losses.at[i].set(loss)
         learn_steps = learn_steps + 1
-        sync = (learn_steps % cfg.target_sync_every) == 0
+        sync = (learn_steps % hy.target_sync_every) == 0
         target_params = jax.tree.map(
             lambda t, p: jnp.where(sync, p, t), target_params, params)
 

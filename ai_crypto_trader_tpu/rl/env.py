@@ -41,6 +41,12 @@ class EnvParams(NamedTuple):
                              # features are appended dynamically)
     episode_len: int
     fee_rate: jnp.ndarray    # taker fee fraction per side
+    # Per-candle execution cost fraction per side on top of the flat fee —
+    # a scalar 0.0 (the frictionless default), or a [T] / [S, T] table.
+    # The LOB path (`sim/engine.scenario_env_params(dynamics="lob")`)
+    # threads the simulated half-spread here, so crossing a blown-out
+    # book costs the agent exactly what the book says it should.
+    trade_cost: jnp.ndarray = 0.0
 
 
 class EnvState(NamedTuple):
@@ -63,7 +69,7 @@ def obs_size(p: EnvParams) -> int:
 
 def make_env_params(ind: dict, episode_len: int = 256,
                     fee_rate: float = 0.0,
-                    extra_features=None) -> EnvParams:
+                    extra_features=None, trade_cost=None) -> EnvParams:
     """Build the feature table from a compute_indicators() dict.
 
     ``ind`` arrays may carry a leading scenario axis ([S, T] — the
@@ -74,7 +80,12 @@ def make_env_params(ind: dict, episode_len: int = 256,
     ``extra_features`` ([(S,) T, E]) appends E market columns to the
     table — the LOB's book-state features (spread, top-of-book depth)
     ride here; `_observe` concatenates whatever width the table has, so
-    the program shape follows the table and nothing else changes."""
+    the program shape follows the table and nothing else changes.
+
+    ``trade_cost`` (scalar or [(S,) T]) adds a per-candle execution-cost
+    fraction per side on open/close — half-spread from the LOB path —
+    on top of the flat ``fee_rate``.  None keeps the frictionless
+    default (cost 0.0, a program-identical no-op)."""
     close = ind["close"]
     ret1 = jnp.diff(close, prepend=close[..., :1], axis=-1) / close
     prev5 = jnp.roll(close, 5, axis=-1)
@@ -94,7 +105,9 @@ def make_env_params(ind: dict, episode_len: int = 256,
         obs = jnp.concatenate([obs, jnp.asarray(extra_features)], axis=-1)
     return EnvParams(close=close, obs_table=obs.astype(jnp.float32),
                      episode_len=episode_len,
-                     fee_rate=jnp.asarray(fee_rate, jnp.float32))
+                     fee_rate=jnp.asarray(fee_rate, jnp.float32),
+                     trade_cost=(0.0 if trade_cost is None
+                                 else jnp.asarray(trade_cost, jnp.float32)))
 
 
 def _lane(p: EnvParams, s: EnvState):
@@ -154,7 +167,14 @@ def env_step(p: EnvParams, s: EnvState, action) -> tuple[EnvState, jnp.ndarray, 
     # pnl would be double-counted). Fees charged on open/close.
     exposure = in_pos.astype(jnp.float32)
     price_ret = (next_price - price) / price
-    fees = (open_now.astype(jnp.float32) + close_now.astype(jnp.float32)) * p.fee_rate
+    # Per-side cost: flat fee plus this candle's execution cost (the LOB
+    # half-spread when the table is populated; scalar 0.0 otherwise, which
+    # compiles to the frictionless program — ndim is static under jit).
+    cost = p.trade_cost
+    if getattr(cost, "ndim", 0):
+        cost = (cost[s.scen] if cost.ndim == 2 else cost)[s.t]
+    fees = (open_now.astype(jnp.float32) + close_now.astype(jnp.float32)) * (
+        p.fee_rate + cost)
     reward = exposure * price_ret - fees
 
     balance = s.balance * (1.0 + reward)

@@ -1,0 +1,419 @@
+"""Population-based RL: a vmapped DQN fleet trained inside the LOB
+simulator, with PBT exploit/explore between generations.
+
+The single-agent trainer (rl/dqn.py) already scans K iterations per host
+round-trip; this module lifts the WHOLE training state — params, target
+params, optimizer state, replay ring, env states, ε, PRNG key — over a
+leading [P] population axis (Anakin/Podracer, arXiv 2104.06272) and adds
+the population-based-training loop of arXiv 2206.08888 (Fast PBT):
+
+  * one **generation** = every member trains ``iters_per_generation``
+    iterations and is then evaluated greedily, all as ONE compiled
+    program routed through ``Partitioner.population_eval`` with the
+    population tree donated — so the fleet shards over the mesh exactly
+    like the GA population, pad/mask layout cards included;
+  * between generations the **exchange** step (a second small donated
+    program) truncation-selects: bottom-quantile members copy a random
+    top-quantile member's params/opt-state/replay and perturb their
+    hyperparameters — learning rate, γ, ε schedule, target-sync period —
+    as *array content* (rl/dqn.py `Hypers`), never as a recompile;
+  * the host reads back ONE pytree per generation (fitness + lineage +
+    hypers), the same one-sync contract as `evolve/ga.run_ga`.
+
+At P=1 the exploit bracket is empty (`evolve/selection.quantile_split`),
+the exchange is a structural no-op, and G generations of
+``iters_per_generation`` iterations are bit-identical to
+``train_iterations(n_iters=G·iters)`` on the same PRNGKey — the parity
+oracle tests/test_population.py pins.
+
+The winning member closes the loop operationally: `adopt_winner`
+registers it in the model registry and runs it through the scorecard
+adoption gate (obs/scorecard.py, offline-score overrides) before it may
+go active — a fresh policy that is measurably worse than the incumbent
+on the same simulated markets lands as shadow, not live.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ai_crypto_trader_tpu.evolve.selection import quantile_split
+from ai_crypto_trader_tpu.obs import tickpath
+from ai_crypto_trader_tpu.parallel.partitioner import (
+    Partitioner,
+    SingleDevicePartitioner,
+)
+from ai_crypto_trader_tpu.rl.dqn import (
+    DQNConfig,
+    DQNState,
+    Hypers,
+    QNetwork,
+    _iteration,
+    dqn_init,
+    hypers_from_config,
+)
+from ai_crypto_trader_tpu.rl.env import EnvParams, env_reset, env_step
+from ai_crypto_trader_tpu.utils import devprof, meshprof
+
+# Shared default so every train_pbt call without a partitioner keys the
+# program caches onto one entry (the evolve/ga.py pattern).
+_SINGLE = SingleDevicePartitioner()
+
+# fold_in salt deriving each member's greedy-eval key from its training
+# key WITHOUT consuming it (consuming would break the P=1 parity oracle:
+# the single-agent trainer never evaluates mid-run)
+_EVAL_SALT = 0x5EED
+
+
+class PBTConfig(NamedTuple):
+    """Static population/PBT knobs (hashable — program-cache key)."""
+
+    population: int = 16
+    generations: int = 4
+    iters_per_generation: int = 8   # train iterations per member per gen
+    eval_steps: int = 128           # greedy-eval rollout length
+    exploit_frac: float = 0.25      # bottom/top truncation quantile
+    perturb_scale: float = 1.2      # multiplicative hyperparam jitter
+    lr_bounds: tuple = (1e-5, 1e-1)
+    gamma_bounds: tuple = (0.90, 0.999)
+    eps_decay_bounds: tuple = (0.9, 0.99999)
+    eps_min_bounds: tuple = (1e-3, 0.2)
+    sync_bounds: tuple = (2, 1000)  # target_sync_every clip (learn steps)
+
+
+class PopState(NamedTuple):
+    """The device-resident fleet: every leaf leads with the [P] axis."""
+
+    members: DQNState   # each field stacked [P, ...]
+    hypers: Hypers      # each field [P]
+
+
+class PBTResult(NamedTuple):
+    state: PopState          # final fleet (device arrays)
+    fitness: np.ndarray      # [P] final-generation fitness (host)
+    best_member: int
+    history: list            # one dict per generation
+    cfg: DQNConfig
+    pcfg: PBTConfig
+
+
+def host_read(tree):
+    """THE per-generation device→host sync (the evolve/ga.py seam —
+    module-level so tests wrap it with a counting double and assert ONE
+    sync per generation)."""
+    t0 = time.perf_counter()
+    with meshprof.allow_transfers():
+        out = jax.device_get(tree)
+    devprof.observe_latency("host_read", time.perf_counter() - t0)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n"))
+def _pop_init_jit(key, env_params: EnvParams, cfg: DQNConfig, n: int):
+    member_keys = jax.random.split(key, n)
+    members = jax.vmap(lambda k: dqn_init(k, env_params, cfg))(member_keys)
+    hypers = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (n,) + x.shape),
+        hypers_from_config(cfg))
+    return PopState(members=members, hypers=hypers)
+
+
+def pop_init(key, env_params: EnvParams, cfg: DQNConfig,
+             pcfg: PBTConfig) -> PopState:
+    """Initialize the fleet: member *i*'s state is bit-identical to
+    ``dqn_init(jax.random.split(key, P)[i], ...)`` — at P=1 that is the
+    exact key stream the parity oracle's single agent consumes.  Hypers
+    start at the config's values for every member; diversity comes from
+    the explore step, not the init (Fast PBT §3 does the same)."""
+    return _pop_init_jit(key, env_params, cfg, pcfg.population)
+
+
+def _eval_member(env_params: EnvParams, params, cfg: DQNConfig, key,
+                 n_steps: int):
+    """Greedy-policy fitness: mean final equity over ``cfg.num_envs``
+    fresh episodes after ``n_steps`` steps (the LOB env charges spread
+    crossings, so blown-out books show up here, not just in the obs)."""
+    states, obs = jax.vmap(lambda k: env_reset(env_params, k))(
+        jax.random.split(key, cfg.num_envs))
+    net = QNetwork(cfg.hidden, cfg.n_actions)
+
+    def step(carry, _):
+        states, obs = carry
+        actions = jnp.argmax(net.apply(params, obs), axis=-1)
+        states2, obs2, _, _ = jax.vmap(
+            lambda s, a: env_step(env_params, s, a))(states, actions)
+        return (states2, obs2), None
+
+    (states, _), _ = lax.scan(step, (states, obs), None, length=n_steps)
+    return jnp.mean(states.balance)
+
+
+@functools.lru_cache(maxsize=2)
+def _pbt_program(cfg: DQNConfig, pcfg: PBTConfig, partitioner: Partitioner):
+    """THE per-generation compiled program: every member scans
+    ``iters_per_generation`` training iterations (its own traced hypers)
+    then evaluates greedily — vmapped over [P], sharded over the mesh by
+    the partitioner, population tree donated.
+
+    Cache key is (cfg, pcfg-sans-generations, partitioner) — see
+    `_program_pcfg`; generation count is host-loop business, so runs
+    that differ only in length reuse the same executable."""
+
+    def member_generation(member: DQNState, hy: Hypers,
+                          env_params: EnvParams):
+        def it(st, _):
+            st, metrics = _iteration(env_params, st, cfg, hy)
+            return st, metrics
+
+        member, metrics = lax.scan(it, member, None,
+                                   length=pcfg.iters_per_generation)
+        fitness = _eval_member(
+            env_params, member.params, cfg,
+            jax.random.fold_in(member.key, _EVAL_SALT), pcfg.eval_steps)
+        return member, fitness, {
+            "loss": jnp.mean(metrics["loss"]),
+            "mean_reward": jnp.mean(metrics["mean_reward"]),
+            "epsilon": member.epsilon,
+        }
+
+    def generation(pop: PopState, env_params: EnvParams):
+        members, fitness, met = jax.vmap(
+            member_generation, in_axes=(0, 0, None))(
+                pop.members, pop.hypers, env_params)
+        return PopState(members=members, hypers=pop.hypers), fitness, met
+
+    return partitioner.population_eval(generation, name="pbt_generation",
+                                       donate_pop=True)
+
+
+@functools.lru_cache(maxsize=2)
+def _exchange_program(cfg: DQNConfig, pcfg: PBTConfig):
+    """The between-generations PBT step as ONE donated program:
+    truncation-select (bottom ``exploit_frac`` copies a random top-
+    ``exploit_frac`` member's full training state), then perturb the
+    copies' hyperparameters in place.  Everything is array content —
+    fitness values move, the executable never recompiles.
+
+    Returns ``(members', hypers', lineage)`` where ``lineage[i]`` is the
+    member *i* copied from (its own index if it survived).  When the
+    bracket is empty (P·frac < 1, notably P=1) the exchange is a
+    structural no-op and the donated buffers pass straight through —
+    the parity oracle's contract."""
+    n = int(pcfg.population * pcfg.exploit_frac)
+
+    def _jitter(key, shape):
+        """×s or ×1/s, coin-flipped per member (Fast PBT's explore)."""
+        up = jax.random.bernoulli(key, 0.5, shape)
+        return jnp.where(up, pcfg.perturb_scale, 1.0 / pcfg.perturb_scale)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def exchange(members: DQNState, hypers: Hypers, fitness, key):
+        P = fitness.shape[0]
+        lineage = jnp.arange(P, dtype=jnp.int32)
+        if n == 0:
+            return members, hypers, lineage
+
+        bottom, top, _ = quantile_split(fitness, pcfg.exploit_frac)
+        k_donor, k_jit = jax.random.split(key)
+        donors = top[jax.random.randint(k_donor, (n,), 0, n)]
+        lineage = lineage.at[bottom].set(donors)
+        cloned = lineage != jnp.arange(P)
+
+        # exploit: clones gather the donor's ENTIRE training state —
+        # params, target, opt state, replay ring, env states, ε
+        members = jax.tree.map(lambda x: x[lineage], members)
+        # …except the PRNG key: a clone sharing its donor's stream would
+        # explore in lock-step with it forever.  fold_in re-derives a
+        # fresh per-slot stream for clones; survivors' keys are untouched
+        # (bitwise — the parity contract again).
+        forked = jax.vmap(jax.random.fold_in)(members.key, lineage)
+        members = members._replace(
+            key=jnp.where(cloned[:, None], forked, members.key))
+
+        # explore: clones perturb the donor's hypers multiplicatively,
+        # clipped to the search box; survivors keep theirs bitwise
+        hy = jax.tree.map(lambda x: x[lineage], hypers)
+        ks = jax.random.split(k_jit, 5)
+        pert = Hypers(
+            learning_rate=jnp.clip(
+                hy.learning_rate * _jitter(ks[0], (P,)), *pcfg.lr_bounds),
+            gamma=jnp.clip(
+                hy.gamma * _jitter(ks[1], (P,)), *pcfg.gamma_bounds),
+            # ε decay lives just under 1.0: perturb its distance to 1 so
+            # the jitter changes the *half-life*, not the digit dust
+            epsilon_decay=jnp.clip(
+                1.0 - (1.0 - hy.epsilon_decay) * _jitter(ks[2], (P,)),
+                *pcfg.eps_decay_bounds),
+            epsilon_min=jnp.clip(
+                hy.epsilon_min * _jitter(ks[3], (P,)), *pcfg.eps_min_bounds),
+            target_sync_every=jnp.clip(
+                jnp.round(hy.target_sync_every * _jitter(ks[4], (P,)))
+                .astype(jnp.int32), *pcfg.sync_bounds),
+        )
+        hypers = jax.tree.map(
+            lambda p, o: jnp.where(
+                cloned.reshape((P,) + (1,) * (p.ndim - 1)), p, o), pert, hy)
+        return members, hypers, lineage
+
+    return exchange
+
+
+def _program_pcfg(pcfg: PBTConfig) -> PBTConfig:
+    """Program-cache key: the compiled programs don't depend on the
+    generation count, so normalize it out — a 1-generation warmup run
+    and a 20-generation timed run share one executable."""
+    return pcfg._replace(generations=0)
+
+
+def train_pbt(key, env_params: EnvParams, cfg: DQNConfig, pcfg: PBTConfig,
+              partitioner: Partitioner | None = None) -> PBTResult:
+    """Host driver: G generations of [train+eval → exchange], ONE
+    host_read per generation.
+
+    Per generation the device runs exactly two dispatches — the sharded
+    generation program (population donated) and the small exchange
+    program — inside a meshprof watch window, so a steady-state
+    recompile or an unsanctioned device→host transfer pages exactly
+    like the GA's would.  The first generation publishes the
+    ``pbt_generation`` devprof cost card and verifies the donation
+    actually freed the old fleet buffers."""
+    partitioner = partitioner if partitioner is not None else _SINGLE
+    pop = pop_init(key, env_params, cfg, pcfg)
+    if pcfg.population % partitioner.device_count == 0:
+        pop = partitioner.shard_population(pop)
+
+    prog_pcfg = _program_pcfg(pcfg)
+    misses_before = _pbt_program.cache_info().misses
+    program = _pbt_program(cfg, prog_pcfg, partitioner)
+    cold = _pbt_program.cache_info().misses > misses_before
+    exchange = _exchange_program(cfg, prog_pcfg)
+
+    prof = devprof.active()
+    if prof is not None and not devprof.has_card("pbt_generation"):
+        devprof.cost_card("pbt_generation", program, pop, env_params,
+                          _memory_analysis=False)
+
+    history = []
+    host = None
+    for g in range(pcfg.generations):
+        gcold = cold and g == 0
+        donated = jax.tree.leaves(pop) if (prof is not None and g == 0) \
+            else None
+        t0 = time.perf_counter()
+        with tickpath.coldstart("pbt_generation", cold=gcold), \
+                meshprof.watch("pbt_generation", cold=gcold):
+            pop, fitness, met = program(pop, env_params)
+            if donated is not None:
+                devprof.verify_donation("pbt_generation", donated)
+            members, hypers, lineage = exchange(
+                pop.members, pop.hypers, fitness,
+                jax.random.fold_in(key, g + 1))
+            pop = PopState(members=members, hypers=hypers)
+            host = host_read({"fitness": fitness, "lineage": lineage,
+                              "hypers": hypers._asdict(), "metrics": met})
+        if prof is not None:
+            prof.observe_latency("pbt_generation", time.perf_counter() - t0)
+        history.append({
+            "generation": g,
+            "best_fitness": float(host["fitness"].max()),
+            "mean_fitness": float(host["fitness"].mean()),
+            "n_exploited": int(
+                (host["lineage"] != np.arange(pcfg.population)).sum()),
+            "fitness": host["fitness"].tolist(),
+            "lineage": host["lineage"].tolist(),
+            "hypers": {k: np.asarray(v).tolist()
+                       for k, v in host["hypers"].items()},
+            "loss": float(host["metrics"]["loss"].mean()),
+            "mean_reward": float(host["metrics"]["mean_reward"].mean()),
+        })
+
+    fitness = np.asarray(host["fitness"])
+    return PBTResult(state=pop, fitness=fitness,
+                     best_member=int(np.argmax(fitness)),
+                     history=history, cfg=cfg, pcfg=pcfg)
+
+
+def best_params(result: PBTResult):
+    """The winning member's Q-network params (device tree)."""
+    return jax.tree.map(lambda x: x[result.best_member],
+                        result.state.members.params)
+
+
+def adopt_winner(result: PBTResult, registry, scorecard=None, *,
+                 kind: str = "rl_policy", symbol: str = "SIM",
+                 interval: str = "pbt",
+                 checkpoint_path: str | None = None) -> dict:
+    """Close the loop: register the winning policy and run it through
+    the scorecard adoption gate before it may go active.
+
+    The gate compares SIMULATOR fitness (the score overrides added in
+    obs/scorecard.py) between the candidate and the registry's best
+    incumbent of the same ``kind`` — the models/service.py `_run_hpo`
+    precedent: gate → register → performance → active/shadow.  A
+    candidate worse than the incumbent on the same simulated markets is
+    registered as shadow, never hot-swapped."""
+    best = result.best_member
+    hy = {k: float(np.asarray(v)[best])
+          for k, v in result.state.hypers._asdict().items()}
+    fitness = float(result.fitness[best])
+
+    incumbent = registry.best(kind, metric="fitness")
+    allowed, reason = True, "no_scorecard"
+    if scorecard is not None:
+        allowed, reason = scorecard.adoption_gate(
+            "dqn_pbt:candidate",
+            incumbent["version"] if incumbent else "dqn_pbt:none",
+            symbol, interval,
+            candidate_score=fitness,
+            incumbent_score=(incumbent or {}).get(
+                "performance", {}).get("fitness"))
+
+    payload = dict(hy, arch="dqn_pbt", state_size=result.cfg.state_size,
+                   hidden=str(result.cfg.hidden), fitness=fitness)
+    if checkpoint_path is not None:
+        from ai_crypto_trader_tpu.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(checkpoint_path, best_params(result),
+                        metadata={"kind": kind, "fitness": fitness})
+        payload["checkpoint"] = checkpoint_path
+    # exact-dup-only threshold: a winner that cleared its gate must get
+    # its OWN version (the structure-search precedent, registry.register)
+    vid = registry.register(kind, payload, metadata={
+        "arch": "dqn_pbt",
+        "population": result.pcfg.population,
+        "generations": result.pcfg.generations,
+        "dynamics": "lob",
+        "adoption": "adopted" if allowed else "blocked_by_scorecard",
+        "adoption_reason": reason,
+    }, similarity_threshold=1.0)
+    registry.update_performance(vid, {
+        "fitness": fitness,
+        "mean_fitness": float(result.fitness.mean()),
+    })
+    registry.set_status(vid, "active" if allowed else "shadow")
+    return {"version": vid, "adopted": allowed, "reason": reason,
+            "fitness": fitness}
+
+
+def pbt_env_params(key, scenario="mixed", num_scenarios: int = 32,
+                   steps: int = 1024, episode_len: int = 256,
+                   fee_rate: float = 0.0005, dynamics: str = "lob",
+                   flow=None):
+    """The fleet's training markets: `sim/engine.scenario_env_params`
+    with LOB dynamics by default — book-state observation columns AND
+    the half-spread trade cost, so queue position, spread blowouts and
+    liquidity holes shape the reward.  Returns (EnvParams, labels);
+    size networks with ``rl.env.obs_size(params)``."""
+    from ai_crypto_trader_tpu.sim.engine import scenario_env_params
+
+    return scenario_env_params(key, scenario=scenario,
+                               num_scenarios=num_scenarios, steps=steps,
+                               episode_len=episode_len, fee_rate=fee_rate,
+                               dynamics=dynamics, flow=flow)

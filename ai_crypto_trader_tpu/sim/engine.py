@@ -489,7 +489,11 @@ def scenario_env_params(key, scenario="mixed", num_scenarios: int = 64,
     relative spread (per mille) and the top-of-book depth normalized by
     the flow's steady-state depth — so the policy can SEE the
     microstructure regime it is trading through.  The env observation
-    widens; size networks with `rl.env.obs_size(params)`."""
+    widens; size networks with `rl.env.obs_size(params)`.  The simulated
+    half-spread also becomes the env's per-candle `trade_cost`: crossing
+    the book during a spread blowout charges exactly what the book
+    quotes, so microstructure shapes the *reward*, not just the
+    observation."""
     from ai_crypto_trader_tpu import ops
     from ai_crypto_trader_tpu.rl.env import make_env_params
 
@@ -501,6 +505,7 @@ def scenario_env_params(key, scenario="mixed", num_scenarios: int = 64,
     ind = ops.compute_indicators(
         {k: candles[k] for k in ("open", "high", "low", "close", "volume")})
     extra = None
+    trade_cost = None
     if dynamics == "lob":
         from ai_crypto_trader_tpu.sim import lob
 
@@ -508,5 +513,7 @@ def scenario_env_params(key, scenario="mixed", num_scenarios: int = 64,
         steady = fl.limit_rate / jnp.maximum(fl.cancel_rate, 1e-6)
         extra = jnp.stack([candles["spread"] * 1e3,
                            jnp.tanh(candles["cap"] / steady)], axis=-1)
+        trade_cost = candles["spread"] / 2.0   # half-spread paid per side
     return make_env_params(ind, episode_len=episode_len,
-                           fee_rate=fee_rate, extra_features=extra), labels
+                           fee_rate=fee_rate, extra_features=extra,
+                           trade_cost=trade_cost), labels

@@ -70,7 +70,7 @@ _ACTIVE: "MeshProf | None" = None
 DEFAULT_HOT_PROGRAMS = frozenset({
     "tick_engine", "ga_scan", "backtest_sweep", "population_sweep",
     "train_epoch", "sim_sweep", "dqn_train_iterations", "lob_sweep",
-    "tenant_engine",
+    "tenant_engine", "pbt_generation",
 })
 
 # pad fraction above which MeshPaddingWasteHigh fires (a quarter of the

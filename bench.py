@@ -47,6 +47,12 @@ The other target rows print one JSON line each ahead of it:
   rl_env_steps_per_sec    DQN train_iteration: 256 vmapped envs × 32 steps
                           + 4 replay-batch learns (`reinforcement_learning
                           .py:335-419`; the reference has no env at all)
+  pbt_env_steps_per_sec   population-based RL (rl/population.py): P DQN
+                          members training vmapped in the LOB simulator,
+                          PBT exploit/explore between generations, sharded
+                          through the Partitioner; fleet env steps/s +
+                          speedup_vs_single vs the per-member scan path
+                          (BENCH_RL_POP/BENCH_PBT_GENS/BENCH_PBT_ITERS)
   mc_paths_10k_ms         10k GBM paths × 30 d + full stats (10× the
                           reference budget, `monte_carlo_service.py:264-336`)
   sim_sweep               adversarial scenario sweep: 4096 stress markets
@@ -162,7 +168,8 @@ def collected_rows() -> list:
         if isinstance(row, dict) and "metric" in row:
             out[(row["metric"], row.get("device_kind", "unknown"),
                  str(row.get("mode") or ""),
-                 str(row.get("aot_cache") or ""))] = row
+                 str(row.get("aot_cache") or ""),
+                 str(row.get("dynamics") or ""))] = row
     return list(out.values())
 
 
@@ -182,6 +189,7 @@ def append_history(rows: list, path: str | None = None,
               "BENCH_LOAD_SYMBOLS", "BENCH_LOAD_TICKS",
               "BENCH_LOAD_SLO_MS",
               "BENCH_GA_T", "BENCH_GA_POP", "BENCH_GA_GENS",
+              "BENCH_RL_POP", "BENCH_PBT_GENS", "BENCH_PBT_ITERS",
               "BENCH_LOB_SCENARIOS", "BENCH_LOB_STEPS", "BENCH_LOB_LEVELS",
               "BENCH_COLDSTART_TICKS",
               "BENCH_FLEET_TENANTS", "BENCH_FLEET_SYMBOLS",
@@ -260,12 +268,19 @@ def _gate_key(r: dict) -> tuple:
     key on the cache state: a warm restart REPLAYS the hot set's
     executables (utils/aotcache.py) and is an order of magnitude faster
     than a cold one — letting the warm trajectory gate the cold row
-    would flag every legitimate cold start as a regression."""
+    would flag every legitimate cold start as a regression.
+
+    DYNAMICS-stamped rows (the RL rows' dynamics=frictionless|lob) key
+    on the market model: stepping the frictionless single-path env and
+    stepping the LOB-cost scenario env are different workloads of the
+    same env_steps/sec metric — a single-agent frictionless history row
+    must never gate a population LOB run (and BENCH_RL_POP rides the
+    scale stamp for the same reason)."""
     scale = r.get("scale") or {}
     return (r["metric"], r.get("device_kind", "unknown"),
             tuple(sorted(scale.items())), int(r.get("devices") or 1),
             str(r.get("mode") or ""), str(r.get("tenants_cap") or ""),
-            str(r.get("aot_cache") or ""))
+            str(r.get("aot_cache") or ""), str(r.get("dynamics") or ""))
 
 
 def gate_history(rows: list, tolerance: float = GATE_TOLERANCE):
@@ -292,7 +307,8 @@ def gate_history(rows: list, tolerance: float = GATE_TOLERANCE):
                 best_prior[key] = r
     ok, report = True, []
     for key in sorted(latest):
-        metric, device_kind, scale, devices, mode, tenants_cap, aot = key
+        (metric, device_kind, scale, devices, mode, tenants_cap, aot,
+         dynamics) = key
         row, best = latest[key], best_prior.get(key)
         rec = {"metric": metric, "device_kind": device_kind,
                "value": row["value"], "unit": row.get("unit")}
@@ -306,6 +322,8 @@ def gate_history(rows: list, tolerance: float = GATE_TOLERANCE):
             rec["tenants_cap"] = tenants_cap
         if aot:
             rec["aot_cache"] = aot
+        if dynamics:
+            rec["dynamics"] = dynamics
         if best is None:
             rec.update(status="new")
         else:
@@ -377,7 +395,8 @@ def trend_table(rows: list, report: list, last_n: int = 5) -> list[str]:
                int(rec.get("devices") or 1),
                str(rec.get("mode") or ""),
                str(rec.get("tenants_cap") or ""),
-               str(rec.get("aot_cache") or ""))
+               str(rec.get("aot_cache") or ""),
+               str(rec.get("dynamics") or ""))
         trail = by_key.get(key, [])[-last_n:]
         if not trail:
             continue
@@ -696,8 +715,122 @@ def bench_rl(ind):
         f"{cfg.rollout_len} steps + {cfg.learn_steps_per_iter} learns, "
         f"donated) in {dt:.3f}s → {steps_per_sec:,.0f} env steps/s")
     # A100-with-host-env DQN is env-bound at ~1e5 steps/s (BASELINE.md §RL)
+    # dynamics stamps the gate key: this row trains in the frictionless
+    # indicator env; the PBT row trains in the LOB env (half-spread trade
+    # costs) — same metric name must never gate across the two regimes
     emit("rl_env_steps_per_sec", steps_per_sec, "steps/s",
-         round(steps_per_sec / 1e5, 1))
+         round(steps_per_sec / 1e5, 1), dynamics="frictionless")
+
+
+def bench_pbt():
+    """pbt_env_steps_per_sec row: population-based RL throughput — P DQN
+    members training vmapped inside the LOB simulator (half-spread trade
+    costs live in the reward), PBT exploit/explore between generations,
+    sharded through the Partitioner (rl/population.py, ISSUE 19).
+
+    The number is aggregate env steps/s across the fleet; the honesty
+    check riding the row is ``speedup_vs_single`` — the same per-member
+    config pushed through the single-agent `train_iterations` path, so
+    the batching win (one vmapped dispatch vs P serial programs) is
+    measured, not assumed.  Self-contained: builds its own scenario env,
+    no dependency on the 525k-candle indicator prep."""
+    import jax
+
+    from ai_crypto_trader_tpu.parallel import get_partitioner
+    from ai_crypto_trader_tpu.rl import (
+        DQNConfig, dqn_init, obs_size, train_iterations)
+    from ai_crypto_trader_tpu.rl.population import (
+        PBTConfig, pbt_env_params, train_pbt)
+    from ai_crypto_trader_tpu.utils import meshprof as meshprof_mod
+
+    P = int(os.environ.get("BENCH_RL_POP", "16"))
+    GENS = int(os.environ.get("BENCH_PBT_GENS", "3"))
+    ITERS = int(os.environ.get("BENCH_PBT_ITERS", "64"))
+    partitioner = get_partitioner()
+
+    env, _ = pbt_env_params(jax.random.PRNGKey(7), num_scenarios=16,
+                            steps=1024, episode_len=256, dynamics="lob")
+    # tiny per-member slice ON PURPOSE: the row measures the fleet
+    # batching win, so each member must be op-overhead-bound — XLA:CPU
+    # runs per-member-params matmuls as a loop over the [P] batch, so
+    # wide nets/rollouts converge to sequential cost (speedup→1) while
+    # narrow ones amortize per-op overhead across the fleet
+    cfg = DQNConfig(state_size=obs_size(env), num_envs=1, rollout_len=8,
+                    hidden=(16,), replay_capacity=128, batch_size=8,
+                    learn_steps_per_iter=1)
+    pcfg = PBTConfig(population=P, generations=GENS,
+                     iters_per_generation=ITERS, eval_steps=4)
+
+    # mesh observatory around the compile run only (the bench_ga
+    # pattern): the sharded generation program's pad/mask layout card
+    # rides the row; timed runs stay observatory-free
+    mesh_obs = meshprof_mod.MeshProf()
+    t0 = time.perf_counter()
+    with meshprof_mod.use(mesh_obs):
+        train_pbt(jax.random.PRNGKey(0), env, cfg,
+                  pcfg._replace(generations=1), partitioner=partitioner)
+    warm = time.perf_counter() - t0
+
+    # timed runs share the warmup's executables (`_program_pcfg`
+    # normalizes the generation count out of the program-cache key);
+    # median-of-3 interleaved with the single-agent baseline — both
+    # sides are sub-second on CPU, and one descheduled run must not
+    # flip the speedup honesty check
+    n_iters = GENS * ITERS
+    st = dqn_init(jax.random.PRNGKey(0), env, cfg)
+    st, _ = train_iterations(env, st, cfg, n_iters=n_iters)     # compile
+    fetch(st.params["params"]["Dense_0"]["kernel"])
+    pop_s, single_s = [], []
+    res = None
+    for i in range(3):
+        t0 = time.perf_counter()
+        res = train_pbt(jax.random.PRNGKey(1 + i), env, cfg, pcfg,
+                        partitioner=partitioner)
+        pop_s.append(time.perf_counter() - t0)
+        # single-agent baseline: identical per-member config + iteration
+        # count through the non-population scan path — P sequential
+        # agents cost P× this, so speedup_vs_single > 1 is pure batching
+        st = dqn_init(jax.random.PRNGKey(1 + i), env, cfg)
+        fetch(st.params["params"]["Dense_0"]["kernel"])
+        t0 = time.perf_counter()
+        st, _ = train_iterations(env, st, cfg, n_iters=n_iters)
+        fetch(st.params["params"]["Dense_0"]["kernel"])
+        single_s.append(time.perf_counter() - t0)
+    dt = float(np.median(pop_s))
+    single_dt = float(np.median(single_s))
+    env_steps = P * GENS * ITERS * cfg.num_envs * cfg.rollout_len
+    steps_per_sec = env_steps / dt
+    single_sps = n_iters * cfg.num_envs * cfg.rollout_len / single_dt
+    speedup = steps_per_sec / single_sps
+
+    layout = mesh_obs.layouts.get("pbt_generation")
+    pad = partitioner.pad_for(P)
+    locality = ({"pad_fraction": round(layout.pad_fraction, 4),
+                 "members_per_device": layout.members_per_device,
+                 "collective_bytes": layout.collective_bytes}
+                if layout is not None else
+                {"pad_fraction": round(pad / (P + pad), 4) if P else 0.0,
+                 "members_per_device": (P + pad) / partitioner.device_count,
+                 "collective_bytes": 0})
+    log(f"PBT: {GENS} generations × pop {P} × {ITERS} iters "
+        f"({cfg.num_envs} envs × {cfg.rollout_len} steps, LOB dynamics, "
+        f"devices={partitioner.device_count}): {dt:.3f}s steady "
+        f"({warm:.1f}s with compile) → {steps_per_sec:,.0f} env steps/s, "
+        f"{speedup:.1f}x the single-agent path "
+        f"({single_sps:,.0f} steps/s/member), "
+        f"best fitness {float(res.fitness.max()):,.2f}")
+    # torch single-device PBT runs the members as a Python loop over
+    # per-agent training (no vmap), so its fleet rate is the A100
+    # single-agent proxy (~1e5 env steps/s, BASELINE.md §RL) — the same
+    # denominator as the rl row, now amortized over the whole fleet
+    emit("pbt_env_steps_per_sec", steps_per_sec, "steps/s",
+         round(steps_per_sec / 1e5, 1), engine="pbt_vmap",
+         devices=partitioner.device_count, dynamics="lob",
+         population=P, generations=GENS, iters_per_generation=ITERS,
+         single_agent_steps_per_sec=round(single_sps, 3),
+         speedup_vs_single=round(speedup, 2),
+         best_fitness=round(float(res.fitness.max()), 3),
+         **locality)
 
 
 def bench_mc():
@@ -2031,6 +2164,7 @@ def run_worker():
         ("flightrec", bench_flightrec),
         ("ga", ga_row),
         ("rl", lambda: bench_rl(ind)),
+        ("pbt", bench_pbt),
         ("mc", bench_mc),
         ("sim", bench_sim),
         ("lob", bench_lob),

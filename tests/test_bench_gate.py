@@ -256,6 +256,33 @@ class TestGateLogic:
         ok, report = self.bench.gate_history(rows, tolerance=0.10)
         assert ok
 
+    def test_cross_dynamics_rows_never_gate(self):
+        """A frictionless single-agent RL row must not gate (or be gated
+        by) a LOB-dynamics population row of the same metric family —
+        dynamics is part of the gate key (ISSUE 19).  Pre-stamp rows key
+        as empty and keep gating only each other."""
+        rows = [
+            {"run_id": "r0", "metric": "rl_env_steps_per_sec", "value": 1e6,
+             "unit": "steps/s", "device_kind": "cpu",
+             "dynamics": "frictionless"},
+            {"run_id": "r1", "metric": "rl_env_steps_per_sec", "value": 1e3,
+             "unit": "steps/s", "device_kind": "cpu", "dynamics": "lob"},
+        ]
+        # the slow LOB row is NOT gated by the fast frictionless prior —
+        # they key apart, so it lands as "new", not REGRESSION
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert ok and {r["status"] for r in report} == {"new"}
+        assert report[0]["dynamics"] == "lob"
+        # a LOWER same-dynamics follow-up DOES gate
+        rows.append({"run_id": "r2", "metric": "rl_env_steps_per_sec",
+                     "value": 1e5, "unit": "steps/s", "device_kind": "cpu",
+                     "dynamics": "frictionless"})
+        ok, report = self.bench.gate_history(rows, tolerance=0.10)
+        assert not ok
+        failing = [r for r in report if r["status"] == "REGRESSION"]
+        assert len(failing) == 1
+        assert failing[0]["dynamics"] == "frictionless"
+
     def test_best_prior_not_just_last(self):
         """The gate compares against the BEST prior row, so two
         successive small regressions cannot ratchet the bar down."""
@@ -343,7 +370,7 @@ class TestRowsFilter:
                             for t in node.targets)):
                 names = {elt.elts[0].value for elt in node.value.elts}
         assert {"tick", "stream", "coldstart", "capacity", "flightrec",
-                "ga", "rl"} <= names
+                "ga", "rl", "pbt"} <= names
 
 
 class TestHistoryRecording:

@@ -80,4 +80,59 @@ def test_sharded_ga_locality_lands_in_bench_history(tmp_path):
     assert rec["members_per_device"] == 2.0
     assert rec["collective_bytes"] > 0
     # the gate keys the sharded trajectory apart from 1-chip rows
-    assert bench._gate_key(rec)[-1] == 8
+    # (key layout: metric, device_kind, scale, devices, mode,
+    # tenants_cap, aot_cache, dynamics)
+    assert bench._gate_key(rec)[3] == 8
+
+
+def test_sharded_pbt_mesh_matches_single_device(ohlcv, mesh8):
+    """ISSUE 19 slow satellite: the PBT generation program sharded over
+    an 8-device mesh reproduces the single-device fleet BIT-FOR-BIT (the
+    collective only all-gathers per-member results), and a ragged fleet
+    pins its pad fraction on the ``pbt_generation`` layout card — 10
+    members over 8 devices pad by 6 (fraction 0.375), the analytic
+    ``Partitioner.pad_for`` twin agreeing."""
+    if jax.device_count() < 8:
+        pytest.skip("needs >= 8 devices (virtual CPU mesh)")
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ai_crypto_trader_tpu import ops
+    from ai_crypto_trader_tpu.parallel import MeshPartitioner
+    from ai_crypto_trader_tpu.rl import DQNConfig, make_env_params
+    from ai_crypto_trader_tpu.rl.population import PBTConfig, train_pbt
+    from ai_crypto_trader_tpu.utils import meshprof
+
+    key = jax.random.PRNGKey(9)
+    arrays = {k: jnp.asarray(v[:256]) for k, v in ohlcv.items()
+              if k != "regime"}
+    env = make_env_params(ops.compute_indicators(arrays), episode_len=32)
+    cfg = DQNConfig(num_envs=2, rollout_len=2, hidden=(8,),
+                    replay_capacity=64, batch_size=8,
+                    learn_steps_per_iter=1)
+    pcfg = PBTConfig(population=16, generations=2,
+                     iters_per_generation=2, eval_steps=4)
+
+    res_single = train_pbt(key, env, cfg, pcfg)
+    res_mesh = train_pbt(key, env, cfg, pcfg,
+                         partitioner=MeshPartitioner(mesh8))
+    np.testing.assert_array_equal(res_mesh.fitness, res_single.fitness)
+    for a, b in zip(jax.tree.leaves(res_mesh.state),
+                    jax.tree.leaves(res_single.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for hm, hs in zip(res_mesh.history, res_single.history):
+        assert hm["lineage"] == hs["lineage"]
+        assert hm["best_fitness"] == hs["best_fitness"]
+
+    # ragged fleet: pad-fraction pinned on the trace-time layout card
+    part = MeshPartitioner(mesh8)
+    assert part.pad_for(10) == 6
+    mp_obs = meshprof.MeshProf()
+    pcfg10 = PBTConfig(population=10, generations=1,
+                       iters_per_generation=1, eval_steps=2)
+    with meshprof.use(mp_obs):
+        train_pbt(key, env, cfg, pcfg10, partitioner=part)
+    layout = mp_obs.layouts["pbt_generation"]
+    assert layout.devices == 8
+    assert layout.pad_fraction == 0.375
+    assert layout.members_per_device == 2.0

@@ -1,0 +1,314 @@
+"""Population-based RL (rl/population.py) — the ISSUE 19 contracts.
+
+Tier-1 on a cheap indicator env (256 candles, tiny nets) so the whole
+file compiles in seconds:
+
+  * P=1 parity oracle: one-member PBT with an empty exploit bracket is
+    BIT-identical to ``train_iterations`` on the same PRNGKey — hypers
+    moved from compile-time constants to traced array content without
+    perturbing a single bit of the training stream;
+  * exchange determinism + exploit/explore semantics under a fixed key:
+    bottom-quantile members copy a top-quantile donor's full training
+    state, survivors pass through bitwise, perturbed hypers stay inside
+    the search box;
+  * the one-sync/zero-steady-recompile/donation contract of
+    ``train_pbt`` (the evolve/ga.py contract, same observatories);
+  * adoption: the winner registers and the scorecard gate decides
+    active vs shadow on offline simulator fitness;
+  * the env's new per-step trade cost: default bit-unchanged, scalar
+    and per-scenario schedules charged on entry/exit, and the LOB
+    scenario factory wires spread/2 into it.
+
+The sharded-PBT case (8-device mesh ≡ single device, pad fraction
+pinned) lives in tests/test_multichip.py with the other mesh dryruns.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu import ops
+from ai_crypto_trader_tpu.rl import (
+    DQNConfig,
+    dqn_init,
+    make_env_params,
+    train_iterations,
+)
+from ai_crypto_trader_tpu.rl import population as pop_mod
+from ai_crypto_trader_tpu.rl.env import BUY, SELL, env_reset, env_step
+from ai_crypto_trader_tpu.rl.population import (
+    PBTConfig,
+    _exchange_program,
+    _program_pcfg,
+    adopt_winner,
+    best_params,
+    pop_init,
+    train_pbt,
+)
+from ai_crypto_trader_tpu.utils import devprof, meshprof
+
+KEY = jax.random.PRNGKey(0)
+
+# tiny everywhere: the contracts are structural, not statistical
+CFG = DQNConfig(num_envs=2, rollout_len=2, hidden=(8,),
+                replay_capacity=64, batch_size=8, learn_steps_per_iter=1,
+                target_sync_every=3)
+
+
+@pytest.fixture(scope="module")
+def env(ohlcv):
+    arrays = {k: jnp.asarray(v[:256]) for k, v in ohlcv.items()
+              if k != "regime"}
+    return make_env_params(ops.compute_indicators(arrays), episode_len=32)
+
+
+def _leaves_equal(tree_a, tree_b):
+    la, lb = jax.tree.leaves(tree_a), jax.tree.leaves(tree_b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(la, lb))
+
+
+class TestParityOracle:
+    def test_pop_init_member_matches_dqn_init(self, env):
+        pop = pop_init(KEY, env, CFG, PBTConfig(population=3))
+        member_keys = jax.random.split(KEY, 3)
+        for i in range(3):
+            single = dqn_init(member_keys[i], env, CFG)
+            member = jax.tree.map(lambda x: x[i], pop.members)
+            assert _leaves_equal(member, single)
+
+    def test_p1_pbt_bit_equals_train_iterations(self, env):
+        """THE oracle: at P=1 the exploit bracket is empty, the exchange
+        is a structural no-op, and G generations of ``iters`` iterations
+        reproduce ``train_iterations(n_iters=G*iters)`` on the same key
+        BIT-FOR-BIT — every DQNState leaf, replay ring included."""
+        pcfg = PBTConfig(population=1, generations=2,
+                         iters_per_generation=3, eval_steps=4)
+        res = train_pbt(KEY, env, CFG, pcfg)
+
+        single0 = dqn_init(jax.random.split(KEY, 1)[0], env, CFG)
+        single, _ = train_iterations(env, single0, CFG, n_iters=6)
+
+        member = jax.tree.map(lambda x: x[0], res.state.members)
+        leaves_m = jax.tree.leaves(member)
+        leaves_s = jax.tree.leaves(single)
+        for a, b in zip(leaves_m, leaves_s):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # hypers never perturbed: still exactly the config's f32 values
+        assert float(res.state.hypers.learning_rate[0]) \
+            == float(np.float32(CFG.learning_rate))
+        assert int(res.state.hypers.target_sync_every[0]) \
+            == CFG.target_sync_every
+        # lineage recorded the no-op
+        assert all(h["lineage"] == [0] for h in res.history)
+        assert all(h["n_exploited"] == 0 for h in res.history)
+        assert np.isfinite(res.fitness).all()
+
+
+class TestExchange:
+    PCFG = PBTConfig(population=8, generations=1, iters_per_generation=1,
+                     eval_steps=4, exploit_frac=0.25)
+
+    def _fresh(self, env):
+        pop = pop_init(KEY, env, CFG, self.PCFG)
+        # exchange donates its inputs — hand it copies, keep the original
+        return jax.tree.map(jnp.array, pop.members), \
+            jax.tree.map(jnp.array, pop.hypers)
+
+    def test_deterministic_under_fixed_key(self, env):
+        ex = _exchange_program(CFG, _program_pcfg(self.PCFG))
+        fitness = jnp.arange(8.0)
+        k = jax.random.PRNGKey(3)
+        m1, h1, lin1 = ex(*self._fresh(env), fitness, k)
+        m2, h2, lin2 = ex(*self._fresh(env), fitness, k)
+        assert _leaves_equal(m1, m2)
+        assert _leaves_equal(h1, h2)
+        np.testing.assert_array_equal(np.asarray(lin1), np.asarray(lin2))
+
+    def test_exploit_explore_semantics(self, env):
+        """fitness = arange → bottom bracket {0, 1}, top bracket {7, 6}.
+        Clones carry the donor's entire training state with a forked key
+        and in-box perturbed hypers; survivors pass through bitwise."""
+        pop = pop_init(KEY, env, CFG, self.PCFG)
+        ex = _exchange_program(CFG, _program_pcfg(self.PCFG))
+        members, hypers, lineage = ex(
+            jax.tree.map(jnp.array, pop.members),
+            jax.tree.map(jnp.array, pop.hypers),
+            jnp.arange(8.0), jax.random.PRNGKey(3))
+        lineage = np.asarray(lineage)
+        pcfg = self.PCFG
+
+        assert set(lineage[:2]) <= {6, 7}          # clones copy the top
+        np.testing.assert_array_equal(lineage[2:], np.arange(2, 8))
+        for i in (0, 1):
+            donor = int(lineage[i])
+            donor_params = jax.tree.map(lambda x: x[donor],
+                                        pop.members.params)
+            clone_params = jax.tree.map(lambda x, i=i: x[i], members.params)
+            assert _leaves_equal(clone_params, donor_params)
+            # …but never the donor's PRNG stream
+            assert not np.array_equal(np.asarray(members.key[i]),
+                                      np.asarray(pop.members.key[donor]))
+            # jnp.clip clips to the bounds' f32 images — compare there
+            def inside(v, lo_hi):
+                lo, hi = (float(np.float32(b)) for b in lo_hi)
+                return lo <= float(v) <= hi
+            assert inside(hypers.learning_rate[i], pcfg.lr_bounds)
+            assert inside(hypers.gamma[i], pcfg.gamma_bounds)
+            assert inside(hypers.target_sync_every[i], pcfg.sync_bounds)
+        # survivors: bitwise untouched, hypers included
+        for i in range(2, 8):
+            sm = jax.tree.map(lambda x, i=i: x[i], members)
+            om = jax.tree.map(lambda x, i=i: x[i], pop.members)
+            assert _leaves_equal(sm, om)
+            sh = jax.tree.map(lambda x, i=i: x[i], hypers)
+            oh = jax.tree.map(lambda x, i=i: x[i], pop.hypers)
+            assert _leaves_equal(sh, oh)
+
+
+class TestContracts:
+    def test_one_sync_zero_recompile_donation(self, env, monkeypatch):
+        """The evolve/ga.py regression guard, ported: ONE host_read per
+        generation, a verified population-buffer donation on the first
+        dispatch, and ZERO steady-state recompiles on a repeat run —
+        the RecompileSentinel watches the same ``pbt_generation`` window
+        the SteadyStateRecompile alert pages on (DEFAULT_HOT_PROGRAMS)."""
+        cfg = CFG._replace(replay_capacity=48)     # fresh program cache key
+        pcfg = PBTConfig(population=4, generations=2,
+                         iters_per_generation=2, eval_steps=4)
+        dp = devprof.DevProf()
+        mp = meshprof.MeshProf()
+        syncs = {"n": 0}
+        real_read = pop_mod.host_read
+
+        def counting_read(tree):
+            syncs["n"] += 1
+            return real_read(tree)
+
+        monkeypatch.setattr(pop_mod, "host_read", counting_read)
+        with devprof.use(dp), meshprof.use(mp):
+            res = train_pbt(jax.random.PRNGKey(0), env, cfg, pcfg)
+            assert syncs["n"] == pcfg.generations
+            card = dp.cards["pbt_generation"]
+            assert card.error is None
+            assert card.flops > 0
+            assert card.donation_ok is True        # no silent fleet copy
+            assert mp.recompiles.steady_total() == 0
+
+            res = train_pbt(jax.random.PRNGKey(1), env, cfg, pcfg)
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+            assert mp.recompiles.windows["pbt_generation"] \
+                == 2 * pcfg.generations
+            assert mp.transfers.total() == 0       # no unsanctioned pulls
+            assert syncs["n"] == 2 * pcfg.generations
+            assert mp.layouts["pbt_generation"].devices == 1
+        assert len(res.history) == pcfg.generations
+        assert np.isfinite(res.fitness).all()
+        assert res.best_member == int(np.argmax(res.fitness))
+
+
+class TestAdoption:
+    @pytest.fixture(scope="class")
+    def result(self, env):
+        pcfg = PBTConfig(population=4, generations=1,
+                         iters_per_generation=2, eval_steps=4)
+        return train_pbt(jax.random.PRNGKey(5), env, CFG, pcfg)
+
+    def test_winner_registers_active_without_incumbent(self, result,
+                                                       tmp_path):
+        from ai_crypto_trader_tpu.obs.scorecard import Scorecard
+        from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+        reg = ModelRegistry(path=str(tmp_path / "reg.json"))
+        out = adopt_winner(result, reg, Scorecard())
+        assert out["adopted"] is True
+        assert out["reason"] == "incumbent_unscored"
+        rec = reg.entries[out["version"]]
+        assert rec["status"] == "active"
+        assert rec["metadata"]["dynamics"] == "lob"
+        assert rec["performance"]["fitness"] == out["fitness"]
+        # the winner's params are extractable (hot-swap payload)
+        p = best_params(result)
+        assert jax.tree.leaves(p)[0].ndim >= 1
+
+    def test_worse_candidate_lands_shadow(self, result, tmp_path):
+        from ai_crypto_trader_tpu.obs.scorecard import Scorecard
+        from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+        reg = ModelRegistry(path=str(tmp_path / "reg.json"))
+        # plant an incumbent with unbeatable offline fitness
+        vid = reg.register("rl_policy", {"arch": "dqn_pbt", "fitness": 1e9},
+                           metadata={"arch": "dqn_pbt"})
+        reg.update_performance(vid, {"fitness": 1e9})
+        reg.set_status(vid, "active")
+        out = adopt_winner(result, reg, Scorecard())
+        assert out["adopted"] is False
+        assert "<=" in out["reason"]               # known-worse blocks
+        rec = reg.entries[out["version"]]
+        assert rec["status"] == "shadow"
+        assert rec["metadata"]["adoption"] == "blocked_by_scorecard"
+
+
+class TestTradeCost:
+    def test_default_env_params_bit_unchanged(self, ohlcv):
+        """No trade_cost argument → the scalar 0.0 python default, and
+        stepping charges exactly the old fee path."""
+        arrays = {k: jnp.asarray(v[:256]) for k, v in ohlcv.items()
+                  if k != "regime"}
+        ind = ops.compute_indicators(arrays)
+        p = make_env_params(ind, episode_len=32)
+        assert float(jnp.asarray(p.trade_cost)) == 0.0
+
+    def test_scalar_trade_cost_charged_on_entry(self, ohlcv):
+        arrays = {k: jnp.asarray(v[:256]) for k, v in ohlcv.items()
+                  if k != "regime"}
+        ind = ops.compute_indicators(arrays)
+        p0 = make_env_params(ind, episode_len=32)
+        p1 = make_env_params(ind, episode_len=32, trade_cost=0.002)
+        s0, _ = env_reset(p0, KEY)
+        s1, _ = env_reset(p1, KEY)
+        _, _, r0, _ = env_step(p0, s0, jnp.asarray(BUY))
+        _, _, r1, _ = env_step(p1, s1, jnp.asarray(BUY))
+        np.testing.assert_allclose(float(r0) - float(r1), 0.002, rtol=1e-4)
+
+    def test_per_step_schedule_indexed_by_time(self, ohlcv):
+        """A [T] trade-cost schedule charges the cost at the STEP's
+        time index — a spread blowout at t hits trades at t, not a flat
+        average."""
+        arrays = {k: jnp.asarray(v[:256]) for k, v in ohlcv.items()
+                  if k != "regime"}
+        ind = ops.compute_indicators(arrays)
+        T = ind["close"].shape[0]
+        p_flat = make_env_params(ind, episode_len=32, trade_cost=0.0)
+        s, _ = env_reset(p_flat, KEY)
+        t0 = int(s.t)
+        sched = jnp.zeros(T).at[t0].set(0.004)
+        p_spike = make_env_params(ind, episode_len=32, trade_cost=sched)
+        s_sp, _ = env_reset(p_spike, KEY)
+        assert int(s_sp.t) == t0                  # same reset offset
+        _, _, r_flat, _ = env_step(p_flat, s, jnp.asarray(BUY))
+        _, _, r_spike, _ = env_step(p_spike, s_sp, jnp.asarray(BUY))
+        np.testing.assert_allclose(float(r_flat) - float(r_spike), 0.004,
+                                   rtol=1e-4)
+        # off the spike the schedule charges nothing extra
+        s2, _, _, _ = env_step(p_spike, s_sp, jnp.asarray(BUY))
+        _, _, r_exit, _ = env_step(p_spike, s2, jnp.asarray(SELL))
+        s2f, _, _, _ = env_step(p_flat, s, jnp.asarray(BUY))
+        _, _, r_exit_f, _ = env_step(p_flat, s2f, jnp.asarray(SELL))
+        np.testing.assert_allclose(float(r_exit), float(r_exit_f),
+                                   atol=1e-7)
+
+    def test_lob_scenarios_wire_half_spread(self):
+        """dynamics='lob' → trade_cost is the per-scenario half-spread
+        schedule, so spread blowouts price entry/exit in the reward."""
+        from ai_crypto_trader_tpu.sim.engine import scenario_env_params
+
+        p, _labels = scenario_env_params(
+            jax.random.PRNGKey(2), scenario="mixed", num_scenarios=2,
+            steps=64, episode_len=16, dynamics="lob")
+        tc = np.asarray(p.trade_cost)
+        assert tc.ndim == 2 and tc.shape[0] == 2
+        assert (tc >= 0).all() and tc.max() > 0
