@@ -244,15 +244,63 @@ def cmd_rl(args):
                      generations=args.generations,
                      iters_per_generation=args.iters)
     partitioner = get_partitioner()
-    res = train_pbt(key, env, cfg, pcfg, partitioner=partitioner)
+
+    # --resume: rebuild the fleet from the newest intact checkpoint and
+    # continue on the ABSOLUTE generation counter — the key stream (and
+    # therefore the run) is bit-identical to one that never died.
+    # Population/config drift is rejected loudly by restore_checkpoint.
+    init_pop, start_gen, prior_history = None, 0, []
+    if args.resume:
+        from ai_crypto_trader_tpu.rl import load_checkpoint, restore_checkpoint
+
+        payload, stats = load_checkpoint(args.resume)
+        if payload is None:
+            raise SystemExit(
+                f"no intact checkpoint in {args.resume} "
+                f"(corrupt_records={stats['corrupt_records']}, "
+                f"torn_tail={stats['torn_tail']})")
+        init_pop = restore_checkpoint(payload, cfg, pcfg, env)
+        start_gen = int(payload["generation"])
+        prior_history = list(payload.get("history") or [])
+
+    # --checkpoint: journal the full fleet every N generations through
+    # the same codec the trainer service uses
+    on_generation, journal = None, None
+    full_history = list(prior_history)
+    if args.checkpoint:
+        from ai_crypto_trader_tpu.rl.trainer_service import (
+            PBT_CHECKPOINT_KIND, checkpoint_payload)
+        from ai_crypto_trader_tpu.utils.journal import SnapshotJournal
+
+        journal = SnapshotJournal(args.checkpoint, kind=PBT_CHECKPOINT_KIND)
+
+        def on_generation(g, pop, row):
+            full_history.append(row)
+            if (g + 1) % max(args.checkpoint_every, 1) == 0:
+                journal.write(checkpoint_payload(
+                    pop, generation=g + 1, cfg=cfg, pcfg=pcfg,
+                    seed=args.seed, history=full_history))
+
+    res = train_pbt(key, env, cfg, pcfg, partitioner=partitioner,
+                    init_pop=init_pop, start_generation=start_gen,
+                    on_generation=on_generation)
+    if journal is not None:
+        journal.close()
 
     print(f"population={pcfg.population} devices={partitioner.device_count} "
           f"dynamics={args.dynamics} scenarios={args.scenarios}")
-    print(f"{'gen':>3} {'best':>9} {'mean':>9} {'exploited':>9} {'loss':>9}")
-    for h in res.history:
-        print(f"{h['generation']:>3} {h['best_fitness']:>9.4f} "
+    if args.resume:
+        print(f"resumed@gen={start_gen} from {args.resume} "
+              f"({len(prior_history)} prior generations)")
+    # 'src' is the provenance column: ckpt rows replayed from the resumed
+    # checkpoint's history, live rows trained by THIS process
+    print(f"{'gen':>3} {'src':>4} {'best':>9} {'mean':>9} {'exploited':>9} "
+          f"{'quar':>4} {'loss':>9}")
+    for h in prior_history + res.history:
+        src = "ckpt" if h["generation"] < start_gen else "live"
+        print(f"{h['generation']:>3} {src:>4} {h['best_fitness']:>9.4f} "
               f"{h['mean_fitness']:>9.4f} {h['n_exploited']:>9} "
-              f"{h['loss']:>9.4f}")
+              f"{h.get('n_quarantined', 0):>4} {h['loss']:>9.4f}")
     last = res.history[-1]
     hy = last["hypers"]
     print("\nfinal fleet (* = winner; 'from' = PBT lineage, the member "
@@ -941,6 +989,18 @@ def cmd_status(args):
     if tp:
         out["tickpath_bottleneck"] = tp.get("bottleneck")
         out["event_age_p99_ms"] = (tp.get("event_age_ms") or {}).get("p99")
+    # continuous PBT training service: generation counter, quarantined
+    # members, checkpoint/recalibration staleness (rl/trainer_service.py)
+    tr = state.get("training")
+    if tr:
+        out["training"] = {
+            "generation": tr.get("generation"),
+            "best_fitness": tr.get("best_fitness"),
+            "quarantined_members": tr.get("quarantined_members"),
+            "checkpoint_age_s": tr.get("checkpoint_age_s"),
+            "last_recalibration": tr.get("last_recalibration"),
+            "resumed_at": tr.get("resumed_at"),
+        }
     print(json.dumps(out, indent=2, default=str))
 
 
@@ -1028,6 +1088,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--registry", default=None,
                     help="register + scorecard-gate the winner into this "
                          "registry JSON")
+    sp.add_argument("--checkpoint", default=None,
+                    help="journal full fleet snapshots to this path every "
+                         "--checkpoint-every generations (resume-able)")
+    sp.add_argument("--checkpoint-every", type=int, default=1)
+    sp.add_argument("--resume", default=None,
+                    help="resume from the newest intact checkpoint in this "
+                         "journal: generation counter, fitness history and "
+                         "hypers continue bit-identically to a run that "
+                         "never died")
     sp.set_defaults(fn=cmd_rl)
     sp = sub.add_parser("generate",
                         help="generate strategy structures (real-CV search)")

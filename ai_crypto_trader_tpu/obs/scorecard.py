@@ -52,6 +52,11 @@ class Scorecard:
     _stats: dict = field(default_factory=dict)     # (arch, s, iv) -> deque
     resolved_total: int = 0
     expired_total: int = 0
+    # adoption-gate verdict trail (bounded): every candidate that passed
+    # through `adoption_gate` on its way into the registry — the PBT
+    # trainer records each generation's winner here so operators can see
+    # WHY a policy went active or shadow without grepping a journal
+    adoptions: deque = field(default_factory=lambda: deque(maxlen=64))
 
     # -- intake --------------------------------------------------------------
     def record_prediction(self, payload: dict) -> bool:
@@ -201,6 +206,15 @@ class Scorecard:
         return False, (f"candidate {candidate_arch} live score {cand:.3f} "
                        f"<= incumbent {incumbent_arch} {inc:.3f}")
 
+    def record_adoption(self, verdict: dict) -> dict:
+        """Append one adoption-gate verdict (``{"version", "adopted",
+        "reason", "fitness", ...}`` — the `rl/population.adopt_winner`
+        return shape plus caller context) to the bounded trail and stamp
+        it with the scorecard clock.  Returns the stored record."""
+        rec = dict(verdict, at=self.now_fn())
+        self.adoptions.append(rec)
+        return rec
+
     # -- export --------------------------------------------------------------
     def export(self) -> None:
         m = self.metrics
@@ -229,8 +243,11 @@ class Scorecard:
         return out
 
     def status(self) -> dict:
-        return {"pending": len(self._pending),
-                "resolved": self.resolved_total,
-                "expired": self.expired_total,
-                "groups": {f"{a}:{s}:{iv}": sc for (a, s, iv), sc
-                           in self.scores().items()}}
+        out = {"pending": len(self._pending),
+               "resolved": self.resolved_total,
+               "expired": self.expired_total,
+               "groups": {f"{a}:{s}:{iv}": sc for (a, s, iv), sc
+                          in self.scores().items()}}
+        if self.adoptions:
+            out["adoptions"] = list(self.adoptions)[-8:]
+        return out

@@ -1,6 +1,7 @@
 from ai_crypto_trader_tpu.rl.env import (  # noqa: F401
     EnvParams,
     EnvState,
+    assert_transfer_compatible,
     env_reset,
     env_step,
     make_env_params,
@@ -14,6 +15,7 @@ from ai_crypto_trader_tpu.rl.dqn import (  # noqa: F401
     dqn_init,
     evaluate_policy,
     hypers_from_config,
+    poisoned_members,
     train_dqn,
     train_iteration,
     train_iterations,
@@ -27,4 +29,11 @@ from ai_crypto_trader_tpu.rl.population import (  # noqa: F401
     pbt_env_params,
     pop_init,
     train_pbt,
+)
+from ai_crypto_trader_tpu.rl.trainer_service import (  # noqa: F401
+    PBT_CHECKPOINT_KIND,
+    PBTTrainerService,
+    checkpoint_payload,
+    load_checkpoint,
+    restore_checkpoint,
 )

@@ -148,6 +148,24 @@ def dqn_init(key, env_params: EnvParams, cfg: DQNConfig) -> DQNState:
                     learn_steps=jnp.asarray(0, jnp.int32), key=key)
 
 
+def poisoned_members(state: DQNState, fitness=None) -> jnp.ndarray:
+    """[P] poison mask over a population-batched DQNState: True where ANY
+    float leaf of member *i*'s params or optimizer state — or its fitness,
+    when given — carries a NaN/Inf.  The traced detector the population
+    quarantine (rl/population.py) ORs into its sticky `quarantined` bit:
+    pure reads over array content, so a trip never recompiles.  Works on
+    any leading batch axis (the leaves' axis 0)."""
+    leaves = jax.tree.leaves((state.params, state.opt_state))
+    n = leaves[0].shape[0]
+    bad = (jnp.zeros((n,), jnp.bool_) if fitness is None
+           else ~jnp.isfinite(fitness))
+    for leaf in leaves:
+        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+            bad = bad | ~jnp.all(
+                jnp.isfinite(leaf).reshape(leaf.shape[0], -1), axis=1)
+    return bad
+
+
 def act(key, params, obs, epsilon, cfg: DQNConfig):
     """ε-greedy batched action selection (`reinforcement_learning.py:292-318`)."""
     q = QNetwork(cfg.hidden, cfg.n_actions).apply(params, obs)

@@ -67,6 +67,30 @@ def obs_size(p: EnvParams) -> int:
     return int(p.obs_table.shape[-1]) + 2
 
 
+def assert_transfer_compatible(old: EnvParams, new: EnvParams) -> None:
+    """Raise unless swapping ``old`` for ``new`` under a compiled program
+    is a pure TRANSFER — identical pytree structure, leaf shapes, dtypes
+    and static fields.  The rolling-recalibration contract
+    (rl/trainer_service.py): re-fitted FlowParams regenerate the feature
+    tables' VALUES, so a swap that would change a shape (and silently
+    recompile every program the env threads through) is a bug upstream,
+    not a recalibration."""
+    if int(old.episode_len) != int(new.episode_len):
+        raise ValueError(
+            f"env episode_len changed {old.episode_len} -> "
+            f"{new.episode_len}: a recalibrated env must be shape-stable")
+    o_l, n_l = jax.tree.leaves(old), jax.tree.leaves(new)
+    if len(o_l) != len(n_l):
+        raise ValueError("env pytree structure changed under recalibration")
+    for a, b in zip(o_l, n_l):
+        a, b = jnp.asarray(a), jnp.asarray(b)
+        if a.shape != b.shape or a.dtype != b.dtype:
+            raise ValueError(
+                f"env leaf changed {a.shape}/{a.dtype} -> "
+                f"{b.shape}/{b.dtype}: a recalibration is a transfer, "
+                f"never a recompile")
+
+
 def make_env_params(ind: dict, episode_len: int = 256,
                     fee_rate: float = 0.0,
                     extra_features=None, trade_cost=None) -> EnvParams:

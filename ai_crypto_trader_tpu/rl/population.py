@@ -58,6 +58,7 @@ from ai_crypto_trader_tpu.rl.dqn import (
     _iteration,
     dqn_init,
     hypers_from_config,
+    poisoned_members,
 )
 from ai_crypto_trader_tpu.rl.env import EnvParams, env_reset, env_step
 from ai_crypto_trader_tpu.utils import devprof, meshprof
@@ -70,6 +71,18 @@ _SINGLE = SingleDevicePartitioner()
 # key WITHOUT consuming it (consuming would break the P=1 parity oracle:
 # the single-agent trainer never evaluates mid-run)
 _EVAL_SALT = 0x5EED
+
+
+@jax.jit
+def _owned_copy(tree):
+    """Re-home every leaf into an executable-owned device buffer.
+
+    Inputs are NOT donated, so the runtime can never alias an output to
+    a caller buffer — the outputs are fresh allocations the executable
+    owns.  This is the safety valve between host-backed arrays (numpy
+    views from checkpoint unpack, chaos-edited members) and the donating
+    fleet programs downstream."""
+    return jax.tree.map(jnp.copy, tree)
 
 
 class PBTConfig(NamedTuple):
@@ -86,22 +99,37 @@ class PBTConfig(NamedTuple):
     eps_decay_bounds: tuple = (0.9, 0.99999)
     eps_min_bounds: tuple = (1e-3, 0.2)
     sync_bounds: tuple = (2, 1000)  # target_sync_every clip (learn steps)
+    # exchanges a tripped member stays frozen (masked out of ranking AND
+    # selection) before the forced-exploit heal clones a survivor over it
+    quarantine_cooldown: int = 1
 
 
 class PopState(NamedTuple):
-    """The device-resident fleet: every leaf leads with the [P] axis."""
+    """The device-resident fleet: every leaf leads with the [P] axis.
+
+    ``quarantined``/``cooldown`` are the member-containment bits (the
+    ops/tenant_engine.py lane pattern on the training axis): ARRAY
+    CONTENT carried in the donated state, so a trip, a cooldown tick and
+    a heal move values — never shapes — and the executable that trained
+    a healthy fleet trains a poisoned one (the meshprof sentinel pins
+    it).  A quarantined member keeps training (its NaNs stay its own —
+    the vmap lanes are independent) but is masked out of fitness ranking
+    and exchange selection until the forced-exploit heal replaces it."""
 
     members: DQNState   # each field stacked [P, ...]
     hypers: Hypers      # each field [P]
+    quarantined: jnp.ndarray   # [P] bool — sticky poison bit
+    cooldown: jnp.ndarray      # [P] i32 — exchanges left before heal
 
 
 class PBTResult(NamedTuple):
     state: PopState          # final fleet (device arrays)
     fitness: np.ndarray      # [P] final-generation fitness (host)
-    best_member: int
+    best_member: int         # argmax over HEALTHY members
     history: list            # one dict per generation
     cfg: DQNConfig
     pcfg: PBTConfig
+    quarantined: np.ndarray | None = None  # [P] final quarantine bits
 
 
 def host_read(tree):
@@ -122,7 +150,9 @@ def _pop_init_jit(key, env_params: EnvParams, cfg: DQNConfig, n: int):
     hypers = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (n,) + x.shape),
         hypers_from_config(cfg))
-    return PopState(members=members, hypers=hypers)
+    return PopState(members=members, hypers=hypers,
+                    quarantined=jnp.zeros((n,), jnp.bool_),
+                    cooldown=jnp.zeros((n,), jnp.int32))
 
 
 def pop_init(key, env_params: EnvParams, cfg: DQNConfig,
@@ -187,7 +217,18 @@ def _pbt_program(cfg: DQNConfig, pcfg: PBTConfig, partitioner: Partitioner):
         members, fitness, met = jax.vmap(
             member_generation, in_axes=(0, 0, None))(
                 pop.members, pop.hypers, env_params)
-        return PopState(members=members, hypers=pop.hypers), fitness, met
+        # in-program member containment (the tenant-engine lane pattern
+        # on the [P] axis): a NaN/Inf anywhere in a member's params /
+        # opt state / fitness ORs into its sticky quarantine bit, with
+        # an edge-armed cooldown — all array content, zero recompiles
+        poisoned = poisoned_members(members, fitness)
+        newly = poisoned & ~pop.quarantined
+        quarantined = pop.quarantined | poisoned
+        cooldown = jnp.where(newly, pcfg.quarantine_cooldown, pop.cooldown)
+        met = dict(met, tripped_new=newly)
+        return PopState(members=members, hypers=pop.hypers,
+                        quarantined=quarantined,
+                        cooldown=cooldown), fitness, met
 
     return partitioner.population_eval(generation, name="pbt_generation",
                                        donate_pop=True)
@@ -201,11 +242,24 @@ def _exchange_program(cfg: DQNConfig, pcfg: PBTConfig):
     copies' hyperparameters in place.  Everything is array content —
     fitness values move, the executable never recompiles.
 
-    Returns ``(members', hypers', lineage)`` where ``lineage[i]`` is the
-    member *i* copied from (its own index if it survived).  When the
-    bracket is empty (P·frac < 1, notably P=1) the exchange is a
-    structural no-op and the donated buffers pass straight through —
-    the parity oracle's contract."""
+    Returns ``(members', hypers', quarantined', cooldown', lineage)``
+    where ``lineage[i]`` is the member *i* copied from (its own index if
+    it survived).  When the bracket is empty (P·frac < 1, notably P=1)
+    the exchange is a structural no-op and the donated buffers pass
+    straight through — the parity oracle's contract.
+
+    Quarantine semantics (all array content — no recompiles):
+
+      * a quarantined member's fitness is masked to ``-inf`` for DONOR
+        ranking — a poisoned fleet member can never be cloned from;
+      * while its cooldown runs it is also masked OUT of the exploit
+        bracket (``+inf`` for bottom ranking): frozen, neither donor nor
+        clone, so healthy members see exactly the exchange they would
+        have seen had the slot been mid-pack;
+      * once the cooldown expires it ranks ``-inf`` for the bottom
+        bracket — the forced exploit — and the clone that overwrites it
+        IS the heal (PBT's own repair path: survivor state + fold_in
+        key fork + freshly perturbed hypers), clearing the bit."""
     n = int(pcfg.population * pcfg.exploit_frac)
 
     def _jitter(key, shape):
@@ -214,17 +268,33 @@ def _exchange_program(cfg: DQNConfig, pcfg: PBTConfig):
         return jnp.where(up, pcfg.perturb_scale, 1.0 / pcfg.perturb_scale)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def exchange(members: DQNState, hypers: Hypers, fitness, key):
+    def exchange(members: DQNState, hypers: Hypers, quarantined, cooldown,
+                 fitness, key):
         P = fitness.shape[0]
         lineage = jnp.arange(P, dtype=jnp.int32)
+        heal_ready = quarantined & (cooldown <= 0)
+        frozen = quarantined & ~heal_ready
+        cooldown = jnp.maximum(cooldown - frozen.astype(jnp.int32), 0)
         if n == 0:
-            return members, hypers, lineage
+            return members, hypers, quarantined, cooldown, lineage
 
-        bottom, top, _ = quantile_split(fitness, pcfg.exploit_frac)
+        neg = jnp.asarray(-jnp.inf, fitness.dtype)
+        # two ranking views of the same fitness (identical bitwise when
+        # nothing is quarantined — the P=1/parity oracle's contract):
+        # donors never poisoned, frozen slots never exploited, heal-ready
+        # slots forced into the exploit bracket
+        fit_top = jnp.where(quarantined, neg, fitness)
+        fit_bottom = jnp.where(frozen, -neg,
+                               jnp.where(heal_ready, neg, fitness))
+        bottom, _, _ = quantile_split(fit_bottom, pcfg.exploit_frac)
+        _, top, _ = quantile_split(fit_top, pcfg.exploit_frac)
         k_donor, k_jit = jax.random.split(key)
         donors = top[jax.random.randint(k_donor, (n,), 0, n)]
         lineage = lineage.at[bottom].set(donors)
         cloned = lineage != jnp.arange(P)
+        healed = cloned & heal_ready
+        quarantined = quarantined & ~healed
+        cooldown = jnp.where(healed, 0, cooldown)
 
         # exploit: clones gather the donor's ENTIRE training state —
         # params, target, opt state, replay ring, env states, ε
@@ -260,7 +330,7 @@ def _exchange_program(cfg: DQNConfig, pcfg: PBTConfig):
         hypers = jax.tree.map(
             lambda p, o: jnp.where(
                 cloned.reshape((P,) + (1,) * (p.ndim - 1)), p, o), pert, hy)
-        return members, hypers, lineage
+        return members, hypers, quarantined, cooldown, lineage
 
     return exchange
 
@@ -273,7 +343,9 @@ def _program_pcfg(pcfg: PBTConfig) -> PBTConfig:
 
 
 def train_pbt(key, env_params: EnvParams, cfg: DQNConfig, pcfg: PBTConfig,
-              partitioner: Partitioner | None = None) -> PBTResult:
+              partitioner: Partitioner | None = None, *,
+              init_pop: PopState | None = None, start_generation: int = 0,
+              on_generation=None) -> PBTResult:
     """Host driver: G generations of [train+eval → exchange], ONE
     host_read per generation.
 
@@ -283,9 +355,29 @@ def train_pbt(key, env_params: EnvParams, cfg: DQNConfig, pcfg: PBTConfig,
     recompile or an unsanctioned device→host transfer pages exactly
     like the GA's would.  The first generation publishes the
     ``pbt_generation`` devprof cost card and verifies the donation
-    actually freed the old fleet buffers."""
+    actually freed the old fleet buffers.
+
+    ``init_pop``/``start_generation`` are the RESUME seam (the trainer
+    service + ``cli rl --resume``): hand back a restored fleet and the
+    absolute generation counter it stopped at and the run continues on
+    the exact key stream an uninterrupted run would have used — the
+    exchange key is ``fold_in(key, g+1)`` with g ABSOLUTE, so a resumed
+    run is bit-identical to one that never died.  ``on_generation(g,
+    pop, row)`` fires after each generation's host_read (checkpoint
+    cadences hook here; a host callback, never a recompile)."""
     partitioner = partitioner if partitioner is not None else _SINGLE
-    pop = pop_init(key, env_params, cfg, pcfg)
+    if init_pop is not None:
+        # A handed-in fleet may sit on HOST-backed buffers (checkpoint
+        # unpack → numpy, chaos poisoning via numpy) that the CPU runtime
+        # zero-copy aliases when alignment allows.  The generation program
+        # DONATES the population; donating an aliased buffer lets XLA
+        # scribble on — then free — memory it never owned, which surfaces
+        # as glibc heap corruption ticks later, not as an exception.  One
+        # non-donating jitted copy re-homes every leaf into
+        # executable-owned device buffers before anything donates them.
+        pop = _owned_copy(init_pop)
+    else:
+        pop = pop_init(key, env_params, cfg, pcfg)
     if pcfg.population % partitioner.device_count == 0:
         pop = partitioner.shard_population(pop)
 
@@ -302,42 +394,69 @@ def train_pbt(key, env_params: EnvParams, cfg: DQNConfig, pcfg: PBTConfig,
 
     history = []
     host = None
-    for g in range(pcfg.generations):
-        gcold = cold and g == 0
-        donated = jax.tree.leaves(pop) if (prof is not None and g == 0) \
+    first = True
+    for g in range(start_generation, start_generation + pcfg.generations):
+        gcold = cold and first
+        donated = jax.tree.leaves(pop) if (prof is not None and first) \
             else None
+        first = False
         t0 = time.perf_counter()
         with tickpath.coldstart("pbt_generation", cold=gcold), \
                 meshprof.watch("pbt_generation", cold=gcold):
             pop, fitness, met = program(pop, env_params)
+            members, hypers, quarantined, cooldown, lineage = exchange(
+                pop.members, pop.hypers, pop.quarantined, pop.cooldown,
+                fitness, jax.random.fold_in(key, g + 1))
             if donated is not None:
                 devprof.verify_donation("pbt_generation", donated)
-            members, hypers, lineage = exchange(
-                pop.members, pop.hypers, fitness,
-                jax.random.fold_in(key, g + 1))
-            pop = PopState(members=members, hypers=hypers)
+            # tripped bits survive the exchange un-donated (args 2/3),
+            # so the heal edge rides the SAME one host_read
+            pre_q = pop.quarantined
+            pop = PopState(members=members, hypers=hypers,
+                           quarantined=quarantined, cooldown=cooldown)
             host = host_read({"fitness": fitness, "lineage": lineage,
-                              "hypers": hypers._asdict(), "metrics": met})
+                              "hypers": hypers._asdict(), "metrics": met,
+                              "pre_quarantined": pre_q,
+                              "quarantined": quarantined,
+                              "cooldown": cooldown})
         if prof is not None:
             prof.observe_latency("pbt_generation", time.perf_counter() - t0)
-        history.append({
+        fin = np.asarray(host["fitness"])
+        q = np.asarray(host["quarantined"])
+        pre_q_h = np.asarray(host["pre_quarantined"])
+        healthy = ~pre_q_h
+        # a quarantined member's NaN fitness must never poison the
+        # fleet-level stats — rank over healthy members only
+        row = {
             "generation": g,
-            "best_fitness": float(host["fitness"].max()),
-            "mean_fitness": float(host["fitness"].mean()),
+            "best_fitness": float(fin[healthy].max()) if healthy.any()
+            else float("nan"),
+            "mean_fitness": float(fin[healthy].mean()) if healthy.any()
+            else float("nan"),
             "n_exploited": int(
                 (host["lineage"] != np.arange(pcfg.population)).sum()),
-            "fitness": host["fitness"].tolist(),
+            "fitness": fin.tolist(),
             "lineage": host["lineage"].tolist(),
             "hypers": {k: np.asarray(v).tolist()
                        for k, v in host["hypers"].items()},
             "loss": float(host["metrics"]["loss"].mean()),
             "mean_reward": float(host["metrics"]["mean_reward"].mean()),
-        })
+            "quarantined": q.tolist(),
+            "n_quarantined": int(q.sum()),
+            "n_tripped": int(
+                np.asarray(host["metrics"]["tripped_new"]).sum()),
+            "n_healed": int((pre_q_h & ~q).sum()),
+        }
+        history.append(row)
+        if on_generation is not None:
+            on_generation(g, pop, row)
 
     fitness = np.asarray(host["fitness"])
+    q = np.asarray(host["quarantined"])
+    ranked = np.where(q, -np.inf, fitness)
     return PBTResult(state=pop, fitness=fitness,
-                     best_member=int(np.argmax(fitness)),
-                     history=history, cfg=cfg, pcfg=pcfg)
+                     best_member=int(np.argmax(ranked)),
+                     history=history, cfg=cfg, pcfg=pcfg, quarantined=q)
 
 
 def best_params(result: PBTResult):

@@ -1,0 +1,496 @@
+"""Continuous PBT training service: the launcher-managed loop that turns
+one-shot `cli rl` sessions into a fleet that never stops training.
+
+ROADMAP item 1's remaining half.  `rl/population.py` (PR 19) made the
+population a single compiled program; this module makes it a SERVICE —
+the evolver-service cadence pattern (`shell/stack.EvolverService`) run
+under launcher supervision (StageBreaker + heartbeat via
+`TradingSystem.attach_trainer`), with the durability and containment rim
+a days-long run actually needs:
+
+  * **one generation per cadence tick** — `train_pbt(generations=1)`
+    with the ABSOLUTE generation counter threaded through, so the key
+    stream is identical to an uninterrupted `train_pbt` call;
+  * **crash-safe lineage** — every ``checkpoint_every`` generations the
+    FULL vmapped training state (params, targets, opt state, replay
+    rings, env states, PRNG keys, Hypers, quarantine bits, fitness
+    history, adoption trail) lands in a `utils/journal.SnapshotJournal`
+    as `pack_array` records: per-array CRCs catch bit rot, the WAL line
+    CRC catches torn tails, compaction bounds the file.  A run killed
+    mid-generation resumes from the newest intact checkpoint and
+    produces BIT-identical history — the resume-parity pin;
+  * **winner flow** — each generation's best healthy member goes through
+    the existing `adopt_winner` scorecard gate (active when it beats the
+    incumbent's simulator fitness, shadow otherwise), the verdict
+    journaled beside the checkpoints AND recorded on the scorecard's
+    adoption trail;
+  * **rolling recalibration with last-good fallback** — every
+    ``recalibrate_every`` generations the LOB FlowParams are re-fit from
+    fresh DepthCapture snapshots (`sim/calibrate.fit_flow_params`) so
+    the training distribution tracks the venue; an empty, NaN-poisoned
+    or CRC-corrupted window degrades to the last-good params with
+    ``pbt_recalibration_failures_total`` counted — and the swap is
+    shape-guarded (`rl/env.assert_transfer_compatible`): a transfer,
+    never a recompile.
+
+Alert inputs (`alert_state()`, merged into the launcher's rule-engine
+state) and gauges pair with the `TrainingFleetStalled` /
+`MemberQuarantined` rules in utils/alerts.py and their PromQL twins in
+monitoring/alert_rules.yml.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_crypto_trader_tpu.rl.dqn import DQNConfig
+from ai_crypto_trader_tpu.rl.population import (
+    PBTConfig,
+    PopState,
+    adopt_winner,
+    host_read,
+    pbt_env_params,
+    pop_init,
+    train_pbt,
+)
+from ai_crypto_trader_tpu.utils.journal import (
+    SnapshotJournal,
+    load_snapshot,
+    pack_array,
+    unpack_array,
+)
+
+#: WAL record kind for trainer checkpoints (distinct from the tenant
+#: fleet's `fleet_state` stream — `load_snapshot(kind=...)` selects it)
+PBT_CHECKPOINT_KIND = "pbt_lineage"
+
+#: checkpoint payload format version — bump on layout changes so a
+#: restore can refuse cleanly instead of mis-unpacking
+CHECKPOINT_FORMAT = 1
+
+
+def _cfg_identity(cfg: DQNConfig) -> dict:
+    """The DQNConfig fields that shape the training-state arrays — the
+    drift detector's comparison key (hypers are state, not identity)."""
+    return {"state_size": int(cfg.state_size),
+            "num_envs": int(cfg.num_envs),
+            "rollout_len": int(cfg.rollout_len),
+            "hidden": [int(h) for h in cfg.hidden],
+            "n_actions": int(cfg.n_actions),
+            "replay_capacity": int(cfg.replay_capacity),
+            "batch_size": int(cfg.batch_size)}
+
+
+def checkpoint_payload(pop: PopState, *, generation: int, cfg: DQNConfig,
+                       pcfg: PBTConfig, seed: int | None = None,
+                       history: list | None = None,
+                       adoptions: list | None = None,
+                       recalibration: dict | None = None,
+                       flow=None) -> dict:
+    """Serialize the FULL fleet as one journal-ready snapshot payload.
+
+    Every leaf of the PopState pytree (params, target params, opt state,
+    replay rings, env states, obs, ε, learn counters, PRNG keys, Hypers,
+    quarantine bits, cooldowns) rides as a `pack_array` record — raw
+    bytes + dtype + shape + CRC per array, so the restore is BIT-exact
+    and bit rot raises instead of silently training a corrupted fleet."""
+    leaves = host_read(jax.tree.leaves(pop))
+    payload = {
+        "format": CHECKPOINT_FORMAT,
+        "generation": int(generation),
+        "population": int(pcfg.population),
+        "seed": None if seed is None else int(seed),
+        "cfg": _cfg_identity(cfg),
+        "arrays": [pack_array(np.asarray(leaf)) for leaf in leaves],
+        "history": list(history or []),
+        "adoptions": list(adoptions or []),
+        "recalibration": recalibration,
+    }
+    if flow is not None:
+        payload["flow"] = {k: float(v) for k, v in flow._asdict().items()}
+    return payload
+
+
+def restore_checkpoint(payload: dict, cfg: DQNConfig, pcfg: PBTConfig,
+                       env_params) -> PopState:
+    """Rebuild the device-resident fleet from a checkpoint payload.
+
+    Refuses loudly on every drift axis instead of mis-shaping state into
+    a recompile (or worse, silently training the wrong fleet):
+    population-size drift, network/replay-shape drift, leaf-count drift,
+    and per-leaf shape/dtype drift; per-array CRC mismatches raise from
+    `unpack_array` before any of that."""
+    if int(payload.get("format", -1)) != CHECKPOINT_FORMAT:
+        raise ValueError(
+            f"checkpoint format {payload.get('format')!r} != "
+            f"{CHECKPOINT_FORMAT} — refusing to guess a layout")
+    saved_p = int(payload.get("population", -1))
+    if saved_p != int(pcfg.population):
+        raise ValueError(
+            f"checkpoint population {saved_p} != configured population "
+            f"{pcfg.population}: refusing to load a drifted fleet "
+            f"(resume with population={saved_p} or start fresh)")
+    saved_cfg = payload.get("cfg") or {}
+    want_cfg = _cfg_identity(cfg)
+    if saved_cfg != want_cfg:
+        drift = {k: (saved_cfg.get(k), want_cfg[k]) for k in want_cfg
+                 if saved_cfg.get(k) != want_cfg[k]}
+        raise ValueError(
+            f"checkpoint training-config drift {drift} (saved, "
+            f"configured): the snapshot arrays would not fit this fleet")
+    leaves = [unpack_array(a) for a in payload["arrays"]]  # CRC per array
+    template = pop_init(jax.random.PRNGKey(0), env_params, cfg, pcfg)
+    t_leaves, treedef = jax.tree.flatten(template)
+    if len(leaves) != len(t_leaves):
+        raise ValueError(
+            f"checkpoint carries {len(leaves)} arrays, fleet needs "
+            f"{len(t_leaves)}: state-layout drift")
+    for i, (got, want) in enumerate(zip(leaves, t_leaves)):
+        if tuple(got.shape) != tuple(want.shape) \
+                or got.dtype != np.asarray(want).dtype:
+            raise ValueError(
+                f"checkpoint array {i} is {got.shape}/{got.dtype}, fleet "
+                f"needs {tuple(want.shape)}/{np.asarray(want).dtype}: "
+                f"state-shape drift")
+    return jax.tree.unflatten(treedef, [jnp.asarray(a) for a in leaves])
+
+
+def load_checkpoint(path: str) -> tuple[dict | None, dict]:
+    """Newest intact trainer checkpoint from ``path`` (torn-tail
+    tolerant — a kill mid-append falls back to the previous generation's
+    record).  Returns ``(payload | None, replay stats)``."""
+    return load_snapshot(path, kind=PBT_CHECKPOINT_KIND)
+
+
+@dataclass
+class PBTTrainerService:
+    """The continuously-training fleet as a launcher cadence service.
+
+    Register via `TradingSystem.attach_trainer` (StageBreaker +
+    heartbeat supervision) or append to ``extra_services`` directly;
+    each eligible tick runs ONE PBT generation, then the durability /
+    adoption / recalibration rim around it.  All state mutation happens
+    on the host between compiled dispatches — the device programs are
+    exactly the ones `train_pbt` compiles, shared through the same
+    lru-caches, so a service fleet and a one-shot session are
+    bit-interchangeable."""
+
+    cfg: DQNConfig
+    pcfg: PBTConfig
+    env_params: object = None          # EnvParams; built lazily when None
+    seed: int = 0
+    partitioner: object = None
+    interval_s: float = 0.0            # 0 = one generation per tick
+    max_generations: int | None = None
+    # durability
+    checkpoint_path: str | None = None
+    checkpoint_every: int = 1
+    compact_every: int = 8
+    # adoption
+    registry: object = None
+    scorecard: object = None
+    adopt_every: int = 1
+    # recalibration
+    depth_source: object = None        # DepthCapture | callable | path
+    recalibrate_every: int = 0         # generations between re-fits; 0=off
+    calibration_symbol: str | None = None
+    env_builder: object = None         # callable(flow) -> EnvParams
+    env_kwargs: dict = field(default_factory=dict)
+    # plumbing
+    now_fn: object = time.time
+    metrics: object = None
+    name: str = "trainer"
+    stall_after_s: float | None = None  # default: max(3·interval, 60 s)
+
+    # -- mutable service state ----------------------------------------------
+    generation: int = 0
+    history: list = field(default_factory=list)
+    adoptions: list = field(default_factory=list)
+    flow: object = None                # last-good FlowParams
+    last_recalibration: dict | None = None
+    recalibration_failures: int = 0
+    quarantine_trips: int = 0
+    member_heals: int = 0
+    resumed_at: int | None = None      # provenance: generation resumed from
+    last_generation_at: float | None = None
+    last_checkpoint_at: float | None = None
+    last_checkpoint_generation: int | None = None
+    _pop: object = None
+    _journal: object = None
+    _last: float = -1e18
+
+    # -- lifecycle -----------------------------------------------------------
+    def _build_env(self, flow):
+        if self.env_builder is not None:
+            out = self.env_builder(flow)
+        else:
+            kw = dict(self.env_kwargs)
+            if self.env_params is not None:
+                # a re-fit must regenerate VALUES into the live env's
+                # SHAPES (assert_transfer_compatible's contract) — derive
+                # the scenario geometry from the env itself so a caller
+                # who handed us env_params never has to restate it
+                shape = self.env_params.close.shape
+                if len(shape) == 2:
+                    kw.setdefault("num_scenarios", int(shape[0]))
+                kw.setdefault("steps", int(shape[-1]))
+                kw.setdefault("episode_len", int(self.env_params.episode_len))
+            out = pbt_env_params(jax.random.PRNGKey(self.seed), flow=flow,
+                                 **kw)
+        return out[0] if isinstance(out, tuple) else out
+
+    def _ensure_journal(self):
+        if self._journal is None and self.checkpoint_path is not None:
+            self._journal = SnapshotJournal(self.checkpoint_path,
+                                            compact_every=self.compact_every,
+                                            now_fn=self.now_fn,
+                                            kind=PBT_CHECKPOINT_KIND)
+        return self._journal
+
+    def _bootstrap(self) -> dict:
+        """First run: resume from the newest intact checkpoint when one
+        exists, else init a fresh fleet.  Either way the env comes up
+        from the SAME builder — on resume with a persisted last-good
+        flow, from that flow, so the training distribution survives the
+        restart too."""
+        resumed = False
+        payload = None
+        if self.checkpoint_path is not None \
+                and os.path.exists(self.checkpoint_path):
+            payload, _stats = load_checkpoint(self.checkpoint_path)
+        if payload is not None:
+            from ai_crypto_trader_tpu.sim.lob import flow_params
+
+            if payload.get("flow"):
+                self.flow = flow_params(**payload["flow"])
+            if self.env_params is None:
+                self.env_params = self._build_env(self.flow)
+            self._pop = restore_checkpoint(payload, self.cfg, self.pcfg,
+                                           self.env_params)
+            self.generation = int(payload["generation"])
+            self.history = list(payload.get("history") or [])
+            self.adoptions = list(payload.get("adoptions") or [])
+            self.last_recalibration = payload.get("recalibration")
+            self.resumed_at = self.generation
+            self.last_checkpoint_generation = self.generation
+            resumed = True
+        else:
+            if self.env_params is None:
+                self.env_params = self._build_env(self.flow)
+            self._pop = pop_init(jax.random.PRNGKey(self.seed),
+                                 self.env_params, self.cfg, self.pcfg)
+        return {"resumed": resumed, "generation": self.generation}
+
+    # -- the rim -------------------------------------------------------------
+    def _depth_records(self) -> list:
+        src = self.depth_source
+        if src is None:
+            raise ValueError("no depth source configured")
+        if callable(src):
+            return list(src())
+        if isinstance(src, str):
+            from ai_crypto_trader_tpu.shell.stream import (
+                depth_records_from_journal,
+            )
+
+            records, _stats = depth_records_from_journal(src)
+            return records
+        window = getattr(src, "calibration_window", None)
+        if window is not None:
+            return window(symbol=self.calibration_symbol)
+        return list(src.records())
+
+    def _recalibrate(self) -> dict:
+        """Re-fit FlowParams from the freshest capture window; ANY
+        failure (empty window, poisoned records, fit error, shape drift)
+        keeps the last-good params and counts — the fleet trains on,
+        never on a poisoned distribution."""
+        from ai_crypto_trader_tpu.rl.env import assert_transfer_compatible
+        from ai_crypto_trader_tpu.sim import calibrate
+
+        now = self.now_fn()
+        try:
+            records = self._depth_records()
+            calibrate.validate_depth_records(
+                records, symbol=self.calibration_symbol)
+            flow, report = calibrate.fit_flow_params(
+                records, symbol=self.calibration_symbol)
+            new_env = self._build_env(flow)
+            assert_transfer_compatible(self.env_params, new_env)
+        except Exception as exc:        # noqa: BLE001 — last-good fallback
+            self.recalibration_failures += 1
+            if self.metrics is not None:
+                self.metrics.inc("pbt_recalibration_failures_total")
+            self.last_recalibration = {
+                "at": now, "generation": self.generation, "ok": False,
+                "reason": f"{type(exc).__name__}: {exc}"}
+            return self.last_recalibration
+        self.flow = flow
+        self.env_params = new_env
+        if self.metrics is not None:
+            self.metrics.set_gauge("pbt_last_recalibration_timestamp", now)
+        self.last_recalibration = {
+            "at": now, "generation": self.generation, "ok": True,
+            "records": int(np.asarray(report.get("frames", 0)).item())
+            if isinstance(report, dict) else None}
+        return self.last_recalibration
+
+    def checkpoint(self) -> int | None:
+        """Durably snapshot the fleet NOW (also the `checkpoint_every`
+        cadence target).  Returns the WAL sequence number."""
+        journal = self._ensure_journal()
+        if journal is None or self._pop is None:
+            return None
+        seq = journal.write(checkpoint_payload(
+            self._pop, generation=self.generation, cfg=self.cfg,
+            pcfg=self.pcfg, seed=self.seed, history=self.history,
+            adoptions=self.adoptions,
+            recalibration=self.last_recalibration, flow=self.flow))
+        self.last_checkpoint_at = self.now_fn()
+        self.last_checkpoint_generation = self.generation
+        return seq
+
+    def _adopt(self, result) -> dict | None:
+        if self.registry is None:
+            return None
+        verdict = adopt_winner(result, self.registry, self.scorecard)
+        rec = dict(verdict, generation=self.generation)
+        self.adoptions.append(rec)
+        if self.scorecard is not None:
+            self.scorecard.record_adoption(rec)
+        journal = self._ensure_journal()
+        if journal is not None:
+            # the verdict rides the SAME WAL as the checkpoints (and the
+            # checkpoint payload's adoption trail survives compaction)
+            journal.journal.append("pbt_adoption", rec, flush=True)
+        return rec
+
+    # -- the service tick ----------------------------------------------------
+    async def run_once(self) -> dict:
+        now = self.now_fn()
+        if now - self._last < self.interval_s:
+            return {"ran": False}
+        if self.max_generations is not None \
+                and self.generation >= self.max_generations:
+            return {"ran": False, "reason": "complete"}
+        self._last = now
+        out: dict = {"ran": True}
+        if self._pop is None:
+            out["bootstrap"] = self._bootstrap()
+        if self.recalibrate_every and self.depth_source is not None \
+                and self.generation > 0 \
+                and self.generation % self.recalibrate_every == 0:
+            out["recalibration"] = self._recalibrate()
+
+        prev_trips, prev_heals = self.quarantine_trips, self.member_heals
+        res = train_pbt(
+            jax.random.PRNGKey(self.seed), self.env_params, self.cfg,
+            self.pcfg._replace(generations=1),
+            partitioner=self.partitioner, init_pop=self._pop,
+            start_generation=self.generation)
+        self._pop = res.state
+        row = res.history[0]
+        self.history.append(row)
+        self.generation += 1
+        self.last_generation_at = self.now_fn()
+        self.quarantine_trips += row["n_tripped"]
+        self.member_heals += row["n_healed"]
+        out["generation"] = row["generation"]
+        out["best_fitness"] = row["best_fitness"]
+        out["n_quarantined"] = row["n_quarantined"]
+
+        if self.checkpoint_every \
+                and self.generation % self.checkpoint_every == 0:
+            # adopt BEFORE the checkpoint so the verdict trail the
+            # snapshot carries includes this generation's winner
+            verdict = self._adopt(res)
+            if verdict is not None:
+                out["adoption"] = verdict
+            out["checkpoint_seq"] = self.checkpoint()
+        elif self.adopt_every \
+                and self.generation % self.adopt_every == 0:
+            verdict = self._adopt(res)
+            if verdict is not None:
+                out["adoption"] = verdict
+        self._export_gauges(row,
+                            trips=self.quarantine_trips - prev_trips,
+                            heals=self.member_heals - prev_heals)
+        return out
+
+    # -- observability -------------------------------------------------------
+    def _export_gauges(self, row: dict, trips: int = 0, heals: int = 0):
+        m = self.metrics
+        if m is None:
+            return
+        now = self.now_fn()
+        m.set_gauge("pbt_generation", float(self.generation))
+        m.set_gauge("pbt_generation_interval_seconds",
+                    float(max(self.interval_s, 1e-9)))
+        m.set_gauge("pbt_last_generation_timestamp", float(now))
+        m.set_gauge("pbt_quarantined_members", float(row["n_quarantined"]))
+        if np.isfinite(row["best_fitness"]):
+            m.set_gauge("pbt_best_fitness", float(row["best_fitness"]))
+            m.set_gauge("pbt_mean_fitness", float(row["mean_fitness"]))
+        if self.last_checkpoint_at is not None:
+            m.set_gauge("pbt_checkpoint_age_s",
+                        float(now - self.last_checkpoint_at))
+        m.inc("pbt_generations_total")
+        if trips:
+            m.inc("pbt_quarantine_trips_total", trips)
+        if heals:
+            m.inc("pbt_member_heals_total", heals)
+
+    def _stall_threshold(self) -> float:
+        if self.stall_after_s is not None:
+            return float(self.stall_after_s)
+        return max(3.0 * float(self.interval_s), 60.0)
+
+    def alert_state(self) -> dict:
+        """Inputs for the in-process rule engine (merged into
+        `TradingSystem._alert_state`): the `TrainingFleetStalled` /
+        `MemberQuarantined` predicates read exactly these keys."""
+        out = {"pbt_quarantined_members": int(
+            self.history[-1]["n_quarantined"]) if self.history else 0,
+            "pbt_stall_after_s": self._stall_threshold()}
+        if self.last_generation_at is not None:
+            out["pbt_generation_age_s"] = \
+                self.now_fn() - self.last_generation_at
+        return out
+
+    def status(self) -> dict:
+        """The /state.json ``training`` block (`cli status` renders it):
+        where the fleet is, who is quarantined, how stale the newest
+        checkpoint and calibration are."""
+        now = self.now_fn()
+        last = self.history[-1] if self.history else None
+        out = {
+            "generation": self.generation,
+            "population": int(self.pcfg.population),
+            "best_fitness": last["best_fitness"] if last else None,
+            "mean_fitness": last["mean_fitness"] if last else None,
+            "quarantined_members": last["n_quarantined"] if last else 0,
+            "quarantine_trips": self.quarantine_trips,
+            "member_heals": self.member_heals,
+            "recalibration_failures": self.recalibration_failures,
+            "last_recalibration": self.last_recalibration,
+            "resumed_at": self.resumed_at,
+            "adoptions": self.adoptions[-4:],
+        }
+        if self.last_generation_at is not None:
+            out["generation_age_s"] = round(now - self.last_generation_at, 3)
+        if self.last_checkpoint_at is not None:
+            out["checkpoint_age_s"] = round(now - self.last_checkpoint_at, 3)
+            out["checkpoint_generation"] = self.last_checkpoint_generation
+            out["checkpoint_path"] = self.checkpoint_path
+        return out
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None

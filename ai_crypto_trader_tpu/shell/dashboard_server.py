@@ -326,6 +326,14 @@ class DashboardServer:
             sc = scorecard.status()
             out["scorecard"] = {k: v for k, v in sc.items() if k != "groups"} \
                 | {"groups": {k: dict(v) for k, v in sc["groups"].items()}}
+        # continuous PBT training service (rl/trainer_service.py): where
+        # the fleet is, who is quarantined, checkpoint/recalibration age
+        # (`cli status --url` renders this block)
+        for svc in getattr(system, "extra_services", []) or []:
+            if getattr(svc, "name", "") == "trainer" \
+                    and hasattr(svc, "status"):
+                out["training"] = svc.status()
+                break
         return out
 
     def health(self) -> dict:

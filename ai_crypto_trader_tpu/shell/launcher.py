@@ -347,6 +347,26 @@ class TradingSystem:
         the newest intact snapshot before per-lane reconciliation."""
         self.tenant_engine = engine
 
+    def attach_trainer(self, service) -> None:
+        """Register a rl/trainer_service.PBTTrainerService under FULL
+        stage supervision: unlike plain ``extra_services`` entries (which
+        only get exception isolation), an attached trainer gets its own
+        StageBreaker — a crash-looping training loop backs off and
+        quarantines like a core stage (`TrainingFleetStalled` then fires
+        off its withheld generation timestamps) — and its
+        ``alert_state()`` feeds the in-process rule engine each tick."""
+        from ai_crypto_trader_tpu.utils.supervision import StageBreaker
+
+        if getattr(service, "metrics", None) is None:
+            service.metrics = self.metrics
+        name = getattr(service, "name", "trainer")
+        self.stage_breakers[name] = StageBreaker(
+            name, max_failures=self.stage_max_failures,
+            base_backoff_s=self.stage_backoff_s,
+            quarantine_s=self.stage_quarantine_s)
+        self.heartbeats.expect(name)
+        self.extra_services.append(service)
+
     def fleet_checkpoint(self) -> int | None:
         """Durably snapshot the attached tenant engine's lane mirror as
         one checksummed WAL record (bounded by the snapshot journal's
@@ -867,6 +887,12 @@ class TradingSystem:
         # calibration/accuracy and the max on-device feature PSI
         if self.scorecard is not None:
             state.update(self.scorecard.alert_state())
+        # cadence services that publish rule inputs (the PBT trainer's
+        # TrainingFleetStalled / MemberQuarantined predicates read these)
+        for svc in self.extra_services:
+            svc_state = getattr(svc, "alert_state", None)
+            if svc_state is not None:
+                state.update(svc_state())
         psi_values = [v for feats in self.monitor.last_drift.values()
                       for v in feats.values()]
         if psi_values:
@@ -896,6 +922,12 @@ class TradingSystem:
     async def _run_extra_services(self):
         for svc in self.extra_services:
             name = getattr(svc, "name", type(svc).__name__)
+            if name in self.stage_breakers:
+                # breaker-registered services (attach_trainer) get the
+                # full stage treatment: backoff, quarantine, crash-loop
+                # alerts, heartbeat — not just exception isolation
+                await self._run_stage(name, svc.run_once)
+                continue
             t0 = time.perf_counter()
             try:
                 await svc.run_once()

@@ -244,9 +244,36 @@ class DepthCapture:
         """The ring's current contents, oldest first."""
         return list(self.ring)
 
+    def calibration_window(self, symbol: str | None = None,
+                           min_records: int = 2) -> list[dict]:
+        """The snapshot records a `sim/calibrate.fit_flow_params` re-fit
+        consumes, newest-last — the rolling-recalibration feed
+        (rl/trainer_service.py).  Snapshot-kind only (diffs are size
+        CHANGES, not standing books); returns [] when the window is too
+        thin to fit, so the caller's last-good fallback triggers without
+        a partial-window fit ever running."""
+        books = [r for r in self.ring
+                 if r.get("kind") == "snapshot"
+                 and (symbol is None or r.get("symbol") == symbol)]
+        return books if len(books) >= max(int(min_records), 1) else []
+
     def close(self) -> None:
         if self._journal is not None:
             self._journal.close()
+
+
+def depth_records_from_journal(path: str) -> tuple[list[dict], dict]:
+    """Replay a DepthCapture JSONL journal back into normalized records.
+
+    Torn tails and CRC-corrupt lines are SKIPPED, not raised (the WAL
+    replay contract): the caller gets every intact depth record plus the
+    replay stats — a journal whose corruption emptied the window shows
+    ``corrupt_records > 0`` with an empty list, which the recalibration
+    service treats as a poisoned source and degrades to last-good."""
+    from ai_crypto_trader_tpu.utils.journal import replay
+
+    records, stats = replay(path)
+    return [r["data"] for r in records if r.get("kind") == "depth"], stats
 
 
 class _CandleBook:

@@ -42,6 +42,48 @@ import numpy as np
 from ai_crypto_trader_tpu.sim.lob import FlowParams, flow_params
 
 
+class CalibrationPoisoned(ValueError):
+    """A capture window that must NOT reach the fit: empty, NaN/Inf
+    prices or sizes, a non-positive spread, or a side with zero standing
+    depth.  The rolling-recalibration service catches this (and any
+    other fit failure) and degrades to its last-good FlowParams instead
+    of poisoning the training fleet's env."""
+
+
+def validate_depth_records(records, symbol: str | None = None,
+                           min_records: int = 2) -> None:
+    """Reject a poisoned calibration window BEFORE it reaches the fit.
+
+    Checks the snapshot records (the only kind the fit consumes) for the
+    failure shapes a live capture actually produces: an exhausted/empty
+    window, NaN-poisoned prices or sizes (a chaos fault or a corrupted
+    journal line that slipped through), a crossed or zero spread, and
+    zero-depth sides (a starved book fits a degenerate flow).  Raises
+    :class:`CalibrationPoisoned`; returns None on a clean window."""
+    books = [r for r in records
+             if r.get("kind") == "snapshot" and r.get("bids")
+             and r.get("asks")
+             and (symbol is None or r.get("symbol") == symbol)]
+    if len(books) < max(int(min_records), 1):
+        raise CalibrationPoisoned(
+            f"calibration window has {len(books)} usable snapshot "
+            f"records (need >= {min_records})")
+    for i, rec in enumerate(books):
+        bids = np.asarray(rec["bids"], np.float64)
+        asks = np.asarray(rec["asks"], np.float64)
+        if not (np.isfinite(bids).all() and np.isfinite(asks).all()):
+            raise CalibrationPoisoned(
+                f"snapshot {i} carries NaN/Inf levels (poisoned capture)")
+        if (bids[:, 1] <= 0).all() or (asks[:, 1] <= 0).all():
+            raise CalibrationPoisoned(
+                f"snapshot {i} has a zero-depth side (starved book)")
+        spread = float(asks[0, 0] - bids[0, 0])
+        if spread <= 0:
+            raise CalibrationPoisoned(
+                f"snapshot {i} spread {spread} <= 0 (crossed/degenerate "
+                f"book)")
+
+
 def frames_to_arrays(records, levels: int | None = None,
                      symbol: str | None = None) -> dict:
     """Stack captured depth records into dense arrays.

@@ -490,6 +490,72 @@ def poison_lane_params(engine, lane: int, field: str = "conf_threshold",
     engine._need_seed = True
 
 
+def poison_member_state(pop, member: int, field: str = "params",
+                        value: float = float("nan")):
+    """Inject NaN/Inf into ONE member's slice of a PBT fleet's training
+    state (the [P]-axis twin of :func:`poison_lane_state` — a diverged
+    optimizer, bit rot in a replay ring, a bad restore).  JAX arrays are
+    immutable, so unlike the tenant engine's in-place mirror surgery this
+    RETURNS a new PopState; every other member's leaves are bit-identical
+    (``x.at[m].set`` rewrites one row).  ``field`` names a DQNState field
+    (``params``, ``opt_state``, ``replay``, …); every float leaf under it
+    gets the poison.  The next generation's in-program finiteness scan
+    (rl/dqn.poisoned_members) trips that member's quarantine bit."""
+    import jax
+    import jax.numpy as jnp
+
+    def hit(x):
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            return x.at[member].set(jnp.asarray(value, x.dtype))
+        return x
+
+    members = pop.members._replace(
+        **{field: jax.tree.map(hit, getattr(pop.members, field))})
+    return pop._replace(members=members)
+
+
+def poison_member_hypers(pop, member: int, field: str = "learning_rate",
+                         value: float = float("nan")):
+    """Inject NaN/Inf into one member's hyperparameter row — the explore-
+    step poison path (a perturbation gone wrong, a corrupted checkpoint
+    hyper).  A NaN learning rate NaNs the member's params within one
+    learn step, so the same quarantine gate contains it."""
+    import jax.numpy as jnp
+
+    arr = getattr(pop.hypers, field)
+    hypers = pop.hypers._replace(
+        **{field: arr.at[member].set(jnp.asarray(value, arr.dtype))})
+    return pop._replace(hypers=hypers)
+
+
+def poisoned_depth_records(symbol: str = "BTCUSDC", n: int = 4,
+                           mode: str = "nan_spread") -> list:
+    """Depth-capture snapshot records carrying the calibration poisons
+    `sim/calibrate.validate_depth_records` must refuse: ``nan_spread``
+    (NaN price levels), ``zero_depth`` (a side with no standing size —
+    the degenerate book a venue serves mid-outage), ``crossed`` (best
+    ask ≤ best bid).  Shaped exactly like DepthCapture's normalized
+    records, so they feed a capture ring, a journal, or a recalibration
+    window directly."""
+    records = []
+    for i in range(n):
+        bids = [[100.0 - 0.5 * j, 2.0] for j in range(4)]
+        asks = [[100.5 + 0.5 * j, 2.0] for j in range(4)]
+        if mode == "nan_spread":
+            bids[0][0] = float("nan")
+        elif mode == "zero_depth":
+            asks = [[p, 0.0] for p, _ in asks]
+        elif mode == "crossed":
+            asks[0][0] = bids[0][0] - 0.25
+        else:
+            raise ValueError(f"unknown poison mode {mode!r}")
+        records.append({"symbol": symbol, "kind": "snapshot",
+                        "E": 1_700_000_000_000 + i * 1000,
+                        "U": i * 10, "u": i * 10 + 9,
+                        "bids": bids, "asks": asks})
+    return records
+
+
 def torn_tail(path: str, keep_bytes: int = 17) -> None:
     """Truncate the file's final line mid-record — the on-disk signature
     of a crash during ``write(2)`` that journal replay must tolerate."""

@@ -210,6 +210,18 @@ def default_rules() -> list[AlertRule]:
                   "(NaN/Inf in lane state or params) — masked out of "
                   "sizing/entry until the host healer re-seeds them "
                   "from venue truth"),
+        AlertRule("TrainingFleetStalled", "warning",
+                  lambda s: (s.get("pbt_generation_age_s", 0.0)
+                             > s.get("pbt_stall_after_s", float("inf"))),
+                  "the continuous PBT trainer has not completed a "
+                  "generation within its stall budget — crash-looping "
+                  "stage, hung dispatch, or a starved cadence"),
+        AlertRule("MemberQuarantined", "warning",
+                  lambda s: s.get("pbt_quarantined_members", 0) > 0,
+                  "training-fleet members quarantined by the in-program "
+                  "finiteness scan (NaN/Inf params, opt state or "
+                  "fitness) — masked out of ranking and selection until "
+                  "the forced-exploit heal clones a survivor over them"),
     ]
 
 

@@ -244,9 +244,15 @@ class SnapshotJournal:
     """
 
     def __init__(self, path: str, compact_every: int = 8,
-                 now_fn: Callable[[], float] = time.time):
+                 now_fn: Callable[[], float] = time.time,
+                 kind: str = FLEET_SNAPSHOT_KIND):
+        # ``kind`` names the snapshot stream: the tenant fleet writes
+        # `fleet_state`, the PBT trainer writes `pbt_lineage` — distinct
+        # kinds keep `load_snapshot(path, kind=...)` from resurrecting
+        # the wrong state family out of a misrouted path
         self.journal = WriteAheadJournal(path, now_fn=now_fn)
         self.compact_every = max(int(compact_every), 1)
+        self.kind = str(kind)
         self.writes = 0
 
     @property
@@ -257,7 +263,7 @@ class SnapshotJournal:
         """Durably record one snapshot (flushed + fsync'd before
         returning — a snapshot that might be torn is worthless) and
         compact when due.  Returns the record's sequence number."""
-        seq = self.journal.append(FLEET_SNAPSHOT_KIND, payload, flush=True)
+        seq = self.journal.append(self.kind, payload, flush=True)
         self.writes += 1
         if self.writes % self.compact_every == 0:
             self.journal.compact(payload)

@@ -193,7 +193,8 @@ def append_history(rows: list, path: str | None = None,
               "BENCH_LOB_SCENARIOS", "BENCH_LOB_STEPS", "BENCH_LOB_LEVELS",
               "BENCH_COLDSTART_TICKS",
               "BENCH_FLEET_TENANTS", "BENCH_FLEET_SYMBOLS",
-              "BENCH_FLEET_TICKS")
+              "BENCH_FLEET_TICKS",
+              "BENCH_PBT_RECOVERY_POP", "BENCH_PBT_RECOVERY_ITERS")
              if os.environ.get(k)}
     with open(path, "a", encoding="utf-8") as f:
         for row in rows:
@@ -1082,6 +1083,72 @@ def bench_fleet_recovery():
          snapshot_records=stats["replayed"],
          snapshot_dispatches=report["snapshot_dispatches"],
          first_dispatch_ms=round(first_ms, 3))
+
+
+def bench_pbt_recovery():
+    """Target row: training-fleet restart time — the newest checksummed
+    PBT checkpoint (every pack_array'd leaf of the vmapped PopState)
+    loaded from the lineage journal and restored into device arrays
+    (rl/trainer_service.py load_checkpoint + restore_checkpoint), at
+    BENCH_PBT_RECOVERY_POP members: the cost the continuous trainer pays
+    between process death and its first resumed generation dispatch."""
+    import tempfile
+
+    import jax
+
+    from ai_crypto_trader_tpu.rl import (
+        DQNConfig, PBTConfig, obs_size, pbt_env_params, train_pbt)
+    from ai_crypto_trader_tpu.rl.trainer_service import (
+        checkpoint_payload,
+        load_checkpoint,
+        restore_checkpoint,
+    )
+    from ai_crypto_trader_tpu.utils.journal import SnapshotJournal
+
+    P = int(os.environ.get("BENCH_PBT_RECOVERY_POP", "8"))
+    ITERS = int(os.environ.get("BENCH_PBT_RECOVERY_ITERS", "4"))
+    env, _ = pbt_env_params(jax.random.PRNGKey(7), num_scenarios=8,
+                            steps=512, episode_len=128, dynamics="lob")
+    cfg = DQNConfig(state_size=obs_size(env), num_envs=1, rollout_len=8,
+                    hidden=(16,), replay_capacity=128, batch_size=8,
+                    learn_steps_per_iter=1)
+    pcfg = PBTConfig(population=P, generations=1,
+                     iters_per_generation=ITERS, eval_steps=4)
+    # one real generation so the checkpoint carries trained state (and
+    # the generation program is compiled before the timed resume)
+    res = train_pbt(jax.random.PRNGKey(0), env, cfg, pcfg)
+
+    with tempfile.TemporaryDirectory() as td:
+        journal = SnapshotJournal(os.path.join(td, "pbt.journal"),
+                                  kind="pbt_lineage")
+        for _ in range(3):                  # realistic depth: stale
+            journal.write(checkpoint_payload(  # checkpoints behind the
+                res.state, generation=1,       # newest one
+                cfg=cfg, pcfg=pcfg, history=res.history))
+        journal.close()
+
+        t0 = time.perf_counter()
+        payload, stats = load_checkpoint(journal.path)
+        pop = restore_checkpoint(payload, cfg, pcfg, env)
+        jax.block_until_ready(jax.tree.leaves(pop))
+        ms = (time.perf_counter() - t0) * 1e3
+        # first resumed generation stamped separately: warm executables
+        # (the program cache is keyed on shapes, which the restore
+        # preserved), so this is dispatch + device work, not compile
+        t0 = time.perf_counter()
+        res2 = train_pbt(jax.random.PRNGKey(0), env, cfg, pcfg,
+                         init_pop=pop,
+                         start_generation=int(payload["generation"]))
+        first_ms = (time.perf_counter() - t0) * 1e3
+    assert res2.history[0]["generation"] == 1   # the counter resumed
+    bytes_ = sum(len(a["data"]) for a in payload["arrays"])
+    log(f"pbt recovery: {P} members ({len(payload['arrays'])} arrays, "
+        f"{bytes_ / 1e6:.1f} MB packed) restored from checkpoint in "
+        f"{ms:.1f} ms (+{first_ms:.1f} ms first resumed generation)")
+    emit("pbt_recovery_ms", ms, "ms", None, population=P,
+         arrays=len(payload["arrays"]),
+         snapshot_records=stats["replayed"],
+         first_generation_ms=round(first_ms, 3))
 
 
 def bench_nn():
@@ -2171,6 +2238,7 @@ def run_worker():
         ("nn", bench_nn),
         ("recovery", bench_recovery),
         ("fleet_recovery", bench_fleet_recovery),
+        ("pbt_recovery", bench_pbt_recovery),
     ]
     for name, fn in secondary:
         if not want(name):

@@ -113,18 +113,21 @@ class TestExchange:
 
     def _fresh(self, env):
         pop = pop_init(KEY, env, CFG, self.PCFG)
-        # exchange donates its inputs — hand it copies, keep the original
-        return jax.tree.map(jnp.array, pop.members), \
-            jax.tree.map(jnp.array, pop.hypers)
+        # exchange donates members/hypers — hand it copies, keep the original
+        return (jax.tree.map(jnp.array, pop.members),
+                jax.tree.map(jnp.array, pop.hypers),
+                jnp.array(pop.quarantined), jnp.array(pop.cooldown))
 
     def test_deterministic_under_fixed_key(self, env):
         ex = _exchange_program(CFG, _program_pcfg(self.PCFG))
         fitness = jnp.arange(8.0)
         k = jax.random.PRNGKey(3)
-        m1, h1, lin1 = ex(*self._fresh(env), fitness, k)
-        m2, h2, lin2 = ex(*self._fresh(env), fitness, k)
+        m1, h1, q1, c1, lin1 = ex(*self._fresh(env), fitness, k)
+        m2, h2, q2, c2, lin2 = ex(*self._fresh(env), fitness, k)
         assert _leaves_equal(m1, m2)
         assert _leaves_equal(h1, h2)
+        np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
         np.testing.assert_array_equal(np.asarray(lin1), np.asarray(lin2))
 
     def test_exploit_explore_semantics(self, env):
@@ -133,10 +136,12 @@ class TestExchange:
         and in-box perturbed hypers; survivors pass through bitwise."""
         pop = pop_init(KEY, env, CFG, self.PCFG)
         ex = _exchange_program(CFG, _program_pcfg(self.PCFG))
-        members, hypers, lineage = ex(
+        members, hypers, quarantined, _cooldown, lineage = ex(
             jax.tree.map(jnp.array, pop.members),
             jax.tree.map(jnp.array, pop.hypers),
+            jnp.array(pop.quarantined), jnp.array(pop.cooldown),
             jnp.arange(8.0), jax.random.PRNGKey(3))
+        assert not np.asarray(quarantined).any()   # a healthy fleet stays so
         lineage = np.asarray(lineage)
         pcfg = self.PCFG
 

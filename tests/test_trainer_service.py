@@ -1,0 +1,770 @@
+"""Continuous PBT training service (ISSUE 20): crash-safe generation
+checkpoints, in-program member quarantine, last-good rolling
+recalibration.
+
+Tier-1 on the tiny LOB scenario env (4 scenarios x 64 steps, 8-member
+fleets, 8-unit nets) so the whole file compiles in seconds:
+
+  * alert vocabulary: `TrainingFleetStalled` / `MemberQuarantined` exist
+    with coherent predicates in BOTH rule engines (utils/alerts.py and
+    monitoring/alert_rules.yml);
+  * the checkpoint codec: a `checkpoint_payload` JSON round trip
+    restores the FULL vmapped fleet BIT-exactly; population drift,
+    config drift, format drift, leaf-shape drift and per-array bit rot
+    all refuse loudly; torn tails fall back to the previous intact
+    record; compaction keeps a 50-generation journal O(one snapshot);
+  * THE resume-parity pin: a service killed after a torn checkpoint
+    append resumes from the newest intact record and produces
+    BIT-identical fitness history, lineage and final state to an
+    uninterrupted same-seed run — and a service ticking one generation
+    at a time is bit-interchangeable with one `train_pbt` call;
+  * containment: a poisoned mid-pack member trips the in-program
+    quarantine while every healthy member stays BIT-identical to a
+    clean twin fleet (P=8 tier-1, P=64 in the slow tier); the heal IS
+    PBT's own forced-exploit clone (pinned against a plain exploit of
+    the same survivor under the same key); trip/heal never recompiles
+    (the meshprof sentinel stays green);
+  * the service rim: cadence gating, rolling recalibration with
+    last-good fallback on poisoned capture windows (a recalibration is
+    a TRANSFER, never a recompile), gauges/alert inputs/status block,
+    launcher supervision via `attach_trainer` (StageBreaker + heartbeat
+    + crash-loop isolation), `cli rl --resume` provenance;
+  * the chaos soak (tier-1 smoke; `-m slow` long run): kills + a
+    poisoned member + a poisoned recalibration window in one lifetime,
+    ending healthy with a winner through the adoption gate, the verdict
+    journaled, blast radius == the faulted member, zero steady-state
+    recompiles.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ai_crypto_trader_tpu.rl import (
+    DQNConfig,
+    PBTConfig,
+    obs_size,
+    pbt_env_params,
+)
+from ai_crypto_trader_tpu.rl.population import (
+    _exchange_program,
+    _program_pcfg,
+    pop_init,
+    train_pbt,
+)
+from ai_crypto_trader_tpu.rl.trainer_service import (
+    PBT_CHECKPOINT_KIND,
+    PBTTrainerService,
+    checkpoint_payload,
+    load_checkpoint,
+    restore_checkpoint,
+)
+from ai_crypto_trader_tpu.testing import chaos
+from ai_crypto_trader_tpu.utils import meshprof
+from ai_crypto_trader_tpu.utils.journal import SnapshotJournal, replay
+from ai_crypto_trader_tpu.utils.metrics import MetricsRegistry
+
+KEY = jax.random.PRNGKey(0)
+
+# tiny everywhere: the contracts are structural, not statistical
+PCFG = PBTConfig(population=8, generations=3, iters_per_generation=2,
+                 eval_steps=4)
+
+
+@pytest.fixture(scope="module")
+def env():
+    params, _labels = pbt_env_params(jax.random.PRNGKey(7), num_scenarios=4,
+                                     steps=64, episode_len=32,
+                                     dynamics="lob")
+    return params
+
+
+@pytest.fixture(scope="module")
+def cfg(env):
+    return DQNConfig(state_size=obs_size(env), num_envs=2, rollout_len=2,
+                     hidden=(8,), replay_capacity=64, batch_size=8,
+                     learn_steps_per_iter=1, target_sync_every=3)
+
+
+def _leaves_equal(tree_a, tree_b):
+    la, lb = jax.tree.leaves(tree_a), jax.tree.leaves(tree_b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(la, lb))
+
+
+def _good_records(n=6, seed=0):
+    """A healthy synthetic capture window `fit_flow_params` accepts."""
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        bids = [[100.0 - 0.5 * j, 2.0 + rng.uniform(0, 0.5)]
+                for j in range(4)]
+        asks = [[100.5 + 0.5 * j, 2.0 + rng.uniform(0, 0.5)]
+                for j in range(4)]
+        recs.append({"symbol": "BTCUSDC", "kind": "snapshot",
+                     "E": 1_700_000_000_000 + i * 1000,
+                     "U": i * 10, "u": i * 10 + 9,
+                     "bids": bids, "asks": asks})
+    return recs
+
+
+def _mid_member(env, cfg, generations=1):
+    """A mid-pack member index by CLEAN gen-0 fitness — poisoning it
+    keeps both exchange brackets unchanged among healthy members, the
+    premise of the bit-identity containment pin.  The rank must come
+    from a STABLE sort (what `quantile_split`'s jnp.argsort uses): with
+    fitness ties spanning a bracket boundary, an unstable sort can call
+    a top-bracket donor "mid-pack"."""
+    clean = train_pbt(KEY, env, cfg, PCFG._replace(generations=generations))
+    order = np.argsort(np.array(clean.history[0]["fitness"]), kind="stable")
+    return clean, int(order[len(order) // 2])
+
+
+def _service(env, cfg, **kw):
+    kw.setdefault("now_fn", lambda: 1000.0)
+    return PBTTrainerService(cfg=cfg, pcfg=PCFG._replace(generations=1),
+                             env_params=env, seed=0, **kw)
+
+
+def _tick(svc):
+    return asyncio.run(svc.run_once())
+
+
+@pytest.fixture(autouse=True)
+def no_persistent_compile_cache():
+    """This module runs with the persistent compile cache OFF.  Its
+    fleet programs produce the suite's biggest cache entries, and it
+    sits at the end of the alphabetical run order — the tests most
+    likely to be straddling a write when a timeout kills the run, and a
+    torn entry segfaults jax on read-back (the hazard conftest
+    documents).  Nothing here needs the on-disk cache: every pin is
+    bit-parity or a recompile count, and the big programs compile once
+    per run then hit the in-memory jit cache across tests."""
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+# --------------------------------------------------------------------------
+# alert vocabulary: both rule engines, coherent predicates
+# --------------------------------------------------------------------------
+
+class TestTrainerVocabulary:
+    def test_alert_rules_exist_in_both_engines(self):
+        from ai_crypto_trader_tpu.utils.alerts import default_rules
+
+        rules = {r.name: r for r in default_rules()}
+
+        quarantined = rules["MemberQuarantined"]
+        assert quarantined.severity == "warning"
+        assert quarantined.predicate({"pbt_quarantined_members": 1})
+        assert not quarantined.predicate({"pbt_quarantined_members": 0})
+        assert not quarantined.predicate({})
+
+        stalled = rules["TrainingFleetStalled"]
+        assert stalled.severity == "warning"
+        assert stalled.predicate({"pbt_generation_age_s": 301.0,
+                                  "pbt_stall_after_s": 300.0})
+        assert not stalled.predicate({"pbt_generation_age_s": 299.0,
+                                      "pbt_stall_after_s": 300.0})
+        # no trainer attached -> no stall threshold -> never fires
+        assert not stalled.predicate({"pbt_generation_age_s": 1e9})
+        assert not stalled.predicate({})
+
+        with open(os.path.join(os.path.dirname(__file__), "..",
+                               "monitoring", "alert_rules.yml"),
+                  encoding="utf-8") as f:
+            yml = f.read()
+        assert "TrainingFleetStalled" in yml
+        assert "MemberQuarantined" in yml
+        assert "crypto_trader_tpu_pbt_quarantined_members > 0" in yml
+        assert "crypto_trader_tpu_pbt_last_generation_timestamp" in yml
+        assert "crypto_trader_tpu_pbt_generation_interval_seconds" in yml
+
+
+# --------------------------------------------------------------------------
+# the checkpoint codec: bit-exact restore, loud refusal on every drift axis
+# --------------------------------------------------------------------------
+
+class TestCheckpointCodec:
+    @pytest.fixture(scope="class")
+    def pop(self, env, cfg):
+        return pop_init(KEY, env, cfg, PCFG)
+
+    def _payload(self, pop, cfg, generation=3):
+        return checkpoint_payload(pop, generation=generation, cfg=cfg,
+                                  pcfg=PCFG, seed=0,
+                                  history=[{"generation": 0}])
+
+    def test_json_roundtrip_restores_bit_exact(self, env, cfg, pop):
+        payload = json.loads(json.dumps(self._payload(pop, cfg)))
+        restored = restore_checkpoint(payload, cfg, PCFG, env)
+        assert _leaves_equal(restored, pop)
+        # the quarantine bits and cooldowns ride the same snapshot
+        assert restored.quarantined.dtype == jnp.bool_
+        assert payload["generation"] == 3
+
+    def test_population_drift_rejected(self, env, cfg, pop):
+        payload = self._payload(pop, cfg)
+        with pytest.raises(ValueError, match="refusing to load a drifted"):
+            restore_checkpoint(payload, cfg, PCFG._replace(population=4),
+                               env)
+
+    def test_cfg_drift_rejected_naming_the_keys(self, env, cfg, pop):
+        payload = self._payload(pop, cfg)
+        with pytest.raises(ValueError, match="training-config drift.*hidden"):
+            restore_checkpoint(payload, cfg._replace(hidden=(16,)), PCFG,
+                               env)
+
+    def test_format_drift_rejected(self, env, cfg, pop):
+        payload = dict(self._payload(pop, cfg), format=99)
+        with pytest.raises(ValueError, match="refusing to guess a layout"):
+            restore_checkpoint(payload, cfg, PCFG, env)
+
+    def test_array_bit_rot_raises(self, env, cfg, pop):
+        payload = self._payload(pop, cfg)
+        rec = dict(payload["arrays"][0])
+        data = rec["data"]
+        rec["data"] = ("B" if data[0] != "B" else "C") + data[1:]
+        payload["arrays"] = [rec] + payload["arrays"][1:]
+        with pytest.raises(ValueError, match="crc mismatch"):
+            restore_checkpoint(payload, cfg, PCFG, env)
+
+    def test_torn_tail_falls_back_to_previous_intact(self, env, cfg, pop,
+                                                     tmp_path):
+        path = str(tmp_path / "pbt.journal")
+        journal = SnapshotJournal(path, kind=PBT_CHECKPOINT_KIND)
+        journal.write(self._payload(pop, cfg, generation=1))
+        journal.write(self._payload(pop, cfg, generation=2))
+        journal.close()
+        chaos.torn_tail(path, keep_bytes=41)
+        payload, stats = load_checkpoint(path)
+        assert stats["torn_tail"] is True
+        assert payload is not None and payload["generation"] == 1
+        assert _leaves_equal(restore_checkpoint(payload, cfg, PCFG, env),
+                             pop)
+
+    def test_compaction_bounds_journal_over_50_generations(self, cfg, pop,
+                                                           tmp_path):
+        path = str(tmp_path / "pbt.journal")
+        journal = SnapshotJournal(path, compact_every=5,
+                                  kind=PBT_CHECKPOINT_KIND)
+        base = self._payload(pop, cfg)
+        for g in range(50):
+            journal.write(dict(base, generation=g + 1))
+        journal.close()
+        records, stats = replay(path)
+        # O(one snapshot), never O(uptime): the file holds at most one
+        # compacted record + compact_every live appends
+        assert stats["replayed"] <= 6
+        payload, _stats = load_checkpoint(path)
+        assert payload["generation"] == 50
+
+
+# --------------------------------------------------------------------------
+# resume parity: the headline robustness pin
+# --------------------------------------------------------------------------
+
+class TestResumeParity:
+    def test_service_ticks_bit_equal_one_shot_run(self, env, cfg):
+        """A service running one generation per tick IS `train_pbt` —
+        the absolute generation counter keeps the exchange key stream
+        identical, so state, fitness history and lineage match bitwise."""
+        svc = _service(env, cfg)
+        rows = [_tick(svc) for _ in range(3)]
+        assert [r["generation"] for r in rows] == [0, 1, 2]
+
+        straight = train_pbt(KEY, env, cfg, PCFG._replace(generations=3))
+        assert _leaves_equal(svc._pop, straight.state)
+        for got, want in zip(svc.history, straight.history):
+            assert got["fitness"] == want["fitness"]
+            assert got["lineage"] == want["lineage"]
+            assert got["hypers"] == want["hypers"]
+
+    def test_kill_after_torn_append_resumes_bit_identical(self, env, cfg,
+                                                          tmp_path):
+        """Kill the service so its LAST checkpoint append is torn: the
+        restart falls back to the previous intact record, re-trains the
+        lost generation on the SAME absolute key, and the merged run is
+        BIT-identical to one that never died."""
+        path = str(tmp_path / "pbt.journal")
+        a = _service(env, cfg, checkpoint_path=path, checkpoint_every=1)
+        _tick(a)
+        _tick(a)
+        assert a.generation == 2
+        a.close()
+        chaos.torn_tail(path, keep_bytes=37)    # the gen-2 append dies
+
+        b = _service(env, cfg, checkpoint_path=path, checkpoint_every=1)
+        out = _tick(b)                          # re-trains generation 1
+        assert out["bootstrap"] == {"resumed": True, "generation": 1}
+        assert b.resumed_at == 1
+        _tick(b)                                # generation 2
+        assert b.generation == 3
+
+        straight = train_pbt(KEY, env, cfg, PCFG._replace(generations=3))
+        assert _leaves_equal(b._pop, straight.state)
+        assert len(b.history) == 3
+        for got, want in zip(b.history, straight.history):
+            assert got["fitness"] == want["fitness"]
+            assert got["lineage"] == want["lineage"]
+        b.close()
+
+    def test_cli_resume_provenance(self, env, cfg, tmp_path, capsys):
+        from ai_crypto_trader_tpu import cli
+
+        path = str(tmp_path / "cli.journal")
+        args = ["rl", "--population", "8", "--generations", "1",
+                "--iters", "1", "--envs", "2", "--rollout", "2",
+                "--scenarios", "2", "--steps", "64", "--episode-len", "32"]
+        cli.main(args + ["--checkpoint", path])
+        capsys.readouterr()
+        cli.main(args + ["--checkpoint", path, "--resume", path])
+        out = capsys.readouterr().out
+        assert f"resumed@gen=1 from {path}" in out
+        # the gen table carries provenance: replayed vs live rows
+        assert " ckpt " in out or "ckpt" in out
+        assert "live" in out
+
+    def test_cli_resume_refuses_missing_checkpoint(self, tmp_path):
+        from ai_crypto_trader_tpu import cli
+
+        with pytest.raises(SystemExit, match="no intact checkpoint"):
+            cli.main(["rl", "--population", "8", "--generations", "1",
+                      "--iters", "1", "--envs", "2", "--rollout", "2",
+                      "--scenarios", "2", "--steps", "64",
+                      "--episode-len", "32",
+                      "--resume", str(tmp_path / "absent.journal")])
+
+
+# --------------------------------------------------------------------------
+# containment: blast radius == the poisoned member, heal == forced exploit
+# --------------------------------------------------------------------------
+
+class TestContainment:
+    def _poisoned_run(self, env, cfg, mid, generations=1):
+        pop = pop_init(KEY, env, cfg, PCFG)
+        pop = chaos.poison_member_state(pop, mid, field="params")
+        return train_pbt(KEY, env, cfg,
+                         PCFG._replace(generations=generations),
+                         init_pop=pop)
+
+    def test_healthy_members_bit_identical_p8(self, env, cfg):
+        clean, mid = _mid_member(env, cfg)
+        res = self._poisoned_run(env, cfg, mid)
+        row = res.history[0]
+        assert row["n_tripped"] == 1
+        assert row["quarantined"][mid] is True
+        mask = np.arange(PCFG.population) != mid
+        f_clean = np.array(clean.history[0]["fitness"])
+        f_pois = np.array(row["fitness"])
+        np.testing.assert_array_equal(f_clean[mask], f_pois[mask])
+        # a frozen mid-pack slot leaves both exchange brackets unchanged:
+        # every healthy member's POST-exchange state is bit-identical
+        for i in np.where(mask)[0]:
+            assert _leaves_equal(
+                jax.tree.map(lambda x, i=i: x[i], res.state.members),
+                jax.tree.map(lambda x, i=i: x[i], clean.state.members))
+            assert _leaves_equal(
+                jax.tree.map(lambda x, i=i: x[i], res.state.hypers),
+                jax.tree.map(lambda x, i=i: x[i], clean.state.hypers))
+        # fleet-level stats rank over HEALTHY members only — the NaN
+        # fitness never poisons best/mean
+        assert row["best_fitness"] == clean.history[0]["best_fitness"]
+        assert np.isfinite(row["mean_fitness"])
+
+    @pytest.mark.slow
+    def test_healthy_members_bit_identical_p64(self, env, cfg):
+        pcfg = PCFG._replace(population=64, generations=1)
+        clean = train_pbt(KEY, env, cfg, pcfg)
+        # stable rank, matching quantile_split — see _mid_member
+        order = np.argsort(np.array(clean.history[0]["fitness"]),
+                           kind="stable")
+        mid = int(order[32])
+        pop = chaos.poison_member_state(pop_init(KEY, env, cfg, pcfg), mid,
+                                        field="params")
+        res = train_pbt(KEY, env, cfg, pcfg, init_pop=pop)
+        assert res.history[0]["n_tripped"] == 1
+        mask = np.arange(64) != mid
+        np.testing.assert_array_equal(
+            np.array(clean.history[0]["fitness"])[mask],
+            np.array(res.history[0]["fitness"])[mask])
+        for leaf_c, leaf_p in zip(jax.tree.leaves(clean.state.members),
+                                  jax.tree.leaves(res.state.members)):
+            np.testing.assert_array_equal(np.asarray(leaf_c)[mask],
+                                          np.asarray(leaf_p)[mask])
+
+    def test_trip_then_heal_lifecycle(self, env, cfg):
+        _clean, mid = _mid_member(env, cfg)
+        res = self._poisoned_run(env, cfg, mid, generations=3)
+        rows = res.history
+        # cooldown=1: trip at gen 0 (frozen exchange), heal at gen 1's
+        # exchange — the forced-exploit clone clears the sticky bit
+        assert [r["n_tripped"] for r in rows] == [1, 0, 0]
+        assert rows[0]["n_quarantined"] == 1
+        assert rows[1]["n_healed"] == 1
+        assert rows[-1]["n_quarantined"] == 0
+        assert np.isfinite(np.array(rows[-1]["fitness"])).all()
+
+    def test_hyper_poison_trips_same_gate(self, env, cfg):
+        pop = chaos.poison_member_hypers(pop_init(KEY, env, cfg, PCFG), 3)
+        res = train_pbt(KEY, env, cfg, PCFG._replace(generations=1),
+                        init_pop=pop)
+        assert res.history[0]["n_tripped"] == 1
+        assert res.history[0]["quarantined"][3] is True
+
+    def test_heal_is_a_forced_exploit_clone(self, env, cfg):
+        """The heal IS PBT's own repair path: an exchange healing slot m
+        is BIT-identical to a plain exchange where m simply ranked -inf
+        into the exploit bracket — same donor, same fold_in key fork,
+        same perturbed hypers."""
+        ex = _exchange_program(cfg, _program_pcfg(PCFG))
+        pop = pop_init(KEY, env, cfg, PCFG)
+        fitness = jnp.arange(8.0)
+        key = jax.random.PRNGKey(3)
+        m = 4                                   # mid-pack: in no bracket
+
+        def fresh():
+            return (jax.tree.map(jnp.array, pop.members),
+                    jax.tree.map(jnp.array, pop.hypers))
+
+        zeros_b = jnp.zeros((8,), jnp.bool_)
+        zeros_i = jnp.zeros((8,), jnp.int32)
+        mem_a, hy_a, q_a, _cd, lin_a = ex(
+            *fresh(), zeros_b.at[m].set(True), zeros_i, fitness, key)
+        mem_b, hy_b, _qb, _cdb, lin_b = ex(
+            *fresh(), zeros_b, zeros_i, fitness.at[m].set(-jnp.inf), key)
+
+        np.testing.assert_array_equal(np.asarray(lin_a), np.asarray(lin_b))
+        assert int(lin_a[m]) != m               # healed == cloned
+        assert not bool(q_a[m])                 # sticky bit cleared
+        assert _leaves_equal(mem_a, mem_b)
+        assert _leaves_equal(hy_a, hy_b)
+        donor = int(lin_a[m])
+        assert _leaves_equal(
+            jax.tree.map(lambda x: x[m], mem_a.params),
+            jax.tree.map(lambda x: x[donor], pop.members.params))
+        # …with the donor's stream forked, never shared
+        assert not np.array_equal(np.asarray(mem_a.key[m]),
+                                  np.asarray(pop.members.key[donor]))
+
+    def test_frozen_member_invisible_to_healthy_exchange(self, env, cfg):
+        """While the cooldown runs, the quarantined slot is neither donor
+        nor clone — healthy members see exactly the exchange they would
+        have seen had the slot been mid-pack."""
+        ex = _exchange_program(cfg, _program_pcfg(PCFG))
+        pop = pop_init(KEY, env, cfg, PCFG)
+        fitness = jnp.arange(8.0)
+        key = jax.random.PRNGKey(3)
+        m = 4
+
+        def fresh():
+            return (jax.tree.map(jnp.array, pop.members),
+                    jax.tree.map(jnp.array, pop.hypers))
+
+        zeros_b = jnp.zeros((8,), jnp.bool_)
+        zeros_i = jnp.zeros((8,), jnp.int32)
+        mem_f, hy_f, q_f, cd_f, lin_f = ex(
+            *fresh(), zeros_b.at[m].set(True), zeros_i.at[m].set(1),
+            fitness, key)
+        mem_c, hy_c, _q, _cd, lin_c = ex(
+            *fresh(), zeros_b, zeros_i, fitness, key)
+
+        np.testing.assert_array_equal(np.asarray(lin_f), np.asarray(lin_c))
+        assert int(lin_f[m]) == m               # frozen: passes through
+        assert bool(q_f[m]) and int(cd_f[m]) == 0   # cooldown ticked down
+        mask = np.arange(8) != m
+        for a, b in zip(jax.tree.leaves(mem_f), jax.tree.leaves(mem_c)):
+            np.testing.assert_array_equal(np.asarray(a)[mask],
+                                          np.asarray(b)[mask])
+        for a, b in zip(jax.tree.leaves(hy_f), jax.tree.leaves(hy_c)):
+            np.testing.assert_array_equal(np.asarray(a)[mask],
+                                          np.asarray(b)[mask])
+
+    def test_trip_and_heal_never_recompile(self, env, cfg):
+        """The meshprof sentinel watches the same `pbt_generation` window
+        the SteadyStateRecompile alert pages on: a clean run, then a
+        poisoned run with a trip AND a heal, share every executable."""
+        pcfg = PCFG._replace(generations=2)
+        train_pbt(KEY, env, cfg, pcfg)          # warm the program caches
+        mp = meshprof.MeshProf()
+        with meshprof.use(mp):
+            pop = chaos.poison_member_state(pop_init(KEY, env, cfg, PCFG),
+                                            2, field="params")
+            res = train_pbt(KEY, env, cfg, pcfg, init_pop=pop)
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+        assert res.history[0]["n_tripped"] == 1
+        assert res.history[1]["n_healed"] == 1
+
+
+# --------------------------------------------------------------------------
+# the service rim: cadence, recalibration, observability, supervision
+# --------------------------------------------------------------------------
+
+class TestTrainerService:
+    def test_cadence_and_max_generations(self, env, cfg):
+        clock = {"t": 1000.0}
+        svc = _service(env, cfg, interval_s=60.0, max_generations=2,
+                       now_fn=lambda: clock["t"])
+        assert _tick(svc)["ran"] is True
+        assert _tick(svc)["ran"] is False       # interval gate holds
+        clock["t"] += 60.0
+        assert _tick(svc)["ran"] is True
+        clock["t"] += 60.0
+        out = _tick(svc)
+        assert out == {"ran": False, "reason": "complete"}
+        assert svc.generation == 2
+
+    def test_recalibration_good_then_poisoned_keeps_last_good(self, env,
+                                                              cfg):
+        feed = {"recs": _good_records()}
+        m = MetricsRegistry()
+        svc = _service(env, cfg, depth_source=lambda: feed["recs"],
+                       recalibrate_every=2, metrics=m)
+        for _ in range(3):                      # recalibrates at gen 2
+            _tick(svc)
+        assert svc.last_recalibration["ok"] is True
+        assert svc.recalibration_failures == 0
+        good_flow, good_env = svc.flow, svc.env_params
+        assert good_flow is not None
+
+        feed["recs"] = chaos.poisoned_depth_records(mode="nan_spread")
+        _tick(svc)                              # gen 3: no recalibration
+        out = _tick(svc)                        # gen 4: poisoned window
+        r = out["recalibration"]
+        assert r["ok"] is False
+        assert "CalibrationPoisoned" in r["reason"]
+        assert svc.recalibration_failures == 1
+        # last-good fallback: the fleet keeps training on the good fit
+        assert svc.flow is good_flow
+        assert svc.env_params is good_env
+        failures = [v for k, v in m.counters.items()
+                    if "pbt_recalibration_failures_total" in str(k)]
+        assert sum(failures) == 1.0
+
+    def test_every_poison_mode_is_refused(self):
+        from ai_crypto_trader_tpu.sim.calibrate import (
+            CalibrationPoisoned,
+            validate_depth_records,
+        )
+
+        for mode in ("nan_spread", "zero_depth", "crossed"):
+            with pytest.raises(CalibrationPoisoned):
+                validate_depth_records(
+                    chaos.poisoned_depth_records(mode=mode))
+
+    def test_recalibration_swap_is_a_transfer_never_a_recompile(self, env,
+                                                                cfg):
+        """EnvParams are array content: after a successful re-fit the
+        next generation reuses every executable (the meshprof sentinel
+        would flag a shape-changing swap as a steady-state recompile)."""
+        feed = {"recs": _good_records()}
+        svc = _service(env, cfg, depth_source=lambda: feed["recs"],
+                       recalibrate_every=2)
+        _tick(svc)
+        _tick(svc)
+        mp = meshprof.MeshProf()
+        with meshprof.use(mp):
+            out = _tick(svc)                    # gen 2: recalibrate + train
+            assert out["recalibration"]["ok"] is True
+            assert svc.env_params.close.shape == env.close.shape
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+
+    def test_gauges_alert_inputs_and_status(self, env, cfg):
+        clock = {"t": 1000.0}
+        m = MetricsRegistry()
+        svc = _service(env, cfg, metrics=m, interval_s=30.0,
+                       now_fn=lambda: clock["t"], checkpoint_every=1)
+        # a poisoned member at init: the first tick trips quarantine and
+        # the MemberQuarantined rule fires off the service's own inputs
+        svc.env_params = env
+        svc._pop = chaos.poison_member_state(
+            pop_init(KEY, env, cfg, PCFG), 5, field="params")
+        _tick(svc)
+        gauges = {str(k): v for k, v in m.gauges.items()}
+        assert any("pbt_generation" in k for k in gauges)
+        assert any("pbt_quarantined_members" in k for k in gauges)
+        assert any("pbt_last_generation_timestamp" in k for k in gauges)
+
+        from ai_crypto_trader_tpu.utils.alerts import default_rules
+
+        rules = {r.name: r for r in default_rules()}
+        state = svc.alert_state()
+        assert state["pbt_quarantined_members"] == 1
+        assert rules["MemberQuarantined"].predicate(state)
+        assert not rules["TrainingFleetStalled"].predicate(state)
+        clock["t"] += svc._stall_threshold() + 1.0
+        assert rules["TrainingFleetStalled"].predicate(svc.alert_state())
+
+        status = svc.status()
+        assert status["generation"] == 1
+        assert status["population"] == 8
+        assert status["quarantined_members"] == 1
+        assert status["quarantine_trips"] == 1
+
+    def test_attach_trainer_runs_under_stage_supervision(self, env, cfg):
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_shell import _series
+
+        from ai_crypto_trader_tpu.shell.dashboard_server import (
+            DashboardServer,
+        )
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        ex = FakeExchange({"BTCUSDC": _series()})
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: 0.0)
+        svc = _service(env, cfg, max_generations=1)
+        system.attach_trainer(svc)
+        assert "trainer" in system.stage_breakers
+        assert svc.metrics is system.metrics    # gauges land in /metrics
+        asyncio.run(system._run_extra_services())
+        assert svc.generation == 1
+        # success beat the stage breaker, not the plain-isolation path
+        assert system.stage_breakers["trainer"].failures == 0
+        state = system._alert_state()
+        assert state["pbt_quarantined_members"] == 0
+        assert "pbt_stall_after_s" in state
+        # the dashboard's /state.json carries the training block
+        block = DashboardServer(system, port=0).state()["training"]
+        assert block["generation"] == 1
+        assert block["population"] == 8
+
+    def test_crash_looping_trainer_is_quarantined_not_fatal(self, env,
+                                                            cfg):
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_shell import _series
+
+        from ai_crypto_trader_tpu.shell.exchange import FakeExchange
+        from ai_crypto_trader_tpu.shell.launcher import TradingSystem
+
+        ex = FakeExchange({"BTCUSDC": _series()})
+        clock = {"t": 0.0}
+        system = TradingSystem(ex, ["BTCUSDC"], now_fn=lambda: clock["t"])
+
+        class Exploder:
+            name = "trainer"
+
+            async def run_once(self):
+                raise RuntimeError("boom")
+
+        system.attach_trainer(Exploder())
+        br = system.stage_breakers["trainer"]
+        for _ in range(system.stage_max_failures + 1):
+            asyncio.run(system._run_extra_services())
+            clock["t"] += 1e6                   # clear the backoff window
+        assert br.failures >= system.stage_max_failures
+        assert br.quarantined is True           # crash loop contained
+
+
+# --------------------------------------------------------------------------
+# the chaos soak: kills + poison + bad capture window in one lifetime
+# --------------------------------------------------------------------------
+
+def _run_soak(env, cfg, tmp_path, kills):
+    """Shared soak driver: a checkpointing/recalibrating/adopting service
+    lifetime with `kills` process deaths (the last one torn mid-append),
+    one poisoned member and one poisoned recalibration window.  Returns
+    the final service and its journal path."""
+    from ai_crypto_trader_tpu.obs.scorecard import Scorecard
+    from ai_crypto_trader_tpu.strategy.registry import ModelRegistry
+
+    path = str(tmp_path / "soak.journal")
+    feed = {"recs": _good_records()}
+    registry = ModelRegistry(path=str(tmp_path / "registry.json"))
+    scorecard = Scorecard()
+    metrics = MetricsRegistry()
+
+    def spawn():
+        return _service(env, cfg, checkpoint_path=path, checkpoint_every=1,
+                        depth_source=lambda: feed["recs"],
+                        recalibrate_every=2, registry=registry,
+                        scorecard=scorecard, metrics=metrics)
+
+    svc = spawn()
+    _tick(svc)                      # gen 0 (winner adopted) -> ckpt@1
+    # poison one member mid-lifetime: gen 1 trips (the sticky bit rides
+    # ckpt@2, so even a torn-tail resume replays the quarantine)
+    svc._pop = chaos.poison_member_state(svc._pop, 5, field="params")
+    _tick(svc)                      # gen 1: trip, frozen   -> ckpt@2
+    _tick(svc)                      # gen 2: good recal + heal -> ckpt@3
+    for k in range(kills):
+        svc.close()                 # process death…
+        if k == kills - 1:
+            # …this one mid-append: tear the newest checkpoint record
+            chaos.torn_tail(path, keep_bytes=43)
+        svc = spawn()
+        if k < kills - 1:
+            _tick(svc)              # a generation between kills
+    # a poisoned capture window in the resumed lifetime: tick until a
+    # recalibration generation refuses it (recalibrate_every=2 -> <=2)
+    feed["recs"] = chaos.poisoned_depth_records(mode="zero_depth")
+    for _ in range(3):
+        _tick(svc)
+        if svc.recalibration_failures:
+            break
+    feed["recs"] = _good_records(seed=1)
+    while svc.generation % 2:       # land on the next recal generation
+        _tick(svc)
+    _tick(svc)                      # …which re-fits cleanly
+    return svc, path
+
+
+class TestChaosSoak:
+    def test_soak_smoke_ends_healthy_with_adopted_winner(self, env, cfg,
+                                                         tmp_path):
+        svc, path = _run_soak(env, cfg, tmp_path, kills=1)
+        assert svc.resumed_at is not None       # the kill really resumed
+        last = svc.history[-1]
+        assert last["n_quarantined"] == 0       # the poisoned member healed
+        assert np.isfinite(np.array(last["fitness"])).all()
+        # the trip and the heal survive the kill in the restored lineage
+        assert any(r["n_tripped"] == 1 for r in svc.history)
+        assert any(r["n_healed"] == 1 for r in svc.history)
+        assert svc.recalibration_failures == 1  # one poisoned window, counted
+        assert svc.last_recalibration["ok"] is True     # …and recovered
+        # >= 1 winner went through the adoption gate, verdict journaled
+        assert len(svc.adoptions) >= 1
+        assert all("adopted" in v for v in svc.adoptions)
+        svc.close()
+        records, _stats = replay(path)
+        kinds = {r["kind"] for r in records}
+        assert "pbt_adoption" in kinds
+
+    @pytest.mark.slow
+    def test_soak_long_blast_radius_and_zero_recompiles(self, env, cfg,
+                                                        tmp_path):
+        """The full ISSUE-20 soak: 2 kills (one torn mid-append), one
+        poisoned member, one poisoned recalibration window — ends
+        healthy, blast radius == the faulted member (healthy fitness
+        rows bit-identical to a clean twin until the heal reshuffles the
+        exploit bracket), zero steady-state recompiles end to end."""
+        clean = train_pbt(KEY, env, cfg, PCFG._replace(generations=2))
+        mp = meshprof.MeshProf()
+        with meshprof.use(mp):
+            svc, _path = _run_soak(env, cfg, tmp_path, kills=2)
+            assert mp.recompiles.steady_total() == 0, \
+                mp.recompiles.status()
+        assert svc.history[-1]["n_quarantined"] == 0
+        assert np.isfinite(np.array(svc.history[-1]["fitness"])).all()
+        assert svc.recalibration_failures == 1
+        assert len(svc.adoptions) >= 1
+        # blast radius: at the trip generation every healthy member's
+        # fitness is bit-identical to the clean twin's
+        trip_row = next(r for r in svc.history if r["n_tripped"] == 1)
+        g = trip_row["generation"]
+        mask = ~np.asarray(trip_row["quarantined"])
+        np.testing.assert_array_equal(
+            np.array(clean.history[g]["fitness"])[mask],
+            np.array(trip_row["fitness"])[mask])
+        svc.close()
